@@ -1,0 +1,40 @@
+"""scalerl_tpu: a TPU-native (JAX/XLA/pjit/Pallas) distributed deep-RL framework.
+
+Re-designed from scratch with the capabilities of jianzhnie/ScaleRL
+(reference mounted at /root/reference), built TPU-first:
+
+- All neural-net compute (acting inference + learning) runs on TPU inside
+  jitted, batched functions (SEED-RL topology) instead of per-process CPU
+  inference (reference: ``scalerl/algorithms/impala/impala_atari.py:196``).
+- Learner data-parallelism is an XLA ``psum`` over an ICI device mesh
+  (reference: HF Accelerate / NCCL, ``scalerl/trainer/off_policy.py:118``).
+- Replay buffers are static-shape pytree ring buffers living in HBM with
+  device-side sampling (reference: Python deques, ``scalerl/data/replay_buffer.py``).
+- Temporal math (V-trace, n-step returns, LSTM unrolls) is ``jax.lax.scan``
+  (reference: Python reverse loops, ``scalerl/algorithms/impala/vtrace.py:151``).
+
+Package layout
+--------------
+- ``config``   — dataclass argument schemas + CLI parsing
+- ``utils``    — logging, schedulers, timers, metrics, progress
+- ``envs``     — host-side Gym/PettingZoo envs + JAX-native device envs
+- ``data``     — HBM replay (uniform / n-step / prioritized), trajectory structs
+- ``models``   — Flax networks (MLP heads, IMPALA AtariNet)
+- ``ops``      — pure-functional RL math (V-trace, returns, losses)
+- ``parallel`` — mesh construction, sharded train steps, multi-host bring-up
+- ``runtime``  — actor-learner runtime: rollout queues, inference server,
+                 parameter server, TCP transport, worker fleet
+- ``agents``   — DQN, A3C/A2C, IMPALA, Ape-X agents
+- ``trainer``  — trainer loops (off-policy, actor-learner)
+"""
+
+__version__ = "0.1.0"
+
+from scalerl_tpu.config import (  # noqa: F401
+    A3CArguments,
+    ApexArguments,
+    DQNArguments,
+    ImpalaArguments,
+    RLArguments,
+    parse_args,
+)

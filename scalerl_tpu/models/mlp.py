@@ -1,0 +1,130 @@
+"""MLP policy/value networks in Flax.
+
+Parity targets: ``QNet``/``ActorNet``/``CriticNet``/``ActorCriticNet``
+(``scalerl/algorithms/utils/network.py:5-95``) plus the DQN architecture
+flags the reference's config declares (dueling / noisy,
+``scalerl/algorithms/rl_args.py:163-315``).  Compute is sized for the MXU:
+plain Dense stacks in bfloat16-friendly shapes; no data-dependent control
+flow.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class NoisyDense(nn.Module):
+    """Factorized-Gaussian NoisyNet linear layer (Fortunato et al. 2018).
+
+    Noise is passed in via an explicit rng collection (``noise``) so the layer
+    stays a pure function; when the collection is absent the layer runs with
+    mean weights (evaluation mode).
+    """
+
+    features: int
+    sigma0: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        in_features = x.shape[-1]
+        bound = 1.0 / jnp.sqrt(in_features)
+        mu_init = nn.initializers.uniform(scale=2 * bound)
+
+        w_mu = self.param("w_mu", lambda k, s: mu_init(k, s) - bound, (in_features, self.features))
+        b_mu = self.param("b_mu", lambda k, s: mu_init(k, s) - bound, (self.features,))
+        sigma_init = nn.initializers.constant(self.sigma0 / jnp.sqrt(in_features))
+        w_sigma = self.param("w_sigma", sigma_init, (in_features, self.features))
+        b_sigma = self.param("b_sigma", sigma_init, (self.features,))
+
+        if self.has_rng("noise"):
+            key = self.make_rng("noise")
+            k1, k2 = jax.random.split(key)
+            eps_in = jax.random.normal(k1, (in_features,))
+            eps_out = jax.random.normal(k2, (self.features,))
+            f = lambda e: jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+            eps_w = jnp.outer(f(eps_in), f(eps_out))
+            w = w_mu + w_sigma * eps_w
+            b = b_mu + b_sigma * f(eps_out)
+        else:
+            w, b = w_mu, b_mu
+        return x @ w + b
+
+
+def _parse_hidden(hidden_sizes) -> Tuple[int, ...]:
+    if isinstance(hidden_sizes, str):
+        return tuple(int(h) for h in hidden_sizes.split(",") if h)
+    return tuple(hidden_sizes)
+
+
+class QNet(nn.Module):
+    """Q-network with optional dueling heads and noisy layers.
+
+    Parity: ``network.py:5-24`` (plain), dueling/noisy per the DQN flags.
+    """
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (128, 128)
+    dueling: bool = False
+    noisy: bool = False
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(jnp.float32)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)  # flatten everything but batch
+        dense = NoisyDense if self.noisy else nn.Dense
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(dense(h)(x))
+        if self.dueling:
+            adv = dense(self.action_dim)(x)
+            val = dense(1)(x)
+            return val + adv - adv.mean(axis=-1, keepdims=True)
+        return dense(self.action_dim)(x)
+
+
+class ActorNet(nn.Module):
+    """Categorical policy head (``network.py:27-46``)."""
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(jnp.float32)
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.action_dim)(x)  # logits
+
+
+class CriticNet(nn.Module):
+    """State-value head (``network.py:49-67``)."""
+
+    hidden_sizes: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(jnp.float32)
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x).squeeze(-1)
+
+
+class ActorCriticNet(nn.Module):
+    """Shared-torso actor-critic (``network.py:70-95``,
+    ``a3c/parallel_a3c.py:27-68``). Returns (logits, value)."""
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = obs.astype(jnp.float32)
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(nn.Dense(h)(x))
+        logits = nn.Dense(self.action_dim)(x)
+        value = nn.Dense(1)(x).squeeze(-1)
+        return logits, value

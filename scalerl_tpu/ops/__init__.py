@@ -1,0 +1,17 @@
+from scalerl_tpu.ops.losses import (  # noqa: F401
+    baseline_loss,
+    double_dqn_targets,
+    dqn_loss,
+    entropy_loss,
+    policy_gradient_loss,
+)
+from scalerl_tpu.ops.returns import (  # noqa: F401
+    discounted_returns,
+    gae_advantages,
+    n_step_returns,
+)
+from scalerl_tpu.ops.vtrace import (  # noqa: F401
+    VTraceOutput,
+    vtrace_from_importance_weights,
+    vtrace_from_logits,
+)

@@ -1,0 +1,85 @@
+"""RL loss functions, pure and jit/grad-safe.
+
+Parity targets:
+- IMPALA losses (``scalerl/algorithms/impala/loss_fn.py:5-23``):
+  ``compute_baseline_loss`` = 0.5 * sum(adv^2), ``compute_entropy_loss`` =
+  sum(p * log p) (negative entropy; minimised, i.e. an entropy *bonus*),
+  ``compute_policy_gradient_loss`` = sum(NLL(a) * advantage.detach()).
+- DQN / double-DQN target + TD loss (``scalerl/algorithms/dqn/dqn_agent.py:
+  136-180``), with optional element-wise importance weights for PER
+  (``apex/worker.py:134-161``) and Huber option.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def baseline_loss(advantages: jnp.ndarray) -> jnp.ndarray:
+    """0.5 * sum(advantages^2)."""
+    return 0.5 * jnp.sum(jnp.square(advantages))
+
+
+def entropy_loss(logits: jnp.ndarray) -> jnp.ndarray:
+    """sum(p * log p): the negative entropy (minimising adds entropy bonus)."""
+    log_policy = jax.nn.log_softmax(logits, axis=-1)
+    policy = jnp.exp(log_policy)
+    return jnp.sum(policy * log_policy)
+
+
+def policy_gradient_loss(
+    logits: jnp.ndarray,
+    actions: jnp.ndarray,
+    advantages: jnp.ndarray,
+) -> jnp.ndarray:
+    """sum over [T, B] of -log pi(a_t|x_t) * advantage (advantage detached)."""
+    log_policy = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_policy, actions[..., None], axis=-1).squeeze(-1)
+    return jnp.sum(nll * jax.lax.stop_gradient(advantages))
+
+
+def double_dqn_targets(
+    q_next_online: jnp.ndarray,
+    q_next_target: jnp.ndarray,
+    rewards: jnp.ndarray,
+    discounts: jnp.ndarray,
+    double_dqn: bool = True,
+) -> jnp.ndarray:
+    """TD targets: r + discount * Q_target(s', argmax_a Q_online(s', a)).
+
+    With ``double_dqn=False`` the action selection uses the target net
+    (vanilla DQN).  Shapes: q_* [B, A]; rewards/discounts [B].
+    """
+    if double_dqn:
+        next_actions = jnp.argmax(q_next_online, axis=-1)
+    else:
+        next_actions = jnp.argmax(q_next_target, axis=-1)
+    q_next = jnp.take_along_axis(q_next_target, next_actions[:, None], axis=-1).squeeze(-1)
+    return jax.lax.stop_gradient(rewards + discounts * q_next)
+
+
+def dqn_loss(
+    q_values: jnp.ndarray,
+    actions: jnp.ndarray,
+    targets: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+    huber_delta: Optional[float] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """TD loss for chosen actions; returns (loss, |td_error| for PER).
+
+    Shapes: q_values [B, A], actions [B], targets [B], weights [B] or None.
+    """
+    q_sa = jnp.take_along_axis(q_values, actions[:, None], axis=-1).squeeze(-1)
+    td_error = q_sa - targets
+    if huber_delta is not None:
+        abs_td = jnp.abs(td_error)
+        quadratic = jnp.minimum(abs_td, huber_delta)
+        per_elem = 0.5 * quadratic**2 + huber_delta * (abs_td - quadratic)
+    else:
+        per_elem = 0.5 * jnp.square(td_error)
+    if weights is not None:
+        per_elem = per_elem * weights
+    return jnp.mean(per_elem), jnp.abs(jax.lax.stop_gradient(td_error))

@@ -1,0 +1,115 @@
+"""Return / advantage computations as ``lax.scan``s over the time axis.
+
+Covers the reference's temporal math:
+- per-step discounted returns (``scalerl/hpc/generation.py:143-147`` and the
+  A3C rollout return, ``parallel_a3c.py:251-262``) -> ``discounted_returns``;
+- n-step reward folding done incrementally by ``MultiStepReplayBuffer``
+  (``scalerl/data/replay_buffer.py:230-273``) -> ``n_step_returns`` computes
+  the same (reward, n-step-done, index-of-next-state) quantities over a
+  whole ``[T, B]`` trajectory in one scan;
+- GAE (not in the reference, standard for the A2C runtime) -> ``gae_advantages``.
+
+All functions are time-major ``[T, B]`` and jit/grad-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def discounted_returns(
+    rewards: jnp.ndarray,
+    discounts: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+) -> jnp.ndarray:
+    """R_t = r_t + discount_t * R_{t+1}, seeded with the bootstrap value.
+
+    Args:
+      rewards: [T, B].
+      discounts: [T, B] (gamma * (1 - done)).
+      bootstrap_value: [B].
+    """
+
+    def backward(acc, xs):
+        r_t, d_t = xs
+        acc = r_t + d_t * acc
+        return acc, acc
+
+    _, returns = jax.lax.scan(backward, bootstrap_value, (rewards, discounts), reverse=True)
+    return returns
+
+
+def n_step_returns(
+    rewards: jnp.ndarray,
+    dones: jnp.ndarray,
+    values_tpn: jnp.ndarray,
+    gamma: float,
+    n: int,
+) -> jnp.ndarray:
+    """Truncated n-step returns with episode-boundary masking.
+
+    With k_eff(t) = min(n, T - t) (the window truncates at the rollout end):
+
+    G_t = sum_{k=0}^{k_eff-1} gamma^k r_{t+k} * prod_{j<k}(1-d_{t+j})
+          + gamma^{k_eff} * prod_{j<k_eff}(1-d_{t+j}) * values_tpn[t]
+
+    Args:
+      rewards: [T, B].
+      dones: [T, B] episode-termination flags.
+      values_tpn: [T, B] bootstrap values, ``values_tpn[t] = V(x_{min(t+n, T)})``
+        (callers build this by shifting a [T+1] value sequence and clamping the
+        index at T); only consumed where no done occurred inside the window.
+      gamma: scalar discount.
+      n: number of steps.
+    """
+    T = rewards.shape[0]
+    cont = 1.0 - dones.astype(rewards.dtype)
+
+    acc_r = jnp.zeros_like(rewards)
+    alive = jnp.ones_like(rewards)
+    for k in range(n):
+        # reward at t+k (zero past the rollout end), masked by survival
+        # through steps t..t+k-1; padding cont with ones keeps the bootstrap
+        # alive for the truncated tail (only real dones kill it).
+        r_k = jnp.concatenate([rewards[k:], jnp.zeros((k,) + rewards.shape[1:], rewards.dtype)], axis=0)[:T]
+        acc_r = acc_r + (gamma**k) * alive * r_k
+        c_k = jnp.concatenate([cont[k:], jnp.ones((k,) + cont.shape[1:], cont.dtype)], axis=0)[:T]
+        alive = alive * c_k
+    k_eff = jnp.minimum(n, T - jnp.arange(T))
+    gamma_eff = (gamma ** k_eff).astype(rewards.dtype)
+    gamma_eff = gamma_eff.reshape((T,) + (1,) * (rewards.ndim - 1))
+    return acc_r + gamma_eff * alive * values_tpn
+
+
+def gae_advantages(
+    rewards: jnp.ndarray,
+    discounts: jnp.ndarray,
+    values: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    lambda_: float = 0.95,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized advantage estimation.
+
+    A_t = delta_t + discount_t * lambda * A_{t+1},
+    delta_t = r_t + discount_t * V_{t+1} - V_t.
+
+    Returns (advantages [T, B], value targets vs = A + V).
+    """
+    values_t_plus_1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rewards + discounts * values_t_plus_1 - values
+
+    def backward(acc, xs):
+        delta_t, d_t = xs
+        acc = delta_t + d_t * lambda_ * acc
+        return acc, acc
+
+    _, advantages = jax.lax.scan(
+        backward,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, discounts),
+        reverse=True,
+    )
+    return advantages, advantages + values

@@ -1,0 +1,123 @@
+"""V-trace off-policy actor-critic targets (IMPALA) as a reverse ``lax.scan``.
+
+Functional parity with the reference's torch implementation
+(``scalerl/algorithms/impala/vtrace.py:43-172``):
+
+- ``from_logits`` computes behavior/target action log-probs from logits, then
+  defers to ``from_importance_weights`` (reference ``vtrace.py:43-76``).
+- ``from_importance_weights`` clips the importance weights (rho-hat, c-hat),
+  forms temporal-difference deltas, and runs the reverse-time recursion
+  ``acc_t = delta_t + discount_t * c_t * acc_{t+1}`` to get ``vs``
+  (reference's Python loop at ``vtrace.py:149-155`` becomes
+  ``lax.scan(reverse=True)``), then the clipped policy-gradient advantages
+  (``vtrace.py:160-166``).
+
+All inputs are time-major ``[T, B, ...]`` (the universal trajectory layout,
+see SURVEY.md §7).  Everything here is pure and jit/vmap/grad-safe; the
+caller decides where to ``stop_gradient`` (the reference computes V-trace
+under ``torch.no_grad``, so callers should treat the returned targets as
+constants — both exported functions apply ``stop_gradient`` to their outputs).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceOutput(NamedTuple):
+    vs: jnp.ndarray  # [T, B] V-trace value targets
+    pg_advantages: jnp.ndarray  # [T, B] clipped policy-gradient advantages
+
+
+def action_log_probs(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    """log pi(a|s) from unnormalised logits, any leading batch dims."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1).squeeze(-1)
+
+
+def vtrace_from_importance_weights(
+    log_rhos: jnp.ndarray,
+    discounts: jnp.ndarray,
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    clip_rho_threshold: Optional[float] = 1.0,
+    clip_pg_rho_threshold: Optional[float] = 1.0,
+    clip_c_threshold: float = 1.0,
+) -> VTraceOutput:
+    """Compute V-trace targets from log importance weights.
+
+    Args:
+      log_rhos: [T, B] log(pi_target(a)/pi_behavior(a)).
+      discounts: [T, B] per-step discount (gamma * (1 - done)).
+      rewards: [T, B].
+      values: [T, B] value estimates V(x_t) under the target policy.
+      bootstrap_value: [B] V(x_T).
+      clip_rho_threshold: rho-hat clip (None = no clipping).
+      clip_pg_rho_threshold: clip for the pg-advantage rhos (None = none).
+      clip_c_threshold: c-hat clip.
+    """
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos) if clip_rho_threshold is not None else rhos
+    cs = jnp.minimum(clip_c_threshold, rhos)
+
+    # V(x_{t+1}) with bootstrap at the end.
+    values_t_plus_1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_t_plus_1 - values)
+
+    def backward(acc: jnp.ndarray, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs),
+        reverse=True,
+    )
+    vs = vs_minus_v + values
+
+    # Advantage for the policy gradient: r + gamma * vs_{t+1} - V(x_t).
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    if clip_pg_rho_threshold is not None:
+        clipped_pg_rhos = jnp.minimum(clip_pg_rho_threshold, rhos)
+    else:
+        clipped_pg_rhos = rhos
+    pg_advantages = clipped_pg_rhos * (rewards + discounts * vs_t_plus_1 - values)
+
+    return VTraceOutput(
+        vs=jax.lax.stop_gradient(vs),
+        pg_advantages=jax.lax.stop_gradient(pg_advantages),
+    )
+
+
+def vtrace_from_logits(
+    behavior_logits: jnp.ndarray,
+    target_logits: jnp.ndarray,
+    actions: jnp.ndarray,
+    discounts: jnp.ndarray,
+    rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    bootstrap_value: jnp.ndarray,
+    clip_rho_threshold: Optional[float] = 1.0,
+    clip_pg_rho_threshold: Optional[float] = 1.0,
+    clip_c_threshold: float = 1.0,
+) -> VTraceOutput:
+    """V-trace from behavior/target policy logits ([T, B, A]) and actions ([T, B])."""
+    log_rhos = action_log_probs(target_logits, actions) - action_log_probs(
+        behavior_logits, actions
+    )
+    return vtrace_from_importance_weights(
+        log_rhos=log_rhos,
+        discounts=discounts,
+        rewards=rewards,
+        values=values,
+        bootstrap_value=bootstrap_value,
+        clip_rho_threshold=clip_rho_threshold,
+        clip_pg_rho_threshold=clip_pg_rho_threshold,
+        clip_c_threshold=clip_c_threshold,
+    )

@@ -1,0 +1,13 @@
+from scalerl_tpu.utils.logging import get_logger  # noqa: F401
+from scalerl_tpu.utils.metrics import EpisodeMetrics, calculate_mean  # noqa: F401
+from scalerl_tpu.utils.schedulers import (  # noqa: F401
+    LinearDecayScheduler,
+    MultiStepScheduler,
+    PiecewiseScheduler,
+)
+from scalerl_tpu.utils.timers import Timer, Timings  # noqa: F401
+from scalerl_tpu.utils.tree import (  # noqa: F401
+    hard_target_update,
+    param_count,
+    soft_target_update,
+)

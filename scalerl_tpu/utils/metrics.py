@@ -1,0 +1,94 @@
+"""Vectorized episode accounting for env pools.
+
+Parity target: ``EpisodeMetrics`` (``scalerl/envs/env_utils.py:10-82``) and
+``calculate_vectorized_scores`` (``:123-164``) / ``calculate_mean``
+(``scalerl/utils/utils.py``).  Pure numpy on the host — episode boundaries are
+data-dependent and belong outside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+
+class EpisodeMetrics:
+    """Track per-env running return/length and report completed episodes."""
+
+    def __init__(self, num_envs: int) -> None:
+        self.num_envs = num_envs
+        self._returns = np.zeros(num_envs, dtype=np.float64)
+        self._lengths = np.zeros(num_envs, dtype=np.int64)
+        self.episode_returns: List[float] = []
+        self.episode_lengths: List[int] = []
+
+    def step(self, rewards: np.ndarray, dones: np.ndarray) -> int:
+        """Accumulate one vector step. Returns number of episodes completed."""
+        rewards = np.asarray(rewards, dtype=np.float64).reshape(self.num_envs)
+        dones = np.asarray(dones).reshape(self.num_envs).astype(bool)
+        self._returns += rewards
+        self._lengths += 1
+        finished = int(dones.sum())
+        if finished:
+            for i in np.nonzero(dones)[0]:
+                self.episode_returns.append(float(self._returns[i]))
+                self.episode_lengths.append(int(self._lengths[i]))
+            self._returns[dones] = 0.0
+            self._lengths[dones] = 0
+        return finished
+
+    @property
+    def num_episodes(self) -> int:
+        return len(self.episode_returns)
+
+    def summary(self, window: int = 100) -> Dict[str, float]:
+        rets = self.episode_returns[-window:]
+        lens = self.episode_lengths[-window:]
+        if not rets:
+            return {"episodes": 0}
+        return {
+            "episodes": float(len(self.episode_returns)),
+            "return_mean": float(np.mean(rets)),
+            "return_std": float(np.std(rets)),
+            "return_max": float(np.max(rets)),
+            "return_min": float(np.min(rets)),
+            "length_mean": float(np.mean(lens)),
+        }
+
+
+def calculate_vectorized_scores(
+    rewards: np.ndarray,
+    dones: np.ndarray,
+    include_unterminated: bool = False,
+) -> List[float]:
+    """Split ``[T, N]`` reward/done arrays into completed-episode returns."""
+    rewards = np.asarray(rewards, dtype=np.float64)
+    dones = np.asarray(dones).astype(bool)
+    if rewards.ndim == 1:
+        rewards = rewards[:, None]
+        dones = dones[:, None]
+    T, N = rewards.shape
+    scores: List[float] = []
+    for env in range(N):
+        acc = 0.0
+        steps = 0
+        for t in range(T):
+            acc += rewards[t, env]
+            steps += 1
+            if dones[t, env]:
+                scores.append(acc)
+                acc = 0.0
+                steps = 0
+        if include_unterminated and steps > 0:
+            scores.append(acc)
+    return scores
+
+
+def calculate_mean(dicts: Sequence[Mapping[str, float]]) -> Dict[str, float]:
+    """Average a list of metric dicts key-wise (keys may be ragged)."""
+    out: Dict[str, List[float]] = {}
+    for d in dicts:
+        for k, v in d.items():
+            out.setdefault(k, []).append(float(v))
+    return {k: float(np.mean(v)) for k, v in out.items()}

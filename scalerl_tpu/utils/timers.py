@@ -1,0 +1,106 @@
+"""Lightweight host-side profiling timers.
+
+Parity targets: ``Timings`` online mean/variance event profiler
+(``scalerl/utils/profile.py:10-65``, MonoBeast-derived design) and
+``Timer`` (``scalerl/utils/timer.py:12-118``).  For device-side tracing use
+``jax.profiler.trace`` — these timers cover the host runtime (env stepping,
+queue waits, infeed) where ``jax.profiler`` has no visibility.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict
+
+
+class Timings:
+    """Per-event online mean/variance timers (Welford update).
+
+    Usage::
+
+        t = Timings()
+        ... step env ...
+        t.time("step")
+        ... write buffer ...
+        t.time("write")
+    """
+
+    def __init__(self) -> None:
+        self._means: Dict[str, float] = collections.defaultdict(float)
+        self._vars: Dict[str, float] = collections.defaultdict(float)
+        self._counts: Dict[str, int] = collections.defaultdict(int)
+        self.reset()
+
+    def reset(self) -> None:
+        self.last_time = time.time()
+
+    def time(self, name: str) -> None:
+        """Record the elapsed time since the last ``time``/``reset`` call."""
+        now = time.time()
+        x = now - self.last_time
+        self.last_time = now
+        n = self._counts[name]
+        n += 1
+        delta = x - self._means[name]
+        self._means[name] += delta / n
+        delta2 = x - self._means[name]
+        self._vars[name] += delta * delta2
+        self._counts[name] = n
+
+    def means(self) -> Dict[str, float]:
+        return dict(self._means)
+
+    def stds(self) -> Dict[str, float]:
+        return {
+            k: (self._vars[k] / max(self._counts[k], 1)) ** 0.5 for k in self._vars
+        }
+
+    def summary(self, prefix: str = "") -> str:
+        means = self.means()
+        stds = self.stds()
+        total = sum(means.values()) or 1.0
+        rows = [
+            f"  {k}: {1000.0 * means[k]:.2f}ms +- {1000.0 * stds[k]:.2f}ms "
+            f"({100.0 * means[k] / total:.1f}%)"
+            for k in sorted(means, key=means.get, reverse=True)  # type: ignore[arg-type]
+        ]
+        return f"{prefix}total: {1000.0 * total:.2f}ms\n" + "\n".join(rows)
+
+
+class Timer:
+    """Context-manager stopwatch with a running check interval."""
+
+    def __init__(self) -> None:
+        self._start = time.time()
+        self._last_check = self._start
+        self._running = True
+
+    def __enter__(self) -> "Timer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._running = False
+
+    def start(self) -> None:
+        self._start = time.time()
+        self._last_check = self._start
+        self._running = True
+
+    def since_start(self) -> float:
+        return time.time() - self._start
+
+    def since_last_check(self) -> float:
+        now = time.time()
+        dur = now - self._last_check
+        self._last_check = now
+        return dur
+
+    def check_time(self, interval: float) -> bool:
+        """True (and reset the check clock) if ``interval`` seconds elapsed."""
+        now = time.time()
+        if now - self._last_check >= interval:
+            self._last_check = now
+            return True
+        return False

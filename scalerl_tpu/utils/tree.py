@@ -1,0 +1,48 @@
+"""Pytree parameter utilities: target-network updates, counting.
+
+Parity target: ``hard_target_update`` / ``soft_target_update``
+(``scalerl/utils/model_utils.py:4-32``) — reimagined as pure functions over
+Flax parameter pytrees so they can live inside a jitted train step (the
+reference mutates ``nn.Module`` state dicts on the host).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+def hard_target_update(online: Params, target: Params) -> Params:
+    """target <- online (pure; returns the new target pytree)."""
+    del target
+    return jax.tree_util.tree_map(lambda x: x, online)
+
+
+def soft_target_update(online: Params, target: Params, tau: float) -> Params:
+    """Polyak update: target <- tau * online + (1 - tau) * target."""
+    return jax.tree_util.tree_map(
+        lambda o, t: tau * o + (1.0 - tau) * t, online, target
+    )
+
+
+def periodic_target_update(
+    online: Params, target: Params, steps: jnp.ndarray, period: int
+) -> Params:
+    """Hard-update target every ``period`` steps; identity otherwise (jittable)."""
+    return jax.tree_util.tree_map(
+        lambda o, t: jnp.where(steps % period == 0, o, t), online, target
+    )
+
+
+def param_count(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def tree_norm(tree: Params) -> jnp.ndarray:
+    """Global L2 norm of a pytree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in leaves))

@@ -1,0 +1,115 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.models import ActorCriticNet, ActorNet, AtariNet, CriticNet, QNet
+
+
+def test_qnet_shapes():
+    net = QNet(action_dim=4, hidden_sizes=(32, 32))
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 8)))
+    q = net.apply(params, jnp.zeros((5, 8)))
+    assert q.shape == (5, 4)
+
+
+def test_qnet_flattens_multidim_obs():
+    net = QNet(action_dim=4, hidden_sizes=(16,))
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 3, 5)))
+    q = net.apply(params, jnp.zeros((7, 3, 5)))
+    assert q.shape == (7, 4)
+
+
+def test_qnet_dueling_mean_zero_advantage():
+    net = QNet(action_dim=3, hidden_sizes=(16,), dueling=True)
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    q = net.apply(params, jax.random.normal(jax.random.PRNGKey(1), (7, 4)))
+    assert q.shape == (7, 3)
+
+
+def test_qnet_noisy_deterministic_without_rng():
+    net = QNet(action_dim=3, hidden_sizes=(16,), noisy=True)
+    obs = jnp.ones((2, 4))
+    params = net.init(jax.random.PRNGKey(0), obs)
+    q1 = net.apply(params, obs)
+    q2 = net.apply(params, obs)
+    np.testing.assert_allclose(np.asarray(q1), np.asarray(q2))
+    # with a noise rng, output differs across keys
+    qa = net.apply(params, obs, rngs={"noise": jax.random.PRNGKey(1)})
+    qb = net.apply(params, obs, rngs={"noise": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(qa), np.asarray(qb))
+
+
+def test_actor_critic_nets():
+    a = ActorNet(action_dim=2, hidden_sizes=(16,))
+    c = CriticNet(hidden_sizes=(16,))
+    ac = ActorCriticNet(action_dim=2, hidden_sizes=(16,))
+    obs = jnp.zeros((3, 4))
+    pa = a.init(jax.random.PRNGKey(0), obs)
+    pc = c.init(jax.random.PRNGKey(0), obs)
+    pac = ac.init(jax.random.PRNGKey(0), obs)
+    assert a.apply(pa, obs).shape == (3, 2)
+    assert c.apply(pc, obs).shape == (3,)
+    logits, value = ac.apply(pac, obs)
+    assert logits.shape == (3, 2) and value.shape == (3,)
+
+
+@pytest.mark.parametrize("use_lstm", [False, True])
+def test_atari_net_forward(use_lstm):
+    T, B, A = 3, 2, 6
+    net = AtariNet(num_actions=A, use_lstm=use_lstm, hidden_size=64, lstm_layers=2)
+    frame = jnp.zeros((T, B, 84, 84, 4), jnp.uint8)
+    last_action = jnp.zeros((T, B), jnp.int32)
+    reward = jnp.zeros((T, B))
+    done = jnp.zeros((T, B), bool)
+    state = net.initial_state(B)
+    params = net.init(jax.random.PRNGKey(0), frame, last_action, reward, done, state)
+    (out, new_state) = net.apply(params, frame, last_action, reward, done, state)
+    assert out.policy_logits.shape == (T, B, A)
+    assert out.baseline.shape == (T, B)
+    if use_lstm:
+        assert len(new_state) == 2
+        assert new_state[0][0].shape == (B, net.core_size)
+
+
+def test_atari_net_done_resets_state():
+    """A done at t must reset the LSTM carry: the step after a done should be
+    identical to a fresh-state step."""
+    T, B, A = 1, 1, 4
+    net = AtariNet(num_actions=A, use_lstm=True, hidden_size=32, lstm_layers=1)
+    frame = jnp.ones((T, B, 84, 84, 4), jnp.uint8) * 7
+    la = jnp.zeros((T, B), jnp.int32)
+    rw = jnp.zeros((T, B))
+    fresh = net.initial_state(B)
+    params = net.init(jax.random.PRNGKey(0), frame, la, rw, jnp.zeros((T, B), bool), fresh)
+
+    # run a step to get a non-trivial carry
+    _, dirty = net.apply(params, frame, la, rw, jnp.zeros((T, B), bool), fresh)
+    assert not np.allclose(np.asarray(dirty[0][1]), 0.0)
+
+    # done=True at this step -> output should match running from fresh state
+    out_reset, _ = net.apply(params, frame, la, rw, jnp.ones((T, B), bool), dirty)
+    out_fresh, _ = net.apply(params, frame, la, rw, jnp.ones((T, B), bool), fresh)
+    np.testing.assert_allclose(
+        np.asarray(out_reset.policy_logits), np.asarray(out_fresh.policy_logits), rtol=1e-5
+    )
+
+
+def test_atari_net_jit_grad():
+    T, B, A = 2, 2, 4
+    net = AtariNet(num_actions=A, use_lstm=True, hidden_size=32, lstm_layers=1)
+    frame = jnp.zeros((T, B, 84, 84, 4), jnp.uint8)
+    la = jnp.zeros((T, B), jnp.int32)
+    rw = jnp.zeros((T, B))
+    dn = jnp.zeros((T, B), bool)
+    state = net.initial_state(B)
+    params = net.init(jax.random.PRNGKey(0), frame, la, rw, dn, state)
+
+    @jax.jit
+    def loss(p):
+        out, _ = net.apply(p, frame, la, rw, dn, state)
+        return jnp.sum(out.baseline ** 2) + jnp.sum(out.policy_logits ** 2)
+
+    g = jax.grad(loss)(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)

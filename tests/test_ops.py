@@ -1,0 +1,246 @@
+"""Golden-value tests for the temporal RL math against plain-numpy oracles.
+
+The numpy oracles implement the IMPALA-paper recursions with explicit Python
+loops (independent of the lax.scan implementations under test), per
+SURVEY.md §7's prescription to bitwise-check the scans.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from scalerl_tpu.ops import (
+    baseline_loss,
+    double_dqn_targets,
+    dqn_loss,
+    entropy_loss,
+    discounted_returns,
+    gae_advantages,
+    n_step_returns,
+    policy_gradient_loss,
+    vtrace_from_importance_weights,
+    vtrace_from_logits,
+)
+
+T, B, A = 7, 3, 5
+
+
+def np_softmax(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+def np_vtrace(log_rhos, discounts, rewards, values, bootstrap, rho_clip, pg_rho_clip, c_clip=1.0):
+    rhos = np.exp(log_rhos)
+    clipped_rhos = np.minimum(rho_clip, rhos) if rho_clip is not None else rhos
+    cs = np.minimum(c_clip, rhos)
+    values_tp1 = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+    acc = np.zeros_like(bootstrap)
+    vs_minus_v = np.zeros_like(values)
+    for t in reversed(range(len(rewards))):
+        acc = deltas[t] + discounts[t] * cs[t] * acc
+        vs_minus_v[t] = acc
+    vs = vs_minus_v + values
+    vs_tp1 = np.concatenate([vs[1:], bootstrap[None]], axis=0)
+    pg_rhos = np.minimum(pg_rho_clip, rhos) if pg_rho_clip is not None else rhos
+    pg_adv = pg_rhos * (rewards + discounts * vs_tp1 - values)
+    return vs, pg_adv
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def test_vtrace_importance_weights_matches_numpy(rng):
+    log_rhos = rng.normal(size=(T, B)).astype(np.float32) * 0.5
+    discounts = (0.99 * (rng.random((T, B)) > 0.1)).astype(np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    out = vtrace_from_importance_weights(
+        jnp.array(log_rhos), jnp.array(discounts), jnp.array(rewards),
+        jnp.array(values), jnp.array(bootstrap),
+        clip_rho_threshold=1.0, clip_pg_rho_threshold=1.0,
+    )
+    vs_np, pg_np = np_vtrace(log_rhos, discounts, rewards, values, bootstrap, 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(out.vs), vs_np, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg_np, rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_no_clipping(rng):
+    log_rhos = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = np.full((T, B), 0.9, np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    out = vtrace_from_importance_weights(
+        jnp.array(log_rhos), jnp.array(discounts), jnp.array(rewards),
+        jnp.array(values), jnp.array(bootstrap),
+        clip_rho_threshold=None, clip_pg_rho_threshold=None,
+    )
+    vs_np, pg_np = np_vtrace(log_rhos, discounts, rewards, values, bootstrap, None, None)
+    np.testing.assert_allclose(np.asarray(out.vs), vs_np, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg_np, rtol=1e-4, atol=1e-4)
+
+
+def test_vtrace_on_policy_reduces_to_n_step_bellman(rng):
+    """With rho == 1 (on-policy), vs should equal the discounted return."""
+    log_rhos = np.zeros((T, B), np.float32)
+    discounts = np.full((T, B), 0.95, np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    out = vtrace_from_importance_weights(
+        jnp.array(log_rhos), jnp.array(discounts), jnp.array(rewards),
+        jnp.array(values), jnp.array(bootstrap),
+    )
+    # On-policy V-trace target is the Monte-Carlo lambda=1 return.
+    ret = discounted_returns(jnp.array(rewards), jnp.array(discounts), jnp.array(bootstrap))
+    np.testing.assert_allclose(np.asarray(out.vs), np.asarray(ret), rtol=1e-4, atol=1e-4)
+
+
+def test_vtrace_from_logits_consistency(rng):
+    behavior = rng.normal(size=(T, B, A)).astype(np.float32)
+    target = rng.normal(size=(T, B, A)).astype(np.float32)
+    actions = rng.integers(0, A, size=(T, B))
+    discounts = np.full((T, B), 0.99, np.float32)
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+
+    out = vtrace_from_logits(
+        jnp.array(behavior), jnp.array(target), jnp.array(actions),
+        jnp.array(discounts), jnp.array(rewards), jnp.array(values), jnp.array(bootstrap),
+    )
+    lp_t = np.log(np_softmax(target))
+    lp_b = np.log(np_softmax(behavior))
+    idx = np.arange(A)
+    log_rhos = np.take_along_axis(lp_t, actions[..., None], -1)[..., 0] - np.take_along_axis(lp_b, actions[..., None], -1)[..., 0]
+    vs_np, pg_np = np_vtrace(log_rhos, discounts, rewards, values, bootstrap, 1.0, 1.0)
+    np.testing.assert_allclose(np.asarray(out.vs), vs_np, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg_np, rtol=1e-4, atol=1e-4)
+
+
+def test_discounted_returns_oracle(rng):
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = (0.9 * (rng.random((T, B)) > 0.2)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    ret = np.zeros((T, B), np.float32)
+    acc = bootstrap.copy()
+    for t in reversed(range(T)):
+        acc = rewards[t] + discounts[t] * acc
+        ret[t] = acc
+    out = discounted_returns(jnp.array(rewards), jnp.array(discounts), jnp.array(bootstrap))
+    np.testing.assert_allclose(np.asarray(out), ret, rtol=1e-5, atol=1e-5)
+
+
+def test_n_step_returns_oracle(rng):
+    n, gamma = 3, 0.9
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    dones = (rng.random((T, B)) > 0.7)
+    values_tpn = rng.normal(size=(T, B)).astype(np.float32)
+
+    # Oracle for the truncated-tail contract: k_eff = min(n, T - t); the
+    # bootstrap survives unless a REAL done occurs inside the window.
+    expected = np.zeros((T, B), np.float32)
+    for b in range(B):
+        for t in range(T):
+            k_eff = min(n, T - t)
+            acc, surv = 0.0, 1.0
+            for k in range(k_eff):
+                acc += (gamma**k) * surv * rewards[t + k, b]
+                if dones[t + k, b]:
+                    surv = 0.0
+                    break
+            expected[t, b] = acc + (gamma**k_eff) * surv * values_tpn[t, b]
+    out = n_step_returns(jnp.array(rewards), jnp.array(dones), jnp.array(values_tpn), gamma, n)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_gae_oracle(rng):
+    lam = 0.95
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    discounts = (0.99 * (rng.random((T, B)) > 0.1)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    values_tp1 = np.concatenate([values[1:], bootstrap[None]], 0)
+    deltas = rewards + discounts * values_tp1 - values
+    adv = np.zeros((T, B), np.float32)
+    acc = np.zeros(B, np.float32)
+    for t in reversed(range(T)):
+        acc = deltas[t] + discounts[t] * lam * acc
+        adv[t] = acc
+    a, vt = gae_advantages(jnp.array(rewards), jnp.array(discounts), jnp.array(values), jnp.array(bootstrap), lam)
+    np.testing.assert_allclose(np.asarray(a), adv, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(vt), adv + values, rtol=1e-4, atol=1e-4)
+
+
+def test_losses(rng):
+    logits = jnp.array(rng.normal(size=(T, B, A)).astype(np.float32))
+    actions = jnp.array(rng.integers(0, A, size=(T, B)))
+    adv = jnp.array(rng.normal(size=(T, B)).astype(np.float32))
+
+    # entropy_loss is sum(p log p) <= 0, minimised at uniform
+    assert float(entropy_loss(logits)) < 0
+    uniform = jnp.zeros((1, 1, A))
+    np.testing.assert_allclose(float(entropy_loss(uniform)), -np.log(A), rtol=1e-5)
+
+    # pg loss equals manual NLL * adv
+    lp = jax.nn.log_softmax(logits, -1)
+    nll = -np.take_along_axis(np.asarray(lp), np.asarray(actions)[..., None], -1)[..., 0]
+    expected = float((nll * np.asarray(adv)).sum())
+    np.testing.assert_allclose(float(policy_gradient_loss(logits, actions, adv)), expected, rtol=1e-4)
+
+    np.testing.assert_allclose(float(baseline_loss(adv)), 0.5 * float((np.asarray(adv) ** 2).sum()), rtol=1e-5)
+
+
+def test_double_dqn_targets_and_loss(rng):
+    Bq = 6
+    q_online = jnp.array(rng.normal(size=(Bq, A)).astype(np.float32))
+    q_target = jnp.array(rng.normal(size=(Bq, A)).astype(np.float32))
+    rewards = jnp.array(rng.normal(size=(Bq,)).astype(np.float32))
+    discounts = jnp.full((Bq,), 0.99)
+
+    tgt = double_dqn_targets(q_online, q_target, rewards, discounts, double_dqn=True)
+    sel = np.argmax(np.asarray(q_online), -1)
+    expected = np.asarray(rewards) + 0.99 * np.take_along_axis(np.asarray(q_target), sel[:, None], -1)[:, 0]
+    np.testing.assert_allclose(np.asarray(tgt), expected, rtol=1e-5)
+
+    # vanilla DQN picks argmax from target net
+    tgt_v = double_dqn_targets(q_online, q_target, rewards, discounts, double_dqn=False)
+    sel_v = np.argmax(np.asarray(q_target), -1)
+    expected_v = np.asarray(rewards) + 0.99 * np.take_along_axis(np.asarray(q_target), sel_v[:, None], -1)[:, 0]
+    np.testing.assert_allclose(np.asarray(tgt_v), expected_v, rtol=1e-5)
+
+    q = jnp.array(rng.normal(size=(Bq, A)).astype(np.float32))
+    actions = jnp.array(rng.integers(0, A, size=(Bq,)))
+    loss, td = dqn_loss(q, actions, tgt)
+    assert loss.shape == ()
+    assert td.shape == (Bq,)
+    w = jnp.zeros((Bq,))
+    loss_w, _ = dqn_loss(q, actions, tgt, weights=w)
+    assert float(loss_w) == 0.0
+
+
+def test_vtrace_jit_and_grad():
+    """The whole V-trace + loss pipeline must be jit- and grad-safe."""
+    key = jax.random.PRNGKey(0)
+    behavior = jax.random.normal(key, (T, B, A))
+    params = jnp.zeros((A,))
+
+    def loss_fn(p):
+        target = behavior + p  # fake dependence on params
+        actions = jnp.zeros((T, B), jnp.int32)
+        discounts = jnp.full((T, B), 0.99)
+        rewards = jnp.ones((T, B))
+        values = jnp.zeros((T, B))
+        bootstrap = jnp.zeros((B,))
+        out = vtrace_from_logits(behavior, target, actions, discounts, rewards, values, bootstrap)
+        return policy_gradient_loss(target, actions, out.pg_advantages) + baseline_loss(out.vs - values)
+
+    g = jax.jit(jax.grad(loss_fn))(params)
+    assert np.all(np.isfinite(np.asarray(g)))

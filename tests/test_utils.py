@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from scalerl_tpu.utils import (
+    EpisodeMetrics,
+    LinearDecayScheduler,
+    MultiStepScheduler,
+    PiecewiseScheduler,
+    Timings,
+    calculate_mean,
+)
+from scalerl_tpu.utils.metrics import calculate_vectorized_scores
+
+
+def test_linear_decay():
+    s = LinearDecayScheduler(1.0, 0.1, total_steps=9)
+    assert s.value(0) == pytest.approx(1.0)
+    assert s.value(9) == pytest.approx(0.1)
+    assert s.value(100) == pytest.approx(0.1)
+    mid = s.value(4)
+    assert 0.1 < mid < 1.0
+
+
+def test_piecewise():
+    s = PiecewiseScheduler([(0, 1.0), (10, 0.5), (20, 0.1)])
+    assert s.value(5) == 1.0
+    assert s.value(10) == 0.5
+    assert s.value(25) == 0.1
+    with pytest.raises(ValueError):
+        PiecewiseScheduler([(10, 1.0), (0, 0.5)])
+
+
+def test_multistep():
+    s = MultiStepScheduler(1.0, [5, 10], gamma=0.1)
+    assert s.value(0) == 1.0
+    assert s.value(5) == pytest.approx(0.1)
+    assert s.value(10) == pytest.approx(0.01)
+
+
+def test_episode_metrics():
+    m = EpisodeMetrics(num_envs=2)
+    m.step(np.array([1.0, 2.0]), np.array([False, False]))
+    done = m.step(np.array([1.0, 2.0]), np.array([True, False]))
+    assert done == 1
+    assert m.episode_returns == [2.0]
+    assert m.episode_lengths == [2]
+    m.step(np.array([5.0, 2.0]), np.array([False, True]))
+    assert m.episode_returns == [2.0, 6.0]
+    s = m.summary()
+    assert s["episodes"] == 2
+    assert s["return_mean"] == pytest.approx(4.0)
+
+
+def test_vectorized_scores():
+    rewards = np.array([[1.0, 2.0], [1.0, 2.0], [1.0, 2.0]])
+    dones = np.array([[False, True], [False, False], [True, True]])
+    scores = calculate_vectorized_scores(rewards, dones)
+    assert sorted(scores) == [2.0, 3.0, 4.0]
+
+
+def test_calculate_mean():
+    out = calculate_mean([{"a": 1.0, "b": 2.0}, {"a": 3.0}])
+    assert out == {"a": 2.0, "b": 2.0}
+
+
+def test_timings():
+    t = Timings()
+    t.time("a")
+    t.time("b")
+    assert set(t.means()) == {"a", "b"}
+    assert "total" in t.summary()
+
+
+def test_target_updates():
+    import jax.numpy as jnp
+
+    from scalerl_tpu.utils import hard_target_update, soft_target_update
+
+    online = {"w": jnp.ones(3)}
+    target = {"w": jnp.zeros(3)}
+    new_t = soft_target_update(online, target, tau=0.1)
+    np.testing.assert_allclose(np.asarray(new_t["w"]), 0.1 * np.ones(3), rtol=1e-6)
+    hard = hard_target_update(online, target)
+    np.testing.assert_allclose(np.asarray(hard["w"]), np.ones(3))
