@@ -1,0 +1,48 @@
+"""DQN on CartPole — the canonical e2e entry point.
+
+Parity target: ``examples/test_dqn.py`` in the reference (tyro CLI ->
+Accelerator -> vec envs -> DQNAgent -> OffPolicyTrainer.run()), minus the
+Accelerator: distribution comes from the pjit'd learner, not a launcher.
+
+Usage::
+
+    python examples/train_dqn.py --env-id CartPole-v1 --max-timesteps 50000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import DQNAgent
+from scalerl_tpu.config import DQNArguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OffPolicyTrainer
+
+
+def main() -> None:
+    args = parse_args(DQNArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    train_envs = make_vect_envs(args.env_id, num_envs=args.num_envs, seed=args.seed)
+    eval_envs = make_vect_envs(args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False)
+    agent = DQNAgent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        action_dim=train_envs.single_action_space.n,
+    )
+    trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        train_envs.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

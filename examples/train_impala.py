@@ -1,0 +1,87 @@
+"""IMPALA training entry point.
+
+Parity target: ``examples/test_impala_atari.py`` (which is import-broken in
+the reference, SURVEY.md §2.4 — this one runs).  Two backends:
+
+- ``--env-backend jax``  : fused on-device actor-learner loop (flagship
+  throughput path; CartPole-v1 or SyntheticPixel-v0).
+- ``--env-backend gym``  : host actor threads + central batched device
+  inference (SEED-RL topology; any gymnasium env id, Atari if ale_py
+  is installed).
+
+Usage::
+
+    python examples/train_impala.py --env-backend jax --env-id CartPole-v1 \
+        --max-timesteps 500000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+from scalerl_tpu.agents.impala import ImpalaAgent
+from scalerl_tpu.config import ImpalaArguments, parse_args
+from scalerl_tpu.envs import make_jax_vec_env, make_vect_envs
+
+
+def main() -> None:
+    args = parse_args(ImpalaArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+
+    if args.env_backend == "jax":
+        from scalerl_tpu.trainer.actor_learner import DeviceActorLearnerTrainer
+
+        venv = make_jax_vec_env(args.env_id, num_envs=args.num_envs)
+        agent = ImpalaAgent(
+            args,
+            obs_shape=venv.observation_shape,
+            num_actions=venv.num_actions,
+            obs_dtype=venv.env.observation_dtype,
+        )
+        trainer = DeviceActorLearnerTrainer(args, agent, venv)
+    else:
+        from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+        envs_per_actor = max(args.num_envs // args.num_actors, 1)
+        atari = args.env_id.startswith("ALE/") or "NoFrameskip" in args.env_id
+        env_fns = [
+            (
+                lambda i=i: make_vect_envs(
+                    args.env_id,
+                    num_envs=envs_per_actor,
+                    seed=args.seed + i,
+                    async_envs=envs_per_actor > 1,
+                    atari=atari,
+                )
+            )
+            for i in range(args.num_actors)
+        ]
+        probe = env_fns[0]()
+        obs_shape = probe.single_observation_space.shape
+        num_actions = probe.single_action_space.n
+        probe.close()
+        agent = ImpalaAgent(
+            args,
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=jnp.uint8 if len(obs_shape) == 3 else jnp.float32,
+        )
+        trainer = HostActorLearnerTrainer(args, agent, env_fns)
+
+    try:
+        result = trainer.train(total_frames=args.total_steps)
+        print("final:", {k: round(float(v), 3) for k, v in result.items()})
+        if args.save_model and not args.disable_checkpoint:
+            path = agent.save_checkpoint(os.path.join(trainer.model_save_dir, "ckpt_final"))
+            print("checkpoint:", path)
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from scalerl_tpu.agents.base import BaseAgent  # noqa: F401
+from scalerl_tpu.agents.dqn import DQNAgent, DQNTrainState  # noqa: F401

@@ -1,0 +1,44 @@
+"""Agent interface.
+
+Parity target: ``BaseAgent`` (``scalerl/algorithms/base.py:7-124``):
+``get_action`` (exploration) / ``predict`` (greedy) / ``learn`` /
+``get_weights`` / ``set_weights`` / ``save_checkpoint`` / ``load_checkpoint``.
+TPU-shaped differences: weights are parameter pytrees (not state dicts), and
+``learn`` consumes a device-resident batch dict and returns a metrics dict.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+
+class BaseAgent(ABC):
+    """Algorithm-agnostic agent API consumed by the trainers."""
+
+    @abstractmethod
+    def get_action(self, obs: np.ndarray) -> np.ndarray:
+        """Sample actions with exploration (host entry point for actors)."""
+
+    @abstractmethod
+    def predict(self, obs: np.ndarray) -> np.ndarray:
+        """Greedy/argmax actions (evaluation)."""
+
+    @abstractmethod
+    def learn(self, batch: Mapping[str, Any]) -> Dict[str, float]:
+        """One gradient step on a batch; returns scalar metrics."""
+
+    def get_weights(self) -> Any:
+        """Return the current parameter pytree (for parameter servers)."""
+        raise NotImplementedError
+
+    def set_weights(self, weights: Any) -> None:
+        raise NotImplementedError
+
+    def save_checkpoint(self, path: str) -> str:
+        raise NotImplementedError
+
+    def load_checkpoint(self, path: str) -> None:
+        raise NotImplementedError

@@ -142,7 +142,7 @@ class A3CArguments(RLArguments):
 
     algo_name: str = "a3c"
     num_workers: int = 8
-    rollout_steps: int = 20
+    # the unroll is the inherited ``rollout_length`` field (default 20)
     value_loss_coef: float = 0.5
     entropy_coef: float = 0.01
     gae_lambda: float = 1.0
@@ -168,9 +168,8 @@ class ImpalaArguments(RLArguments):
     num_buffers: int = 32  # free/full queue depth (impala_atari.py:72)
     num_learner_threads: int = 1
     batch_size: int = 8
-    # Loss
+    # Loss (the discount is the inherited ``gamma`` field — no duplicate knob)
     reward_clipping: str = "abs_one"  # abs_one | none
-    discounting: float = 0.99
     baseline_cost: float = 0.5
     entropy_cost: float = 0.01
     vtrace_rho_clip: float = 1.0
@@ -181,9 +180,19 @@ class ImpalaArguments(RLArguments):
     rmsprop_eps: float = 0.01
     rmsprop_momentum: float = 0.0
     max_grad_norm: float = 40.0
-    # Run
-    total_steps: int = 30_000_000
+    # Run (the frame budget is the inherited ``max_timesteps`` field)
+    max_timesteps: int = 30_000_000
     checkpoint_interval_s: float = 600.0
+
+    # Reference-vocabulary aliases (read-only; the CLI flags are --gamma and
+    # --max-timesteps — one knob per quantity, no config drift)
+    @property
+    def discounting(self) -> float:
+        return self.gamma
+
+    @property
+    def total_steps(self) -> int:
+        return self.max_timesteps
 
     def validate(self) -> None:
         super().validate()

@@ -1,0 +1,17 @@
+from scalerl_tpu.data.replay import (  # noqa: F401
+    ReplayBuffer,
+    ReplayState,
+    replay_add,
+    replay_init,
+    replay_sample,
+)
+from scalerl_tpu.data.prioritized import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    PrioritizedState,
+    per_add,
+    per_init,
+    per_sample,
+    per_update_priorities,
+)
+from scalerl_tpu.data.sampler import Sampler  # noqa: F401
+from scalerl_tpu.data.trajectory import Trajectory, TrajectorySpec  # noqa: F401

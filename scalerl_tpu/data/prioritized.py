@@ -1,0 +1,197 @@
+"""Prioritized experience replay with device-side proportional sampling.
+
+Capability parity with the reference's ``PrioritizedReplayBuffer`` +
+segment trees (``scalerl/data/replay_buffer.py:276-381``,
+``scalerl/data/segment_tree.py``) and the Ape-X duplicate
+(``scalerl/algorithms/apex/memory.py:11-138``), re-designed for XLA:
+
+Segment trees are pointer-chasing and XLA-hostile (SURVEY.md §7).  Instead,
+stratified proportional sampling is a masked ``cumsum`` over the priority
+plane followed by a vectorized ``searchsorted`` — O(capacity) streaming work
+that XLA vectorizes and fuses, instead of O(log n) *sequential* descents per
+sample.  Priority updates are pure scatters, so the learner can update
+priorities inside its jitted train step with no host round-trip.
+
+Priorities are stored raw; the ``alpha`` exponent is applied at sample time
+(equivalent to the reference storing ``p**alpha``), and importance weights
+use the standard ``(N * P)^-beta / max`` normalization
+(``replay_buffer.py:370-381``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from scalerl_tpu.data.replay import (
+    ReplayState,
+    Spec,
+    _logical_start,
+    gather_transitions,
+    replay_add,
+    replay_init,
+    transition_spec,
+)
+
+
+@struct.dataclass
+class PrioritizedState:
+    replay: ReplayState
+    priorities: jnp.ndarray  # [capacity, num_envs] raw (un-exponentiated)
+    max_priority: jnp.ndarray  # float32 scalar
+
+
+def per_init(spec: Spec, capacity: int, num_envs: int) -> PrioritizedState:
+    return PrioritizedState(
+        replay=replay_init(spec, capacity, num_envs),
+        priorities=jnp.zeros((capacity, num_envs), jnp.float32),
+        max_priority=jnp.ones((), jnp.float32),
+    )
+
+
+def per_add(state: PrioritizedState, step) -> PrioritizedState:
+    """Add one vector step; new transitions get the current max priority."""
+    pos = state.replay.pos
+    replay = replay_add(state.replay, step)
+    priorities = state.priorities.at[pos].set(state.max_priority)
+    return state.replace(replay=replay, priorities=priorities)
+
+
+def _flat_physical(state: PrioritizedState, flat_logical: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Map flat logical indices (row-major over [logical_row, env]) to
+    physical (row, env)."""
+    capacity, num_envs = state.priorities.shape
+    start = _logical_start(state.replay, capacity)
+    logical = flat_logical // num_envs
+    envs = flat_logical % num_envs
+    rows = (start + logical) % capacity
+    return rows, envs
+
+
+def per_sample(
+    state: PrioritizedState,
+    key: jax.Array,
+    batch_size: int,
+    alpha: jnp.ndarray,
+    beta: jnp.ndarray,
+    n_step: int = 1,
+    gamma: float = 0.99,
+) -> Dict[str, jnp.ndarray]:
+    """Stratified proportional sample; returns transitions + ``weights``.
+
+    The distribution is ``p_i^alpha`` over valid logical rows (those with a
+    full n-step window); sampling is a cumsum + stratified searchsorted
+    (plan A of SURVEY.md §7; Pallas tree is plan B if this path ever
+    dominates the profile).
+    """
+    capacity, num_envs = state.priorities.shape
+    start = _logical_start(state.replay, capacity)
+    size = state.replay.size
+
+    # Priorities in logical order: roll so row 0 = oldest.
+    logical_prio = jnp.roll(state.priorities, -start, axis=0)
+    valid = (jnp.arange(capacity) < jnp.maximum(size - n_step, 1))[:, None]
+    p = jnp.where(valid, logical_prio, 0.0) ** alpha
+    p = jnp.where(valid, jnp.maximum(p, 1e-12), 0.0)
+    flat_p = p.reshape(-1)
+    cum = jnp.cumsum(flat_p)
+    total = cum[-1]
+
+    # Stratified uniforms: one per bucket.
+    u = jax.random.uniform(key, (batch_size,))
+    targets = (jnp.arange(batch_size) + u) / batch_size * total
+    flat_logical = jnp.searchsorted(cum, targets, side="left")
+    flat_logical = jnp.clip(flat_logical, 0, capacity * num_envs - 1)
+
+    probs = flat_p[flat_logical] / jnp.maximum(total, 1e-12)
+    n_valid = jnp.maximum(jnp.sum(valid) * num_envs, 1).astype(jnp.float32)
+    weights = (n_valid * jnp.maximum(probs, 1e-12)) ** (-beta)
+    weights = weights / jnp.maximum(jnp.max(weights), 1e-12)
+
+    logical = flat_logical // num_envs
+    envs = flat_logical % num_envs
+    batch = gather_transitions(state.replay, logical, envs, n_step, gamma)
+    batch["weights"] = weights
+    return batch
+
+
+def per_update_priorities(
+    state: PrioritizedState,
+    flat_logical: jnp.ndarray,  # [B] as returned in batch["indices"]
+    priorities: jnp.ndarray,  # [B] new raw priorities (e.g. |td| + eps)
+) -> PrioritizedState:
+    rows, envs = _flat_physical(state, flat_logical)
+    priorities = jnp.maximum(priorities, 1e-6)
+    new_prio = state.priorities.at[rows, envs].set(priorities)
+    new_max = jnp.maximum(state.max_priority, jnp.max(priorities))
+    return state.replace(priorities=new_prio, max_priority=new_max)
+
+
+class PrioritizedReplayBuffer:
+    """Host-side wrapper mirroring the reference PER API
+    (``sample(batch_size, beta)`` + ``update_priorities``,
+    ``replay_buffer.py:319-351``)."""
+
+    def __init__(
+        self,
+        obs_shape: Tuple[int, ...],
+        capacity: int,
+        num_envs: int = 1,
+        obs_dtype: jnp.dtype = jnp.float32,
+        alpha: float = 0.6,
+        n_step: int = 1,
+        gamma: float = 0.99,
+    ) -> None:
+        self.spec = transition_spec(obs_shape, obs_dtype)
+        self.capacity = capacity
+        self.num_envs = num_envs
+        self.alpha = alpha
+        self.n_step = n_step
+        self.gamma = gamma
+        self.state = per_init(self.spec, capacity, num_envs)
+        self._add = jax.jit(per_add, donate_argnums=0)
+        # alpha/beta are *traced* args: beta follows a per-step schedule and
+        # making it static would recompile the sampler on every train step
+        self._sample = jax.jit(
+            per_sample, static_argnames=("batch_size", "n_step", "gamma")
+        )
+        self._update = jax.jit(per_update_priorities, donate_argnums=0)
+
+    def __len__(self) -> int:
+        return int(self.state.replay.size) * self.num_envs
+
+    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
+        step = {
+            "obs": jnp.asarray(obs),
+            "next_obs": jnp.asarray(next_obs),
+            "action": jnp.asarray(action),
+            "reward": jnp.asarray(reward),
+            "done": jnp.asarray(done),
+        }
+        for k, v in step.items():
+            want = (self.num_envs,) + tuple(self.spec[k][0])
+            if v.shape != want:
+                step[k] = v.reshape(want)
+        self.state = self._add(self.state, step)
+
+    def sample(self, batch_size: int, beta: float = 0.4, key: Optional[jax.Array] = None):
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        return self._sample(
+            self.state,
+            key,
+            batch_size=batch_size,
+            alpha=jnp.float32(self.alpha),
+            beta=jnp.float32(beta),
+            n_step=self.n_step,
+            gamma=self.gamma,
+        )
+
+    def update_priorities(self, indices, priorities) -> None:
+        self.state = self._update(
+            self.state, jnp.asarray(indices), jnp.asarray(priorities, jnp.float32)
+        )

@@ -1,0 +1,236 @@
+"""Uniform + n-step experience replay as a static-shape HBM ring buffer.
+
+Capability parity with the reference's ``ReplayBuffer`` /
+``MultiStepReplayBuffer`` (``scalerl/data/replay_buffer.py:10-273``),
+re-designed for XLA:
+
+- Storage is a pytree of ``[capacity, num_envs, ...]`` arrays living in HBM
+  (the reference keeps a Python ``deque`` of numpy tuples on the host and
+  pays a host->device copy per learner batch).
+- ``add`` writes one vector-env step with modular indexing
+  (``lax.rem``-style ring semantics); ``sample`` gathers on device.
+- The n-step fold that ``MultiStepReplayBuffer._get_n_step_info``
+  (``replay_buffer.py:230-273``) performs incrementally with per-env deques
+  happens at *sample time* as a static unrolled fold over the gathered
+  ``[B, n]`` window — no separate accumulator state, no host math.
+
+Everything is a pure function over an explicit ``ReplayState`` so it can sit
+inside jit/pjit; the ``ReplayBuffer`` class is a thin host-side convenience
+wrapper holding the state and jitted methods.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# name -> (per-env trailing shape, dtype)
+Spec = Mapping[str, Tuple[Tuple[int, ...], jnp.dtype]]
+
+
+def transition_spec(
+    obs_shape: Tuple[int, ...],
+    obs_dtype: jnp.dtype = jnp.float32,
+    action_dtype: jnp.dtype = jnp.int32,
+    action_shape: Tuple[int, ...] = (),
+) -> Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]:
+    """The standard (obs, next_obs, action, reward, done) transition layout."""
+    return {
+        "obs": (tuple(obs_shape), obs_dtype),
+        "next_obs": (tuple(obs_shape), obs_dtype),
+        "action": (tuple(action_shape), action_dtype),
+        "reward": ((), jnp.float32),
+        "done": ((), jnp.bool_),
+    }
+
+
+@struct.dataclass
+class ReplayState:
+    storage: Dict[str, jnp.ndarray]  # each [capacity, num_envs, ...]
+    pos: jnp.ndarray  # int32 scalar: next write row
+    size: jnp.ndarray  # int32 scalar: number of valid rows
+
+
+def replay_init(spec: Spec, capacity: int, num_envs: int) -> ReplayState:
+    storage = {
+        name: jnp.zeros((capacity, num_envs) + tuple(shape), dtype)
+        for name, (shape, dtype) in spec.items()
+    }
+    return ReplayState(
+        storage=storage,
+        pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
+def replay_add(state: ReplayState, step: Mapping[str, jnp.ndarray]) -> ReplayState:
+    """Write one vector step (each field ``[num_envs, ...]``) at the head."""
+    capacity = next(iter(state.storage.values())).shape[0]
+    storage = {
+        name: arr.at[state.pos].set(step[name].astype(arr.dtype))
+        for name, arr in state.storage.items()
+    }
+    return ReplayState(
+        storage=storage,
+        pos=(state.pos + 1) % capacity,
+        size=jnp.minimum(state.size + 1, capacity),
+    )
+
+
+def replay_add_chunk(state: ReplayState, chunk: Mapping[str, jnp.ndarray]) -> ReplayState:
+    """Write a ``[T, num_envs, ...]`` chunk via a scan of single-step adds."""
+
+    def body(s, step):
+        return replay_add(s, step), None
+
+    state, _ = jax.lax.scan(body, state, dict(chunk))
+    return state
+
+
+def _logical_start(state: ReplayState, capacity: int) -> jnp.ndarray:
+    """Physical row of the logically-oldest entry."""
+    return jnp.where(state.size == capacity, state.pos, 0)
+
+
+def _gather_window(
+    arr: jnp.ndarray, rows: jnp.ndarray, envs: jnp.ndarray
+) -> jnp.ndarray:
+    """arr[rows, envs] for ``[B]`` (or ``[B, n]``) row/env index arrays."""
+    return arr[rows, envs]
+
+
+def n_step_fold(
+    rewards: jnp.ndarray,  # [B, n]
+    dones: jnp.ndarray,  # [B, n] bool
+    gamma: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fold an n-step window into (reward, done, last_index).
+
+    The reward at the first done step is included; steps after it are masked
+    (exactly ``MultiStepReplayBuffer._get_n_step_info``,
+    ``replay_buffer.py:230-273``).  ``last_index`` is the offset whose
+    ``next_obs`` bootstraps the return (first done, else n-1).
+    """
+    n = rewards.shape[1]
+    donesf = dones.astype(rewards.dtype)
+    # alive[:, k] = survived steps 0..k-1
+    alive = jnp.cumprod(1.0 - donesf, axis=1)
+    alive = jnp.concatenate([jnp.ones_like(alive[:, :1]), alive[:, :-1]], axis=1)
+    gammas = gamma ** jnp.arange(n, dtype=rewards.dtype)
+    reward = jnp.sum(rewards * alive * gammas[None, :], axis=1)
+    any_done = jnp.any(dones, axis=1)
+    first_done = jnp.argmax(dones, axis=1)
+    last_index = jnp.where(any_done, first_done, n - 1)
+    return reward, any_done, last_index
+
+
+def gather_transitions(
+    state: ReplayState,
+    logical: jnp.ndarray,  # [B] logical row indices (0 = oldest)
+    envs: jnp.ndarray,  # [B] env column indices
+    n_step: int = 1,
+    gamma: float = 0.99,
+) -> Dict[str, jnp.ndarray]:
+    """Gather (possibly n-step) transitions at given logical (row, env) pairs."""
+    capacity, num_envs = next(iter(state.storage.values())).shape[:2]
+    start = _logical_start(state, capacity)
+    offs = jnp.arange(n_step)
+    rows = (start + logical[:, None] + offs[None, :]) % capacity  # [B, n]
+    rewards = _gather_window(state.storage["reward"], rows, envs[:, None])
+    dones = _gather_window(state.storage["done"], rows, envs[:, None])
+    reward_n, done_n, last_idx = n_step_fold(rewards, dones, gamma)
+
+    row0 = rows[:, 0]
+    row_last = jnp.take_along_axis(rows, last_idx[:, None], axis=1)[:, 0]
+    return {
+        "obs": state.storage["obs"][row0, envs],
+        "action": state.storage["action"][row0, envs],
+        "reward": reward_n,
+        "next_obs": state.storage["next_obs"][row_last, envs],
+        "done": done_n,
+        "n_steps": (last_idx + 1).astype(jnp.int32),
+        "indices": logical * num_envs + envs,  # flat logical index
+    }
+
+
+def replay_sample(
+    state: ReplayState,
+    key: jax.Array,
+    batch_size: int,
+    n_step: int = 1,
+    gamma: float = 0.99,
+) -> Dict[str, jnp.ndarray]:
+    """Uniformly sample ``batch_size`` (possibly n-step) transitions on device.
+
+    Returns fields obs/action/reward/next_obs/done (+``indices`` of the
+    logical (row, env) pair for PER-style callers).
+    """
+    num_envs = next(iter(state.storage.values())).shape[1]
+    # valid logical rows leave room for the n-step window
+    max_l = jnp.maximum(state.size - n_step, 1)
+    k1, k2 = jax.random.split(key)
+    logical = jax.random.randint(k1, (batch_size,), 0, max_l)
+    envs = jax.random.randint(k2, (batch_size,), 0, num_envs)
+    return gather_transitions(state, logical, envs, n_step, gamma)
+
+
+class ReplayBuffer:
+    """Host-side convenience wrapper mirroring the reference's buffer API
+    (``save_to_memory`` / ``sample``, ``replay_buffer.py:77-129``)."""
+
+    def __init__(
+        self,
+        obs_shape: Tuple[int, ...],
+        capacity: int,
+        num_envs: int = 1,
+        obs_dtype: jnp.dtype = jnp.float32,
+        n_step: int = 1,
+        gamma: float = 0.99,
+        device: Optional[jax.Device] = None,
+    ) -> None:
+        self.spec = transition_spec(obs_shape, obs_dtype)
+        self.capacity = capacity
+        self.num_envs = num_envs
+        self.n_step = n_step
+        self.gamma = gamma
+        self.state = replay_init(self.spec, capacity, num_envs)
+        if device is not None:
+            self.state = jax.device_put(self.state, device)
+        self._add = jax.jit(replay_add, donate_argnums=0)
+        self._sample = jax.jit(
+            replay_sample, static_argnames=("batch_size", "n_step", "gamma")
+        )
+
+    def __len__(self) -> int:
+        return int(self.state.size) * self.num_envs
+
+    @property
+    def num_transitions(self) -> int:
+        return len(self)
+
+    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
+        """Add one vector step (accepts numpy or jax arrays; [num_envs, ...])."""
+        step = {
+            "obs": jnp.atleast_1d(jnp.asarray(obs)),
+            "next_obs": jnp.atleast_1d(jnp.asarray(next_obs)),
+            "action": jnp.atleast_1d(jnp.asarray(action)),
+            "reward": jnp.atleast_1d(jnp.asarray(reward)),
+            "done": jnp.atleast_1d(jnp.asarray(done)),
+        }
+        # allow single-env calls without the env axis
+        for k, v in step.items():
+            want = (self.num_envs,) + tuple(self.spec[k][0])
+            if v.shape != want:
+                step[k] = v.reshape(want)
+        self.state = self._add(self.state, step)
+
+    def sample(self, batch_size: int, key: Optional[jax.Array] = None) -> Dict[str, jnp.ndarray]:
+        if key is None:
+            key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        return self._sample(
+            self.state, key, batch_size=batch_size, n_step=self.n_step, gamma=self.gamma
+        )

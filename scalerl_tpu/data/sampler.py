@@ -1,0 +1,75 @@
+"""One ``sample()`` facade over the replay variants.
+
+Parity target: ``Sampler`` (``scalerl/data/sampler.py:10-72``), which selects
+standard / PER / n-step / distributed-DataLoader sampling at construction.
+The TPU equivalent of the "distributed DataLoader" path (sharded sampling
+feeding DDP ranks, ``data/replay_data.py:8-26``) is per-host independent
+sampling feeding a pjit'd learner — each host samples its local buffer and
+the mesh shards the batch axis — so it needs no special case here beyond
+each host constructing its own Sampler.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.data.prioritized import PrioritizedReplayBuffer
+from scalerl_tpu.data.replay import ReplayBuffer
+
+
+class Sampler:
+    def __init__(
+        self,
+        obs_shape: Tuple[int, ...],
+        capacity: int,
+        num_envs: int = 1,
+        obs_dtype: jnp.dtype = jnp.float32,
+        use_per: bool = False,
+        per_alpha: float = 0.6,
+        n_step: int = 1,
+        gamma: float = 0.99,
+    ) -> None:
+        self.use_per = use_per
+        self.n_step = n_step
+        if use_per:
+            self.buffer = PrioritizedReplayBuffer(
+                obs_shape,
+                capacity,
+                num_envs=num_envs,
+                obs_dtype=obs_dtype,
+                alpha=per_alpha,
+                n_step=n_step,
+                gamma=gamma,
+            )
+        else:
+            self.buffer = ReplayBuffer(
+                obs_shape,
+                capacity,
+                num_envs=num_envs,
+                obs_dtype=obs_dtype,
+                n_step=n_step,
+                gamma=gamma,
+            )
+
+    def __len__(self) -> int:
+        return len(self.buffer)
+
+    def add(self, obs, next_obs, action, reward, done) -> None:
+        self.buffer.save_to_memory(obs, next_obs, action, reward, done)
+
+    def sample(
+        self,
+        batch_size: int,
+        beta: float = 0.4,
+        key: Optional[jax.Array] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        if self.use_per:
+            return self.buffer.sample(batch_size, beta=beta, key=key)
+        return self.buffer.sample(batch_size, key=key)
+
+    def update_priorities(self, indices, priorities) -> None:
+        if self.use_per:
+            self.buffer.update_priorities(indices, priorities)

@@ -1,0 +1,133 @@
+"""The universal time-major trajectory format: ``[T+1, B, ...]``.
+
+SURVEY.md §7 adopts the reference IMPALA buffer layout
+(``impala_atari.py:122-151``: per-buffer ``{obs, reward, done, action,
+logits, baseline}`` tensors of length T+1, plus an initial RNN-state pool at
+``:108-120``) as the single trajectory format for every actor-learner
+algorithm, replacing the reference's variable-length episode lists
+(``parallel_dqn.py:233-255``) which cannot have static shapes.
+
+``Trajectory`` is a pytree (flax.struct), so a whole rollout chunk moves
+host<->device as one transfer and threads through jit/pjit/scan unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class Trajectory:
+    """One rollout chunk, time-major ``[T+1, B, ...]``.
+
+    Row convention (matches the reference's env_output layout, where the
+    stored action/reward are *model inputs* at each row,
+    ``impala_atari.py:186-205`` + ``utils/atari_model.py`` last-action feed):
+
+    - ``obs[t]``: observation at step t.
+    - ``action[t]``: the action that *led to* ``obs[t]`` (last-action
+      semantics; ``action[0]`` carries in from the previous chunk).  The
+      action *taken at* ``obs[t]`` is therefore ``action[t+1]``.
+    - ``reward[t]`` / ``done[t]``: consequences of ``action[t]`` (i.e. of the
+      step into ``obs[t]``); both are model inputs at row t.
+    - ``logits[t]``: behavior-policy logits at ``obs[t]`` (V-trace input).
+    - ``core_state``: recurrent state entering row 0 (empty for FF models).
+
+    So the T valid transitions are
+    ``(obs[t], action[t+1]) -> reward[t+1], done[t+1], obs[t+1]``.
+    """
+
+    obs: jnp.ndarray
+    action: jnp.ndarray
+    reward: jnp.ndarray
+    done: jnp.ndarray
+    logits: jnp.ndarray
+    core_state: Any = ()
+
+    @property
+    def unroll_length(self) -> int:
+        return self.obs.shape[0] - 1
+
+    @property
+    def batch_size(self) -> int:
+        return self.obs.shape[1]
+
+
+@dataclass(frozen=True)
+class TrajectorySpec:
+    """Static description of a trajectory chunk; builds zero pytrees and
+    host staging buffers."""
+
+    unroll_length: int  # T
+    batch_size: int  # B
+    obs_shape: Tuple[int, ...]
+    num_actions: int
+    obs_dtype: Any = jnp.uint8
+    core_state_shapes: Tuple[Tuple[int, ...], ...] = ()  # per-leaf [B,...] shapes
+
+    def zeros(self) -> Trajectory:
+        T1 = self.unroll_length + 1
+        B = self.batch_size
+        return Trajectory(
+            obs=jnp.zeros((T1, B) + tuple(self.obs_shape), self.obs_dtype),
+            action=jnp.zeros((T1, B), jnp.int32),
+            reward=jnp.zeros((T1, B), jnp.float32),
+            done=jnp.ones((T1, B), jnp.bool_),
+            logits=jnp.zeros((T1, B, self.num_actions), jnp.float32),
+            core_state=tuple(
+                (jnp.zeros(s, jnp.float32), jnp.zeros(s, jnp.float32))
+                for s in self.core_state_shapes
+            ),
+        )
+
+    def host_zeros(self) -> Dict[str, np.ndarray]:
+        """Numpy staging buffers (one rollout slot) for the host actor plane.
+
+        Recurrent core-state leaves are flat ``core_{i}_{c|h}`` keys with a
+        leading batch axis (they describe row 0 only, so no time axis);
+        ``RolloutQueue.get_batch`` concatenates them on axis 0 while the
+        time-major fields concatenate on axis 1.
+        """
+        T1 = self.unroll_length + 1
+        B = self.batch_size
+        out = {
+            "obs": np.zeros((T1, B) + tuple(self.obs_shape), np.dtype(jnp.dtype(self.obs_dtype).name)),
+            "action": np.zeros((T1, B), np.int32),
+            "reward": np.zeros((T1, B), np.float32),
+            "done": np.ones((T1, B), bool),
+            "logits": np.zeros((T1, B, self.num_actions), np.float32),
+        }
+        for i, s in enumerate(self.core_state_shapes):
+            out[f"core_{i}_c"] = np.zeros(s, np.float32)
+            out[f"core_{i}_h"] = np.zeros(s, np.float32)
+        return out
+
+
+def batch_to_trajectory(batch: Dict[str, np.ndarray]) -> Trajectory:
+    """Assemble a host batch dict (RolloutQueue output) into a Trajectory."""
+    core = []
+    i = 0
+    while f"core_{i}_c" in batch:
+        core.append((jnp.asarray(batch[f"core_{i}_c"]), jnp.asarray(batch[f"core_{i}_h"])))
+        i += 1
+    return Trajectory(
+        obs=jnp.asarray(batch["obs"]),
+        action=jnp.asarray(batch["action"]),
+        reward=jnp.asarray(batch["reward"]),
+        done=jnp.asarray(batch["done"]),
+        logits=jnp.asarray(batch["logits"]),
+        core_state=tuple(core),
+    )
+
+
+def stack_trajectories(trajs: list) -> Trajectory:
+    """Stack single-env trajectories along the batch axis (device-side concat),
+    the equivalent of the reference learner's ``torch.stack(dim=1)`` batching
+    (``impala_atari.py:246-252``)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=1), *trajs)

@@ -1,0 +1,7 @@
+from scalerl_tpu.envs.gym_env import make_gym_env, make_vect_envs  # noqa: F401
+from scalerl_tpu.envs.jax_envs import (  # noqa: F401
+    JaxCartPole,
+    JaxVecEnv,
+    SyntheticPixelEnv,
+    make_jax_vec_env,
+)

@@ -1,0 +1,224 @@
+"""DeepMind-style Atari preprocessing stack (gymnasium 5-tuple API).
+
+Parity target: ``scalerl/envs/atari_wrapper.py:19-311`` (NoopReset(30),
+MaxAndSkip(4), EpisodicLife, FireReset, WarpFrame 84x84 gray, ScaledFloat,
+ClipReward(sign), FrameStack(4)) and the A3C 42x42 variant
+(``scalerl/algorithms/a3c/utils/atari_env.py:9-122``), folded into one
+module (SURVEY.md §2.2 prescribes merging the two preprocessing stacks).
+
+TPU note: the default output is **channel-last uint8** ``[H, W, stack]``
+(not the reference's float CHW) so the host->device infeed moves 4x fewer
+bytes and matches XLA's preferred NHWC conv layout; scaling to [0, 1]
+happens on device inside the model (``models/atari.py``).  Requires ale_py
+for actual Atari ROMs — absent here, the stack is still exercised via
+synthetic envs in tests.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+import gymnasium as gym
+import numpy as np
+
+try:
+    import cv2
+
+    cv2.ocl.setUseOpenCL(False)
+except ImportError:  # pragma: no cover
+    cv2 = None
+
+
+class NoopResetEnv(gym.Wrapper):
+    """Sample 1..noop_max no-op steps at reset (``atari_wrapper.py:19-49``)."""
+
+    def __init__(self, env: gym.Env, noop_max: int = 30) -> None:
+        super().__init__(env)
+        self.noop_max = noop_max
+        self.noop_action = 0
+        assert env.unwrapped.get_action_meanings()[0] == "NOOP"
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        noops = self.unwrapped.np_random.integers(1, self.noop_max + 1)
+        for _ in range(noops):
+            obs, _, terminated, truncated, info = self.env.step(self.noop_action)
+            if terminated or truncated:
+                obs, info = self.env.reset(**kwargs)
+        return obs, info
+
+
+class MaxAndSkipEnv(gym.Wrapper):
+    """Repeat action ``skip`` times; observe max of last two frames."""
+
+    def __init__(self, env: gym.Env, skip: int = 4) -> None:
+        super().__init__(env)
+        self._obs_buffer = np.zeros((2,) + env.observation_space.shape, dtype=np.uint8)
+        self._skip = skip
+
+    def step(self, action):
+        total_reward = 0.0
+        terminated = truncated = False
+        info = {}
+        for i in range(self._skip):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            if i == self._skip - 2:
+                self._obs_buffer[0] = obs
+            if i == self._skip - 1:
+                self._obs_buffer[1] = obs
+            total_reward += float(reward)
+            if terminated or truncated:
+                break
+        max_frame = self._obs_buffer.max(axis=0)
+        return max_frame, total_reward, terminated, truncated, info
+
+
+class EpisodicLifeEnv(gym.Wrapper):
+    """End episode on life loss; only truly reset when the game is over."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        self.lives = 0
+        self.was_real_done = True
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self.was_real_done = terminated or truncated
+        lives = self.env.unwrapped.ale.lives()
+        if 0 < lives < self.lives:
+            terminated = True
+        self.lives = lives
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, **kwargs):
+        if self.was_real_done:
+            obs, info = self.env.reset(**kwargs)
+        else:
+            obs, _, terminated, truncated, info = self.env.step(0)
+            if terminated or truncated:
+                obs, info = self.env.reset(**kwargs)
+        self.lives = self.env.unwrapped.ale.lives()
+        return obs, info
+
+
+class FireResetEnv(gym.Wrapper):
+    """Press FIRE at reset for envs that need it to start."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        assert env.unwrapped.get_action_meanings()[1] == "FIRE"
+        assert len(env.unwrapped.get_action_meanings()) >= 3
+
+    def reset(self, **kwargs):
+        self.env.reset(**kwargs)
+        obs, _, terminated, truncated, _ = self.env.step(1)
+        if terminated or truncated:
+            self.env.reset(**kwargs)
+        obs, _, terminated, truncated, _ = self.env.step(2)
+        if terminated or truncated:
+            self.env.reset(**kwargs)
+        return obs, {}
+
+
+class WarpFrame(gym.ObservationWrapper):
+    """Grayscale + resize to ``size`` x ``size`` (84 DeepMind / 42 A3C)."""
+
+    def __init__(self, env: gym.Env, size: int = 84) -> None:
+        super().__init__(env)
+        if cv2 is None:  # pragma: no cover
+            raise ImportError("WarpFrame requires opencv-python")
+        self.size = size
+        self.observation_space = gym.spaces.Box(
+            low=0, high=255, shape=(size, size, 1), dtype=np.uint8
+        )
+
+    def observation(self, frame):
+        frame = cv2.cvtColor(frame, cv2.COLOR_RGB2GRAY)
+        frame = cv2.resize(frame, (self.size, self.size), interpolation=cv2.INTER_AREA)
+        return frame[:, :, None]
+
+
+class ScaledFloatFrame(gym.ObservationWrapper):
+    """uint8 -> [0,1] float32.  NOT in the default stack: scaling happens on
+    device (``models/atari.py``) to keep infeed uint8."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        self.observation_space = gym.spaces.Box(
+            low=0.0, high=1.0, shape=env.observation_space.shape, dtype=np.float32
+        )
+
+    def observation(self, obs):
+        return np.asarray(obs, dtype=np.float32) / 255.0
+
+
+class ClipRewardEnv(gym.RewardWrapper):
+    """Reward -> sign(reward)."""
+
+    def reward(self, reward):
+        return float(np.sign(reward))
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``k`` frames along the channel axis (channel-last)."""
+
+    def __init__(self, env: gym.Env, k: int = 4) -> None:
+        super().__init__(env)
+        self.k = k
+        self.frames: deque = deque([], maxlen=k)
+        shp = env.observation_space.shape
+        assert len(shp) == 3, "FrameStack expects [H, W, C] observations"
+        self.observation_space = gym.spaces.Box(
+            low=0, high=255, shape=(shp[0], shp[1], shp[2] * k), dtype=env.observation_space.dtype
+        )
+
+    def reset(self, **kwargs):
+        obs, info = self.env.reset(**kwargs)
+        for _ in range(self.k):
+            self.frames.append(obs)
+        return self._get_obs(), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self.frames.append(obs)
+        return self._get_obs(), reward, terminated, truncated, info
+
+    def _get_obs(self):
+        assert len(self.frames) == self.k
+        return np.concatenate(list(self.frames), axis=-1)
+
+
+def wrap_deepmind(
+    env: gym.Env,
+    episode_life: bool = True,
+    clip_rewards: bool = True,
+    frame_stack: int = 4,
+    scale: bool = False,
+    warp_size: int = 84,
+    noop_max: int = 30,
+    skip: int = 4,
+) -> gym.Env:
+    """The full DeepMind stack (``atari_wrapper.py:277-311`` parity)."""
+    env = NoopResetEnv(env, noop_max=noop_max)
+    env = MaxAndSkipEnv(env, skip=skip)
+    if episode_life:
+        env = EpisodicLifeEnv(env)
+    if "FIRE" in env.unwrapped.get_action_meanings():
+        env = FireResetEnv(env)
+    env = WarpFrame(env, size=warp_size)
+    if scale:
+        env = ScaledFloatFrame(env)
+    if clip_rewards:
+        env = ClipRewardEnv(env)
+    if frame_stack > 1:
+        env = FrameStack(env, frame_stack)
+    return env
+
+
+def make_atari_env(env_id: str, seed: int = 42, **wrap_kwargs) -> gym.Env:
+    """gym.make + full DeepMind preprocessing (requires ale_py)."""
+    env = gym.make(env_id)
+    env = wrap_deepmind(env, **wrap_kwargs)
+    env.action_space.seed(seed)
+    return env

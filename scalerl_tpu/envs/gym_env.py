@@ -1,0 +1,87 @@
+"""Host-side Gymnasium environment factories.
+
+Parity targets: ``make_gym_env`` (``scalerl/envs/gym_env.py:6-33``) and
+``make_vect_envs`` / ``make_multi_agent_vect_envs``
+(``scalerl/envs/env_utils.py:85-120``).  The vector path uses gymnasium's
+``AsyncVectorEnv`` with shared-memory observations — one subprocess per env
+writing into a shared plane, which is exactly the staging buffer a TPU
+infeed wants (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import gymnasium as gym
+
+
+def make_gym_env(
+    env_id: str,
+    seed: int = 42,
+    idx: int = 0,
+    capture_video: bool = False,
+    video_dir: Optional[str] = None,
+    atari: bool = False,
+    **env_kwargs,
+) -> Callable[[], gym.Env]:
+    """Return a thunk building one env (thunks are what vector ctors want)."""
+
+    def thunk() -> gym.Env:
+        render_mode = "rgb_array" if (capture_video and idx == 0) else None
+        env = gym.make(env_id, render_mode=render_mode, **env_kwargs)
+        if capture_video and idx == 0 and video_dir is not None:
+            env = gym.wrappers.RecordVideo(env, video_dir)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if atari:
+            from scalerl_tpu.envs.atari import wrap_deepmind
+
+            env = wrap_deepmind(env)
+        env.action_space.seed(seed + idx)
+        return env
+
+    return thunk
+
+
+def make_vect_envs(
+    env_id: str,
+    num_envs: int = 1,
+    seed: int = 42,
+    async_envs: bool = True,
+    capture_video: bool = False,
+    video_dir: Optional[str] = None,
+    atari: bool = False,
+    **env_kwargs,
+) -> gym.vector.VectorEnv:
+    """Vectorized env pool; async uses subprocess workers + shared memory."""
+    thunks = [
+        make_gym_env(
+            env_id,
+            seed=seed,
+            idx=i,
+            capture_video=capture_video,
+            video_dir=video_dir,
+            atari=atari,
+            **env_kwargs,
+        )
+        for i in range(num_envs)
+    ]
+    # SAME_STEP autoreset: on done, step() returns the reset obs and stashes
+    # the true terminal obs in infos["final_obs"] — the classic-gym semantics
+    # the reference's replay path assumes (store next_obs = final_obs on done).
+    mode = gym.vector.AutoresetMode.SAME_STEP
+    if async_envs and num_envs > 1:
+        return gym.vector.AsyncVectorEnv(thunks, shared_memory=True, autoreset_mode=mode)
+    return gym.vector.SyncVectorEnv(thunks, autoreset_mode=mode)
+
+
+def make_multi_agent_vect_envs(
+    env_fn: Callable,
+    num_envs: int = 1,
+    **env_kwargs,
+):
+    """PettingZoo parallel-env pool (``env_utils.py:97-120`` parity)."""
+    from scalerl_tpu.envs.vector.pz_async_vec_env import AsyncPettingZooVecEnv
+
+    env_fns = [partial(env_fn, **env_kwargs) for _ in range(num_envs)]
+    return AsyncPettingZooVecEnv(env_fns)

@@ -1,0 +1,94 @@
+"""Device-native environments: pure-functional, vmappable, jittable.
+
+The reference has no analog — its envs are CPU subprocesses feeding a GPU
+learner.  On TPU, simple env dynamics can run *on device*, fusing the whole
+act->step->learn loop into one XLA program with zero host round-trips; this
+is how the synthetic throughput benches drive the learner at full speed and
+how CartPole-class tasks train end-to-end on-chip.
+
+Protocol (gymnax-flavored, deliberately minimal):
+
+- ``env.reset(key) -> (state, obs)``
+- ``env.step(state, action, key) -> (state, obs, reward, done)`` with
+  **auto-reset**: when an episode ends, the returned state/obs are already
+  reset (done flags the boundary), so fixed-shape rollouts never branch.
+
+``JaxVecEnv`` lifts a single env over a batch axis with ``vmap`` and manages
+keys; everything stays pure so it nests under jit/pjit/scan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+State = Any
+
+
+class JaxEnv:
+    """Interface for device-native envs (subclass and implement the pure fns)."""
+
+    @property
+    def observation_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    @property
+    def observation_dtype(self):
+        return jnp.float32
+
+    @property
+    def num_actions(self) -> int:
+        raise NotImplementedError
+
+    def reset(self, key: jax.Array) -> Tuple[State, jnp.ndarray]:
+        raise NotImplementedError
+
+    def step(
+        self, state: State, action: jnp.ndarray, key: jax.Array
+    ) -> Tuple[State, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+
+class JaxVecEnv:
+    """vmap-lifted batch of one ``JaxEnv``; still pure (state is explicit)."""
+
+    def __init__(self, env: JaxEnv, num_envs: int) -> None:
+        self.env = env
+        self.num_envs = num_envs
+        self._reset = jax.vmap(env.reset)
+        self._step = jax.vmap(env.step)
+
+    @property
+    def observation_shape(self) -> Tuple[int, ...]:
+        return self.env.observation_shape
+
+    @property
+    def num_actions(self) -> int:
+        return self.env.num_actions
+
+    def reset(self, key: jax.Array):
+        keys = jax.random.split(key, self.num_envs)
+        return self._reset(keys)
+
+    def step(self, state, action: jnp.ndarray, key: jax.Array):
+        keys = jax.random.split(key, self.num_envs)
+        return self._step(state, action, keys)
+
+
+def make_jax_vec_env(env_id: str, num_envs: int, **kwargs) -> JaxVecEnv:
+    from scalerl_tpu.envs.jax_envs.cartpole import JaxCartPole
+    from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
+
+    registry = {
+        "CartPole-v1": lambda: JaxCartPole(max_steps=500),
+        "CartPole-v0": lambda: JaxCartPole(max_steps=200),
+        "SyntheticPixel-v0": lambda: SyntheticPixelEnv(**kwargs),
+    }
+    if env_id not in registry:
+        raise KeyError(
+            f"unknown jax env {env_id!r}; available: {sorted(registry)} "
+            "(use env_backend='gym' for host envs)"
+        )
+    return JaxVecEnv(registry[env_id](), num_envs)

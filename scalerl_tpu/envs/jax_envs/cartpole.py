@@ -1,0 +1,90 @@
+"""Pure-JAX CartPole with the classic Gym dynamics and auto-reset.
+
+Matches gymnasium's CartPole-v1 physics (gravity 9.8, masscart 1.0, masspole
+0.1, pole half-length 0.5, force 10, tau 0.02, Euler integration; terminate
+at |x| > 2.4 or |theta| > 12 deg; reward 1 per step; truncate at max_steps),
+so policies trained here transfer to the host env for evaluation parity with
+``examples/test_dqn.py``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.envs.jax_envs.base import JaxEnv
+
+
+class CartPoleState(NamedTuple):
+    x: jnp.ndarray
+    x_dot: jnp.ndarray
+    theta: jnp.ndarray
+    theta_dot: jnp.ndarray
+    t: jnp.ndarray  # step counter
+
+
+class JaxCartPole(JaxEnv):
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+    X_LIMIT = 2.4
+
+    def __init__(self, max_steps: int = 500) -> None:
+        self.max_steps = max_steps
+
+    @property
+    def observation_shape(self) -> Tuple[int, ...]:
+        return (4,)
+
+    @property
+    def num_actions(self) -> int:
+        return 2
+
+    def _obs(self, s: CartPoleState) -> jnp.ndarray:
+        return jnp.stack([s.x, s.x_dot, s.theta, s.theta_dot]).astype(jnp.float32)
+
+    def reset(self, key: jax.Array):
+        vals = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = CartPoleState(vals[0], vals[1], vals[2], vals[3], jnp.zeros((), jnp.int32))
+        return state, self._obs(state)
+
+    def step(self, state: CartPoleState, action: jnp.ndarray, key: jax.Array):
+        force = jnp.where(action == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = jnp.cos(state.theta)
+        sintheta = jnp.sin(state.theta)
+        temp = (
+            force + self.POLEMASS_LENGTH * state.theta_dot**2 * sintheta
+        ) / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        )
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+
+        x = state.x + self.TAU * state.x_dot
+        x_dot = state.x_dot + self.TAU * xacc
+        theta = state.theta + self.TAU * state.theta_dot
+        theta_dot = state.theta_dot + self.TAU * thetaacc
+        t = state.t + 1
+
+        terminated = (
+            (jnp.abs(x) > self.X_LIMIT) | (jnp.abs(theta) > self.THETA_LIMIT)
+        )
+        truncated = t >= self.max_steps
+        done = terminated | truncated
+
+        stepped = CartPoleState(x, x_dot, theta, theta_dot, t)
+        reset_state, reset_obs = self.reset(key)
+        # auto-reset: where done, return the freshly-reset state/obs
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, a, b), reset_state, stepped
+        )
+        obs = jnp.where(done, reset_obs, self._obs(stepped))
+        return new_state, obs, jnp.ones((), jnp.float32), done

@@ -1,0 +1,50 @@
+"""A uniform recurrent-policy interface over vector and pixel models.
+
+Every actor-learner algorithm in this framework drives a model through one
+signature::
+
+    (params, obs[T,B,...], last_action[T,B], reward[T,B], done[T,B],
+     core_state) -> (AtariNetOutput(policy_logits, baseline), core_state)
+
+``AtariNet`` (``models/atari.py``) implements it for pixels;
+``MLPPolicyNet`` here implements it for flat observations (the reference's
+``ActorCriticNet`` capability, ``algorithms/utils/network.py:70-95``, lifted
+to the time-major recurrent signature so IMPALA/A2C code paths are
+model-agnostic).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from scalerl_tpu.models.atari import AtariNetOutput, LSTMState
+
+
+class MLPPolicyNet(nn.Module):
+    """Feed-forward actor-critic on flat obs with the recurrent signature."""
+
+    num_actions: int
+    hidden_sizes: Sequence[int] = (256, 256)
+
+    def initial_state(self, batch_size: int) -> LSTMState:
+        return ()
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jnp.ndarray,  # [T, B, D]
+        last_action: jnp.ndarray,  # [T, B] (unused: no action feedback in MLP)
+        reward: jnp.ndarray,  # [T, B] (unused)
+        done: jnp.ndarray,  # [T, B] (unused: feed-forward)
+        core_state: LSTMState = (),
+    ) -> Tuple[AtariNetOutput, LSTMState]:
+        del last_action, reward, done
+        x = obs.astype(jnp.float32)
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h)(x))
+        logits = nn.Dense(self.num_actions, name="policy")(x)
+        baseline = nn.Dense(1, name="baseline")(x).squeeze(-1)
+        return AtariNetOutput(policy_logits=logits, baseline=baseline), core_state

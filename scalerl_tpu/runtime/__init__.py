@@ -1,0 +1,3 @@
+from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop  # noqa: F401
+from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
+from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401

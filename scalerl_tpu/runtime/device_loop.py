@@ -1,0 +1,172 @@
+"""Fully-fused on-device actor-learner loop (the flagship throughput path).
+
+Replaces the reference's process zoo — actor processes doing per-step CPU
+inference + queue hand-off + learner batching (``impala_atari.py:153-268``)
+— with ONE XLA program per training iteration: env step, policy forward,
+action sample, trajectory collection (``lax.scan`` over the unroll), V-trace
+learner update.  Multiple iterations are themselves ``lax.scan``-ed so the
+host dispatches once per ``iters_per_call`` updates — essential under the
+axon tunnel where each host->device dispatch costs ~50-100 ms, and the reason
+this path reaches orders of magnitude more env-frames/sec than the
+reference's architecture on the same chip count.
+
+Works with any ``JaxVecEnv`` (device-native env) and any model implementing
+the recurrent-policy signature (``models/policy.py``).  Within a fused
+iteration the behavior policy equals the target policy (V-trace rhos = 1,
+the on-policy special case); the *host* actor plane
+(``trainer/actor_learner.py``) exercises true off-policy lag.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.agents.impala import ImpalaTrainState
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+
+
+class ActorCarry(NamedTuple):
+    """Per-env actor state threaded across rollout chunks."""
+
+    env_state: Any
+    obs: jnp.ndarray  # [B, ...]
+    last_action: jnp.ndarray  # [B]
+    reward: jnp.ndarray  # [B]
+    done: jnp.ndarray  # [B]
+    core_state: Any  # model recurrent state
+    episode_return: jnp.ndarray  # [B] running return accumulator
+    return_sum: jnp.ndarray  # scalar: sum of completed-episode returns
+    episode_count: jnp.ndarray  # scalar: completed episodes
+
+
+class DeviceActorLearnerLoop:
+    def __init__(
+        self,
+        model,
+        venv: JaxVecEnv,
+        learn_fn: Callable[[ImpalaTrainState, Trajectory], Tuple[ImpalaTrainState, Dict]],
+        unroll_length: int,
+        iters_per_call: int = 10,
+    ) -> None:
+        self.model = model
+        self.venv = venv
+        self.learn_fn = learn_fn
+        self.unroll_length = unroll_length
+        self.iters_per_call = iters_per_call
+        self._train_many = jax.jit(
+            partial(self._train_many_impl), donate_argnums=(0, 1)
+        )
+
+    # ------------------------------------------------------------------
+    def init_carry(self, key: jax.Array) -> ActorCarry:
+        B = self.venv.num_envs
+        env_state, obs = self.venv.reset(key)
+        return ActorCarry(
+            env_state=env_state,
+            obs=obs,
+            last_action=jnp.zeros(B, jnp.int32),
+            reward=jnp.zeros(B, jnp.float32),
+            done=jnp.ones(B, jnp.bool_),
+            core_state=self.model.initial_state(B),
+            episode_return=jnp.zeros(B, jnp.float32),
+            return_sum=jnp.zeros((), jnp.float32),
+            episode_count=jnp.zeros((), jnp.float32),
+        )
+
+    # ------------------------------------------------------------------
+    def _unroll(self, params, carry: ActorCarry, key: jax.Array):
+        """Collect one [T+1, B] trajectory chunk; row T's logits are unused
+        by the learner (behavior_logits[:-1]) and left zero."""
+        core0 = carry.core_state
+
+        def step(c: ActorCarry, k):
+            out, new_core = self.model.apply(
+                params, c.obs[None], c.last_action[None], c.reward[None],
+                c.done[None], c.core_state,
+            )
+            logits = out.policy_logits[0]
+            k_act, k_env = jax.random.split(k)
+            action = jax.random.categorical(k_act, logits, axis=-1)
+            env_state, next_obs, reward, done = self.venv.step(
+                c.env_state, action, k_env
+            )
+            row = (c.obs, c.last_action, c.reward, c.done, logits)
+            ep_ret = c.episode_return + reward
+            new_c = ActorCarry(
+                env_state=env_state,
+                obs=next_obs,
+                last_action=action,
+                reward=reward,
+                done=done,
+                core_state=new_core,
+                episode_return=jnp.where(done, 0.0, ep_ret),
+                return_sum=c.return_sum + jnp.sum(jnp.where(done, ep_ret, 0.0)),
+                episode_count=c.episode_count + jnp.sum(done),
+            )
+            return new_c, row
+
+        keys = jax.random.split(key, self.unroll_length)
+        carry, rows = jax.lax.scan(step, carry, keys)
+        obs_rows, la_rows, rew_rows, done_rows, logit_rows = rows
+
+        # final row T from the post-scan carry (logits zero: unused)
+        traj = Trajectory(
+            obs=jnp.concatenate([obs_rows, carry.obs[None]], axis=0),
+            action=jnp.concatenate([la_rows, carry.last_action[None]], axis=0),
+            reward=jnp.concatenate([rew_rows, carry.reward[None]], axis=0),
+            done=jnp.concatenate([done_rows, carry.done[None]], axis=0),
+            logits=jnp.concatenate(
+                [logit_rows, jnp.zeros_like(logit_rows[:1])], axis=0
+            ),
+            core_state=core0,
+        )
+        return carry, traj
+
+    # ------------------------------------------------------------------
+    def _train_many_impl(self, state: ImpalaTrainState, carry: ActorCarry, key):
+        def one_iter(sc, k):
+            state, carry = sc
+            k_roll, _ = jax.random.split(k)
+            carry, traj = self._unroll(state.params, carry, k_roll)
+            state, metrics = self.learn_fn(state, traj)
+            return (state, carry), metrics
+
+        (state, carry), metrics = jax.lax.scan(
+            one_iter, (state, carry), jax.random.split(key, self.iters_per_call)
+        )
+        mean_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+        return state, carry, mean_metrics
+
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        state: ImpalaTrainState,
+        carry: ActorCarry,
+        key: jax.Array,
+        num_calls: int,
+        on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
+        """Drive ``num_calls`` fused mega-steps; one host dispatch each."""
+        metrics: Dict[str, float] = {}
+        for i in range(num_calls):
+            key, sub = jax.random.split(key)
+            state, carry, dev_metrics = self._train_many(state, carry, sub)
+            if on_metrics is not None:
+                metrics = {k: float(v) for k, v in dev_metrics.items()}
+                metrics["episodes"] = float(carry.episode_count)
+                metrics["return_mean"] = float(
+                    carry.return_sum / jnp.maximum(carry.episode_count, 1.0)
+                )
+                on_metrics(i, metrics)
+        jax.block_until_ready(state.params)
+        if not metrics:
+            metrics = {
+                "episodes": float(carry.episode_count),
+                "return_mean": float(carry.return_sum / max(float(carry.episode_count), 1.0)),
+            }
+        return state, carry, metrics

@@ -1,0 +1,51 @@
+"""Versioned parameter server for actor weight publication.
+
+Parity target: ``ParameterServer`` (``scalerl/hpc/parameter_server.py:4-33``)
+— a push/pull weight holder — upgraded with what the reference lacked:
+versioning (actors can skip a no-op pull), thread-safety (the reference had
+no locking), and zero-copy host snapshots (device->host fetch happens once
+per publish, not once per actor pull).  This is the "weight publication
+without stalls" design of SURVEY.md §7: the learner publishes a snapshot;
+actor pulls never block the train step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class ParameterServer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._version = 0
+        self._weights: Any = None
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def push(self, weights: Any, to_host: bool = True) -> int:
+        """Publish new weights; returns the new version.
+
+        With ``to_host=True`` the pytree is fetched to numpy once here, so N
+        actor pulls cost zero device traffic (SEED-style actors that run
+        device inference should push with ``to_host=False``).
+        """
+        if to_host:
+            weights = jax.tree_util.tree_map(np.asarray, weights)
+        with self._lock:
+            self._version += 1
+            self._weights = weights
+            return self._version
+
+    def pull(self, have_version: int = -1) -> Tuple[Optional[Any], int]:
+        """Return (weights, version), or (None, version) if caller is current."""
+        with self._lock:
+            if self._weights is None or have_version == self._version:
+                return None, self._version
+            return self._weights, self._version

@@ -1,0 +1,110 @@
+"""Free/full rollout-slot queue: the host side of the learner infeed.
+
+Parity target: the reference's shared-memory buffer pool cycled through
+``free_queue``/``full_queue`` (``impala_atari.py:122-151,416-437``): a fixed
+pool of trajectory slots; actors take a free index, fill the slot, put it on
+the full queue; the learner drains ``batch_size`` indices, stacks, and
+recycles them.
+
+TPU-shaped differences: slots are pinned *numpy* staging buffers (actors
+write with zero serialization), and ``get_batch`` assembles one contiguous
+time-major batch and ships it device-side in a single transfer — the
+reference instead moved per-slot torch tensors and stacked on the learner
+(``impala_atari.py:222-268``).  Worker-crash funneling mirrors the vec-env
+error plumbing (``pz_async_vec_env.py:467-488``): actors report exceptions
+via ``report_error`` and the learner re-raises on the next get.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scalerl_tpu.data.trajectory import TrajectorySpec
+
+
+class RolloutQueue:
+    def __init__(self, spec: TrajectorySpec, num_slots: int) -> None:
+        if num_slots < 2:
+            raise ValueError(f"num_slots must be >= 2, got {num_slots}")
+        self.spec = spec
+        self.num_slots = num_slots
+        self.slots: List[Dict[str, np.ndarray]] = [
+            spec.host_zeros() for _ in range(num_slots)
+        ]
+        self.free: "queue.Queue[int]" = queue.Queue()
+        self.full: "queue.Queue[int]" = queue.Queue()
+        for i in range(num_slots):
+            self.free.put(i)
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        self._closed = threading.Event()
+
+    # -- actor side ----------------------------------------------------
+    def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Take a free slot index (None on shutdown)."""
+        while not self._closed.is_set():
+            try:
+                return self.free.get(timeout=timeout if timeout else 0.1)
+            except queue.Empty:
+                if timeout is not None:
+                    return None
+        return None
+
+    def commit(self, idx: int) -> None:
+        self.full.put(idx)
+
+    def report_error(self, exc: BaseException) -> None:
+        with self._error_lock:
+            if self._error is None:
+                self._error = exc
+        self._closed.set()
+
+    # -- learner side --------------------------------------------------
+    def _check_error(self) -> None:
+        with self._error_lock:
+            if self._error is not None:
+                raise RuntimeError("actor worker died") from self._error
+
+    def get_batch(
+        self, batch_size: int, timeout: Optional[float] = None
+    ) -> Tuple[Dict[str, np.ndarray], List[int]]:
+        """Drain ``batch_size`` full slots into one [T+1, batch, ...] batch.
+
+        Slots are recycled by the caller via ``recycle`` *after* the batch
+        has been shipped to device (the stack below copies, so recycling
+        immediately after this returns is also safe).
+        """
+        idxs: List[int] = []
+        while len(idxs) < batch_size:
+            self._check_error()
+            try:
+                idxs.append(self.full.get(timeout=timeout if timeout else 0.5))
+            except queue.Empty:
+                if self._closed.is_set():
+                    self._check_error()
+                    raise RuntimeError("rollout queue closed")
+                if timeout is not None:
+                    raise TimeoutError(
+                        f"get_batch: only {len(idxs)}/{batch_size} slots ready"
+                    )
+        batch = {
+            # core-state keys describe row 0 only: batch axis is 0; the
+            # time-major fields batch on axis 1
+            k: np.concatenate(
+                [self.slots[i][k] for i in idxs],
+                axis=0 if k.startswith("core_") else 1,
+            )
+            for k in self.slots[idxs[0]].keys()
+        }
+        return batch, idxs
+
+    def recycle(self, idxs: List[int]) -> None:
+        for i in idxs:
+            self.free.put(i)
+
+    def close(self) -> None:
+        self._closed.set()

@@ -1,0 +1,2 @@
+from scalerl_tpu.trainer.base import BaseTrainer  # noqa: F401
+from scalerl_tpu.trainer.off_policy import OffPolicyTrainer  # noqa: F401

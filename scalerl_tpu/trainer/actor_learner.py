@@ -1,0 +1,259 @@
+"""Actor-learner trainers: host actor plane (SEED-style) and fused device loop.
+
+Parity target: ``ImpalaTrainer`` (``scalerl/algorithms/impala/impala_atari.py:
+40-521``), re-architected per SURVEY.md §7:
+
+- **HostActorLearnerTrainer** — CPU actors run *envs only*; every neural-net
+  forward (acting inference) is a central jitted batched call on the device
+  (SEED-RL topology), unlike the reference where each actor process runs its
+  own CPU model copy (``impala_atari.py:196-198``).  Actor threads each
+  drive a vector-env slab, fill pinned trajectory slots from a free/full
+  ``RolloutQueue``, and the learner thread drains, ships, and updates.
+  Weight "publication" is implicit: central inference always reads the
+  learner's latest params (behavior lag <= one chunk), and a
+  ``ParameterServer`` snapshot is exported for off-host actors.
+- **DeviceActorLearnerTrainer** — the fully-fused path for device-native
+  envs (``runtime/device_loop.py``); orders of magnitude faster when env
+  dynamics compile.
+
+Failure handling parity (SURVEY.md §5): actor exceptions funnel through
+``RolloutQueue.report_error`` and re-raise in the learner; teardown joins
+with timeouts (reference ladders: ``impala_atari.py:473-494``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from scalerl_tpu.agents.impala import ImpalaAgent
+from scalerl_tpu.config import ImpalaArguments
+from scalerl_tpu.data.trajectory import TrajectorySpec, batch_to_trajectory
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.metrics import EpisodeMetrics
+from scalerl_tpu.utils.timers import Timings
+
+
+class _ActorThread(threading.Thread):
+    """One actor: owns a vector-env slab, fills trajectory slots."""
+
+    def __init__(
+        self,
+        actor_id: int,
+        trainer: "HostActorLearnerTrainer",
+        envs,
+    ) -> None:
+        super().__init__(name=f"actor-{actor_id}", daemon=True)
+        self.actor_id = actor_id
+        self.trainer = trainer
+        self.envs = envs
+        self.timings = Timings()
+
+    def run(self) -> None:
+        tr = self.trainer
+        agent = tr.agent
+        q = tr.queue
+        T = tr.args.rollout_length
+        B = self.envs.num_envs
+        try:
+            obs, _ = self.envs.reset(seed=tr.args.seed + 1000 * self.actor_id)
+            last_action = np.zeros(B, np.int32)
+            reward = np.zeros(B, np.float32)
+            done = np.ones(B, bool)
+            core_state = agent.initial_state(B)
+            while not tr.stop_event.is_set():
+                idx = q.acquire(timeout=1.0)
+                if idx is None:
+                    continue
+                slot = q.slots[idx]
+                # snapshot the recurrent state entering row 0
+                for i, (c, h) in enumerate(core_state):
+                    slot[f"core_{i}_c"][:] = np.asarray(c)
+                    slot[f"core_{i}_h"][:] = np.asarray(h)
+                self.timings.reset()
+                for t in range(T + 1):
+                    slot["obs"][t] = obs
+                    slot["action"][t] = last_action
+                    slot["reward"][t] = reward
+                    slot["done"][t] = done
+                    # central batched inference on device
+                    action, logits, core_state = agent.act(
+                        obs, last_action, reward, done, core_state
+                    )
+                    slot["logits"][t] = np.asarray(logits)
+                    self.timings.time("model")
+                    if t == T:
+                        break  # row T recorded; its action belongs to next chunk
+                    obs, reward, term, trunc, _ = self.envs.step(np.asarray(action))
+                    done = np.logical_or(term, trunc)
+                    reward = np.asarray(reward, np.float32)
+                    last_action = np.asarray(action, np.int32)
+                    tr.episode_metrics[self.actor_id].step(reward, done)
+                    self.timings.time("step")
+                q.commit(idx)
+                self.timings.time("write")
+                with tr.frame_lock:
+                    tr.env_frames += T * B
+        except Exception as e:  # noqa: BLE001 - funneled to the learner
+            q.report_error(e)
+
+
+class HostActorLearnerTrainer(BaseTrainer):
+    def __init__(
+        self,
+        args: ImpalaArguments,
+        agent: ImpalaAgent,
+        env_fns,  # list of callables, one vector env per actor
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.env_fns = env_fns
+        self.stop_event = threading.Event()
+        self.frame_lock = threading.Lock()
+        self.env_frames = 0
+        self.param_server = ParameterServer()
+
+        probe_env = env_fns[0]()
+        self.envs_per_actor = probe_env.num_envs
+        obs_shape = probe_env.single_observation_space.shape
+        num_actions = probe_env.single_action_space.n
+        self._probe_env = probe_env
+
+        core = agent.initial_state(self.envs_per_actor)
+        self.spec = TrajectorySpec(
+            unroll_length=args.rollout_length,
+            batch_size=self.envs_per_actor,
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=jax.numpy.float32 if len(obs_shape) == 1 else jax.numpy.uint8,
+            core_state_shapes=tuple(tuple(c.shape) for c, _ in core),
+        )
+        self.queue = RolloutQueue(self.spec, num_slots=args.num_buffers)
+        self.episode_metrics = [
+            EpisodeMetrics(self.envs_per_actor) for _ in range(len(env_fns))
+        ]
+        self.learn_timings = Timings()
+
+    # ------------------------------------------------------------------
+    def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
+        args = self.args
+        total_frames = total_frames or args.total_steps
+        actors = []
+        for i, fn in enumerate(self.env_fns):
+            envs = self._probe_env if i == 0 else fn()
+            actors.append(_ActorThread(i, self, envs))
+        for a in actors:
+            a.start()
+
+        start = time.time()
+        last_log_frames = 0
+        metrics: Dict[str, float] = {}
+        try:
+            while self.env_frames < total_frames and not self.stop_event.is_set():
+                self.learn_timings.reset()
+                batch, idxs = self.queue.get_batch(
+                    max(args.batch_size // self.envs_per_actor, 1)
+                )
+                self.learn_timings.time("dequeue")
+                traj = batch_to_trajectory(batch)
+                self.queue.recycle(idxs)
+                self.learn_timings.time("device")
+                metrics = self.agent.learn(traj)
+                self.learn_timings.time("learn")
+                self.param_server.push(self.agent.get_weights())
+
+                if self.env_frames - last_log_frames >= args.logger_frequency:
+                    last_log_frames = self.env_frames
+                    sps = self.env_frames / max(time.time() - start, 1e-8)
+                    rets = [
+                        r
+                        for m in self.episode_metrics
+                        for r in m.episode_returns[-20:]
+                    ]
+                    ret_mean = float(np.mean(rets)) if rets else float("nan")
+                    info = {**metrics, "sps": sps, "return_mean": ret_mean}
+                    self.logger.log_train_data(info, self.env_frames)
+                    if self.is_main_process:
+                        self.text_logger.info(
+                            f"frames {self.env_frames} | sps {sps:.0f} | "
+                            f"return {ret_mean:.1f} | loss {metrics.get('total_loss', float('nan')):.3f}"
+                        )
+        finally:
+            self.stop_event.set()
+            self.queue.close()
+            for a in actors:
+                a.join(timeout=5.0)
+            for a in actors:
+                try:
+                    a.envs.close()
+                except Exception:
+                    pass
+        sps = self.env_frames / max(time.time() - start, 1e-8)
+        rets = [r for m in self.episode_metrics for r in m.episode_returns]
+        return {
+            **metrics,
+            "env_frames": float(self.env_frames),
+            "sps": float(sps),
+            "return_mean": float(np.mean(rets[-100:])) if rets else float("nan"),
+            "episodes": float(len(rets)),
+        }
+
+
+class DeviceActorLearnerTrainer(BaseTrainer):
+    """IMPALA over device-native envs via the fused loop (flagship perf)."""
+
+    def __init__(
+        self,
+        args: ImpalaArguments,
+        agent: ImpalaAgent,
+        venv,
+        iters_per_call: int = 10,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+
+        self.agent = agent
+        self.loop = DeviceActorLearnerLoop(
+            model=agent.model,
+            venv=venv,
+            learn_fn=agent._learn.__wrapped__ if hasattr(agent._learn, "__wrapped__") else agent._learn,
+            unroll_length=args.rollout_length,
+            iters_per_call=iters_per_call,
+        )
+
+    def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
+        args = self.args
+        total_frames = total_frames or args.total_steps
+        frames_per_call = (
+            args.rollout_length * self.loop.venv.num_envs * self.loop.iters_per_call
+        )
+        num_calls = max(total_frames // frames_per_call, 1)
+        key = jax.random.PRNGKey(args.seed)
+        carry = self.loop.init_carry(key)
+        start = time.time()
+
+        def on_metrics(i: int, m: Dict[str, float]) -> None:
+            frames = (i + 1) * frames_per_call
+            sps = frames / max(time.time() - start, 1e-8)
+            self.logger.log_train_data({**m, "sps": sps}, frames)
+            if self.is_main_process and (i % 10 == 0 or i == num_calls - 1):
+                self.text_logger.info(
+                    f"frames {frames} | sps {sps:.0f} | return {m.get('return_mean', float('nan')):.2f}"
+                )
+
+        state, carry, metrics = self.loop.run(
+            self.agent.state, carry, key, num_calls, on_metrics=on_metrics
+        )
+        self.agent.state = state
+        frames = num_calls * frames_per_call
+        metrics["env_frames"] = float(frames)
+        metrics["sps"] = frames / max(time.time() - start, 1e-8)
+        return metrics

@@ -1,0 +1,56 @@
+"""Trainer base: run-dir layout, logger wiring, main-process gating.
+
+Parity target: ``BaseTrainer`` (``scalerl/trainer/base.py:26-179``): log-dir
+layout ``work_dir/project/env/algo/{tb_log,text_log,model_dir}``, main-process
+gating (JAX process index replaces ``accelerator.is_main_process``), and
+TensorBoard-vs-W&B logger selection.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from scalerl_tpu.config import RLArguments
+from scalerl_tpu.utils.loggers import BaseLogger, make_logger
+from scalerl_tpu.utils.logging import get_logger, process_index
+
+
+class BaseTrainer:
+    def __init__(self, args: RLArguments, run_name: Optional[str] = None) -> None:
+        self.args = args
+        self.is_main_process = process_index() == 0
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        run_name = run_name or f"{args.algo_name}_{args.seed}_{stamp}"
+        root = os.path.join(args.work_dir, args.project, args.env_id, args.algo_name, run_name)
+        self.work_dir = root
+        self.tb_log_dir = os.path.join(root, "tb_log")
+        self.text_log_dir = os.path.join(root, "text_log")
+        self.model_save_dir = os.path.join(root, "model_dir")
+        self.video_dir = os.path.join(root, "video_dir")
+        if self.is_main_process:
+            for d in (self.tb_log_dir, self.text_log_dir, self.model_save_dir):
+                os.makedirs(d, exist_ok=True)
+
+        self.text_logger = get_logger(
+            "scalerl_tpu",
+            log_file=os.path.join(self.text_log_dir, f"{run_name}.log")
+            if self.is_main_process
+            else None,
+        )
+        if self.is_main_process and args.logger_backend != "none":
+            self.logger: BaseLogger = make_logger(
+                args.logger_backend,
+                self.tb_log_dir,
+                project=args.project,
+                name=run_name,
+                config=vars(args),
+                train_interval=args.logger_frequency,
+                update_interval=args.logger_frequency,
+            )
+        else:
+            self.logger = make_logger("none", self.tb_log_dir)
+
+    def close(self) -> None:
+        self.logger.close()

@@ -1,0 +1,177 @@
+"""Off-policy trainer: vector-env rollouts feeding a device replay + learner.
+
+Parity target: ``OffPolicyTrainer`` (``scalerl/trainer/off_policy.py:21-323``):
+buffer/sampler wiring (uniform / PER / n-step), warmup + ``train_frequency``
+gating, vector-env evaluation, fps accounting, periodic eval/log/checkpoint.
+Fixes the reference's wiring bugs catalogued in SURVEY.md §2.4 (PER sampler
+signature mismatch, ``next_state``/``next_obs`` field drift, PER alpha fed
+from the RMSProp constant).
+
+The rollout loop runs on the host (data-dependent episode boundaries);
+acting and learning are jitted device calls through the agent.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from scalerl_tpu.agents.dqn import DQNAgent
+from scalerl_tpu.config import DQNArguments
+from scalerl_tpu.data.sampler import Sampler
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.metrics import EpisodeMetrics
+from scalerl_tpu.utils.schedulers import LinearDecayScheduler
+
+
+class OffPolicyTrainer(BaseTrainer):
+    def __init__(
+        self,
+        args: DQNArguments,
+        agent: DQNAgent,
+        train_envs,
+        eval_envs=None,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.train_envs = train_envs
+        self.eval_envs = eval_envs
+        self.num_envs = getattr(train_envs, "num_envs", 1)
+
+        obs_space = train_envs.single_observation_space
+        self.sampler = Sampler(
+            obs_shape=obs_space.shape,
+            capacity=args.buffer_size,
+            num_envs=self.num_envs,
+            use_per=args.use_per,
+            per_alpha=args.per_alpha,
+            n_step=args.n_steps,
+            gamma=args.gamma,
+        )
+        self.per_beta = LinearDecayScheduler(
+            args.per_beta, args.per_beta_final, args.max_timesteps
+        )
+
+        self.global_step = 0
+        self.learn_steps = 0
+        self.metrics = EpisodeMetrics(self.num_envs)
+
+    # ------------------------------------------------------------------
+    def store_experience(self, obs, next_obs, action, reward, terminated, infos) -> None:
+        """Store one vector step; on done, ``next_obs`` is the true terminal
+        obs from ``infos['final_obs']`` (SAME_STEP autoreset semantics)."""
+        real_next = np.asarray(next_obs).copy()
+        final_obs = infos.get("final_obs") if isinstance(infos, dict) else None
+        if final_obs is not None:
+            mask = infos.get("_final_obs")
+            for i in np.nonzero(mask)[0]:
+                real_next[i] = final_obs[i]
+        self.sampler.add(obs, real_next, action, reward, terminated)
+
+    def train_step(self) -> Dict[str, float]:
+        beta = self.per_beta.value(self.global_step)
+        batch = self.sampler.sample(self.args.batch_size, beta=beta)
+        info = self.agent.learn(batch)
+        if self.args.use_per:
+            self.sampler.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
+        info.pop("td_abs", None)
+        self.learn_steps += 1
+        return info
+
+    def run_evaluate_episodes(self, n_episodes: Optional[int] = None) -> Dict[str, float]:
+        """Greedy rollouts on the eval env pool until ``n_episodes`` finish
+        (``off_policy.py:221-249`` parity)."""
+        envs = self.eval_envs or self.train_envs
+        n_episodes = n_episodes or self.args.eval_episodes
+        num_envs = getattr(envs, "num_envs", 1)
+        obs, _ = envs.reset(seed=self.args.seed + 100)
+        returns: list = []
+        ep_ret = np.zeros(num_envs)
+        ep_len = np.zeros(num_envs, int)
+        while len(returns) < n_episodes:
+            actions = self.agent.predict(obs)
+            obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
+            ep_ret += reward
+            ep_len += 1
+            done = np.logical_or(term, trunc)
+            for i in np.nonzero(done)[0]:
+                returns.append((ep_ret[i], ep_len[i]))
+                ep_ret[i] = 0.0
+                ep_len[i] = 0
+        rets = np.array([r for r, _ in returns[:n_episodes]])
+        lens = np.array([l for _, l in returns[:n_episodes]])
+        return {
+            "reward_mean": float(rets.mean()),
+            "reward_std": float(rets.std()),
+            "length_mean": float(lens.mean()),
+        }
+
+    # ------------------------------------------------------------------
+    def run(self) -> Dict[str, float]:
+        args = self.args
+        obs, _ = self.train_envs.reset(seed=args.seed)
+        start = time.time()
+        last_log = 0
+        last_eval = 0
+        last_save = 0
+        train_info: Dict[str, float] = {}
+
+        while self.global_step < args.max_timesteps:
+            actions = self.agent.get_action(obs)
+            next_obs, reward, term, trunc, infos = self.train_envs.step(np.asarray(actions))
+            self.store_experience(obs, next_obs, actions, reward, term, infos)
+            self.metrics.step(reward, np.logical_or(term, trunc))
+            obs = next_obs
+            self.global_step += self.num_envs
+            self.agent.update_exploration(self.num_envs)
+
+            if (
+                len(self.sampler) >= args.warmup_learn_steps
+                and self.global_step % args.train_frequency < self.num_envs
+            ):
+                train_info = self.train_step()
+
+            if self.global_step - last_log >= args.logger_frequency:
+                last_log = self.global_step
+                fps = int(self.global_step / max(time.time() - start, 1e-8))
+                summary = self.metrics.summary()
+                info = {
+                    **{k: v for k, v in train_info.items()},
+                    "rpm_size": len(self.sampler),
+                    "fps": fps,
+                    "learn_steps": self.learn_steps,
+                    **summary,
+                }
+                self.logger.log_train_data(info, self.global_step)
+                if self.is_main_process:
+                    ret = summary.get("return_mean", float("nan"))
+                    self.text_logger.info(
+                        f"step {self.global_step} | fps {fps} | return {ret:.1f} "
+                        f"| eps {self.agent.eps:.3f} | loss {train_info.get('loss', float('nan')):.4f}"
+                    )
+
+            if self.eval_envs is not None and self.global_step - last_eval >= args.eval_frequency:
+                last_eval = self.global_step
+                eval_info = self.run_evaluate_episodes()
+                self.logger.log_test_data(eval_info, self.global_step)
+                if self.is_main_process:
+                    self.text_logger.info(
+                        f"eval @ {self.global_step}: return "
+                        f"{eval_info['reward_mean']:.1f} +- {eval_info['reward_std']:.1f}"
+                    )
+
+            if (
+                args.save_model
+                and not args.disable_checkpoint
+                and self.global_step - last_save >= args.save_frequency
+            ):
+                last_save = self.global_step
+                if self.is_main_process:
+                    self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_{self.global_step}")
+
+        if args.save_model and not args.disable_checkpoint and self.is_main_process:
+            self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_final")
+        return self.metrics.summary()

@@ -90,7 +90,9 @@ class TensorboardLogger(BaseLogger):
         update_interval: int = 1000,
     ) -> None:
         super().__init__(train_interval, test_interval, update_interval)
-        from torch.utils.tensorboard import SummaryWriter
+        # tensorboardX keeps this framework torch-free (torch's SummaryWriter
+        # would drag in a multi-GB dependency for event-file writing)
+        from tensorboardX import SummaryWriter
 
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
@@ -206,4 +208,9 @@ def make_logger(
         return TensorboardLogger(log_dir, **intervals)
     if backend == "wandb":
         return WandbLogger(project=project, name=name, config=config, **intervals)
-    return LazyLogger()
+    if backend in ("none", "lazy"):
+        return LazyLogger()
+    raise ValueError(
+        f"unknown logger backend {backend!r}; expected "
+        "'tensorboard' | 'wandb' | 'none'"
+    )

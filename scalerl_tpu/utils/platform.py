@@ -1,0 +1,21 @@
+"""Platform selection honoring ``RLArguments.platform``.
+
+Under the axon TPU tunnel the ``JAX_PLATFORMS`` env var is ignored (the
+plugin registers regardless), so ``--platform cpu`` must go through
+``jax.config.update('jax_platforms', ...)`` *before* first backend use.
+"""
+
+from __future__ import annotations
+
+
+def setup_platform(platform: str = "auto") -> str:
+    """Pin the JAX backend. Call before any jax array/computation is created.
+
+    ``auto`` keeps JAX's default (TPU when present).  Returns the backend
+    actually in use.
+    """
+    import jax
+
+    if platform and platform != "auto":
+        jax.config.update("jax_platforms", platform)
+    return jax.default_backend()

@@ -17,9 +17,10 @@ Params = Any
 
 
 def hard_target_update(online: Params, target: Params) -> Params:
-    """target <- online (pure; returns the new target pytree)."""
+    """target <- online (pure; returns a distinct-buffer copy, so donation of
+    a state holding both never sees aliased buffers)."""
     del target
-    return jax.tree_util.tree_map(lambda x: x, online)
+    return jax.tree_util.tree_map(jnp.copy, online)
 
 
 def soft_target_update(online: Params, target: Params, tau: float) -> Params:

@@ -2,12 +2,16 @@
 
 Multi-chip sharding tests run on a simulated mesh via
 ``--xla_force_host_platform_device_count=8`` (SURVEY.md §4's prescription),
-so the full dp/mesh path executes on any machine. Must run before jax import.
+so the full dp/mesh path executes on any machine.
+
+NOTE: under the axon TPU tunnel the ``JAX_PLATFORMS`` env var is *ignored*
+(the plugin registers regardless) — ``jax.config.update('jax_platforms',
+'cpu')`` before first backend use is what actually pins CPU.  Without this,
+"CPU" tests silently run over the TPU network tunnel at ~100ms/call.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +20,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platform_name", "cpu")
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu", (
+    "tests must run on CPU; got " + jax.default_backend()
+)
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"

@@ -1,0 +1,161 @@
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.envs import (
+    JaxCartPole,
+    JaxVecEnv,
+    SyntheticPixelEnv,
+    make_gym_env,
+    make_jax_vec_env,
+    make_vect_envs,
+)
+
+
+def test_make_gym_env():
+    env = make_gym_env("CartPole-v1", seed=1)()
+    obs, info = env.reset(seed=1)
+    assert obs.shape == (4,)
+    obs, r, term, trunc, info = env.step(env.action_space.sample())
+    assert obs.shape == (4,)
+    env.close()
+
+
+def test_make_vect_envs_sync():
+    envs = make_vect_envs("CartPole-v1", num_envs=3, async_envs=False)
+    obs, info = envs.reset(seed=3)
+    assert obs.shape == (3, 4)
+    obs, r, term, trunc, info = envs.step(envs.action_space.sample())
+    assert r.shape == (3,)
+    envs.close()
+
+
+def test_make_vect_envs_async_shared_memory():
+    envs = make_vect_envs("CartPole-v1", num_envs=2, async_envs=True)
+    obs, info = envs.reset(seed=0)
+    assert obs.shape == (2, 4)
+    for _ in range(5):
+        obs, r, term, trunc, info = envs.step(envs.action_space.sample())
+    envs.close()
+
+
+def test_jax_cartpole_matches_gym_dynamics():
+    """Step the JAX env and gymnasium's CartPole from the same state with the
+    same actions; trajectories must match until termination."""
+    genv = gym.make("CartPole-v1").unwrapped
+    genv.reset(seed=0)
+    jenv = JaxCartPole()
+
+    state0 = np.array([0.01, -0.02, 0.03, 0.04], np.float32)
+    genv.state = tuple(state0)
+    from scalerl_tpu.envs.jax_envs.cartpole import CartPoleState
+
+    jstate = CartPoleState(
+        jnp.float32(state0[0]), jnp.float32(state0[1]),
+        jnp.float32(state0[2]), jnp.float32(state0[3]), jnp.int32(0),
+    )
+    key = jax.random.PRNGKey(0)
+    for i in range(50):
+        action = i % 2
+        gobs, gr, gterm, gtrunc, _ = genv.step(action)
+        jstate, jobs, jr, jdone = jenv.step(jstate, jnp.int32(action), key)
+        if gterm or gtrunc:
+            assert bool(jdone)
+            break
+        assert not bool(jdone)
+        np.testing.assert_allclose(np.asarray(jobs), gobs, rtol=1e-4, atol=1e-5)
+    genv.close()
+
+
+def test_jax_cartpole_autoreset():
+    env = JaxCartPole(max_steps=5)
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    for i in range(5):
+        state, obs, r, done = env.step(state, jnp.int32(1), jax.random.fold_in(key, i))
+    assert bool(done)  # truncated at max_steps
+    assert int(state.t) == 0  # auto-reset already happened
+
+
+def test_jax_vec_env():
+    venv = make_jax_vec_env("CartPole-v1", num_envs=4)
+    key = jax.random.PRNGKey(0)
+    state, obs = venv.reset(key)
+    assert obs.shape == (4, 4)
+    actions = jnp.ones(4, jnp.int32)
+    state, obs, rew, done = venv.step(state, actions, key)
+    assert rew.shape == (4,) and done.shape == (4,)
+
+
+def test_jax_vec_env_under_jit_scan():
+    """The whole rollout must compile into one XLA program."""
+    venv = make_jax_vec_env("CartPole-v1", num_envs=8)
+
+    @jax.jit
+    def rollout(key):
+        state, obs = venv.reset(key)
+
+        def body(carry, k):
+            state, obs = carry
+            actions = jax.random.randint(k, (8,), 0, 2)
+            state, obs, rew, done = venv.step(state, actions, k)
+            return (state, obs), (rew, done)
+
+        _, (rews, dones) = jax.lax.scan(body, (state, obs), jax.random.split(key, 100))
+        return rews.sum(), dones.sum()
+
+    total_rew, total_done = rollout(jax.random.PRNGKey(0))
+    assert float(total_rew) == 800.0  # reward 1 every step
+    assert int(total_done) >= 0
+
+
+def test_synthetic_pixel_env():
+    env = SyntheticPixelEnv(size=42, stack=2, num_actions=4, episode_length=10)
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    assert obs.shape == (42, 42, 2) and obs.dtype == jnp.uint8
+    # taking the correct action yields reward 1
+    correct = env._correct_action(state.cell)
+    state2, obs2, rew, done = env.step(state, correct, key)
+    assert float(rew) == 1.0
+    # wrong action yields 0
+    wrong = (correct + 1) % 4
+    _, _, rew_w, _ = env.step(state, wrong, key)
+    assert float(rew_w) == 0.0
+    # rendering is deterministic per cell
+    np.testing.assert_array_equal(
+        np.asarray(env._render(state.cell)), np.asarray(env._render(state.cell))
+    )
+
+
+def test_atari_wrappers_on_fake_env():
+    """Drive WarpFrame/ClipReward/FrameStack/MaxAndSkip on a synthetic RGB env
+    (no ALE in this image, SURVEY.md env notes)."""
+    from scalerl_tpu.envs.atari import ClipRewardEnv, FrameStack, MaxAndSkipEnv, WarpFrame
+
+    class FakeRGB(gym.Env):
+        observation_space = gym.spaces.Box(0, 255, (64, 48, 3), np.uint8)
+        action_space = gym.spaces.Discrete(3)
+
+        def __init__(self):
+            self.t = 0
+
+        def reset(self, **kw):
+            self.t = 0
+            return self._frame(), {}
+
+        def _frame(self):
+            return np.full((64, 48, 3), min(self.t * 10, 255), np.uint8)
+
+        def step(self, action):
+            self.t += 1
+            return self._frame(), -2.5, self.t >= 20, False, {}
+
+    env = FrameStack(ClipRewardEnv(WarpFrame(MaxAndSkipEnv(FakeRGB(), skip=4), size=84)), k=4)
+    obs, _ = env.reset()
+    assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+    obs, reward, term, trunc, _ = env.step(0)
+    assert reward == -1.0  # -2.5 * 4 skip-summed, clipped to sign
+    assert obs.shape == (84, 84, 4)

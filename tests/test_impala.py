@@ -1,0 +1,190 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.impala import (
+    ImpalaAgent,
+    impala_loss,
+    make_impala_learn_fn,
+    make_impala_optimizer,
+)
+from scalerl_tpu.config import ImpalaArguments
+from scalerl_tpu.data.trajectory import Trajectory, TrajectorySpec, batch_to_trajectory
+from scalerl_tpu.envs import make_jax_vec_env, make_vect_envs
+from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+
+
+def _args(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        rollout_length=8,
+        batch_size=4,
+        num_actors=2,
+        num_buffers=8,
+        use_lstm=False,
+        hidden_size=64,
+        logger_backend="none",
+    )
+    base.update(kw)
+    return ImpalaArguments(**base)
+
+
+def test_impala_agent_vector_obs_learn_step():
+    args = _args()
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = args.rollout_length, 4
+    key = jax.random.PRNGKey(0)
+    traj = Trajectory(
+        obs=jax.random.normal(key, (T + 1, B, 4)),
+        action=jax.random.randint(key, (T + 1, B), 0, 2),
+        reward=jax.random.normal(key, (T + 1, B)),
+        done=jnp.zeros((T + 1, B), bool),
+        logits=jax.random.normal(key, (T + 1, B, 2)),
+        core_state=(),
+    )
+    m1 = agent.learn(traj)
+    m2 = agent.learn(traj)
+    assert np.isfinite(m1["total_loss"]) and np.isfinite(m2["total_loss"])
+    assert m1["total_loss"] != m2["total_loss"]
+    assert int(agent.state.step) == 2
+    assert int(agent.state.env_frames) == 2 * T * B
+
+
+def test_impala_loss_on_policy_equals_a2c():
+    """With behavior == target logits, V-trace advantages equal the
+    discounted-return advantage; the loss should be finite and its gradient
+    should push the chosen-action probability up for positive advantage."""
+    args = _args()
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = 4, 2
+    obs = jnp.ones((T + 1, B, 4))
+    out, _ = agent.model.apply(
+        agent.state.params, obs, jnp.zeros((T + 1, B), jnp.int32),
+        jnp.zeros((T + 1, B)), jnp.zeros((T + 1, B), bool), (),
+    )
+    traj = Trajectory(
+        obs=obs,
+        action=jnp.zeros((T + 1, B), jnp.int32),
+        reward=jnp.ones((T + 1, B)),
+        done=jnp.zeros((T + 1, B), bool),
+        logits=out.policy_logits,
+        core_state=(),
+    )
+    loss, metrics = impala_loss(
+        agent.state.params, agent.model, traj,
+        discounting=0.99, baseline_cost=0.5, entropy_cost=0.01,
+    )
+    assert np.isfinite(float(loss))
+    assert float(metrics["mean_reward"]) == 1.0
+
+
+def test_impala_lstm_agent_pixels():
+    args = _args(use_lstm=True, hidden_size=32, rollout_length=3)
+    agent = ImpalaAgent(args, obs_shape=(84, 84, 4), num_actions=6)
+    T, B = 3, 2
+    traj = Trajectory(
+        obs=jnp.zeros((T + 1, B, 84, 84, 4), jnp.uint8),
+        action=jnp.zeros((T + 1, B), jnp.int32),
+        reward=jnp.zeros((T + 1, B)),
+        done=jnp.zeros((T + 1, B), bool),
+        logits=jnp.zeros((T + 1, B, 6)),
+        core_state=agent.initial_state(B),
+    )
+    m = agent.learn(traj)
+    assert np.isfinite(m["total_loss"])
+    # act API
+    a, logits, core = agent.act(
+        np.zeros((B, 84, 84, 4), np.uint8), np.zeros(B, np.int32),
+        np.zeros(B, np.float32), np.zeros(B, bool), agent.initial_state(B),
+    )
+    assert a.shape == (B,) and logits.shape == (B, 6)
+
+
+def test_device_loop_cartpole_learns():
+    """The fused device loop must run and improve returns on CartPole."""
+    args = _args(
+        rollout_length=16, gamma=0.99, entropy_cost=0.01,
+        learning_rate=1e-2, hidden_size=64,
+    )
+    venv = make_jax_vec_env("CartPole-v1", num_envs=16)
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    loop = DeviceActorLearnerLoop(
+        model=agent.model, venv=venv,
+        learn_fn=make_impala_learn_fn(agent.model, agent.optimizer, args),
+        unroll_length=args.rollout_length, iters_per_call=20,
+    )
+    key = jax.random.PRNGKey(0)
+    carry = loop.init_carry(key)
+    state = agent.state
+    state, carry, _ = loop.run(state, carry, key, num_calls=1)
+    early_return = float(carry.return_sum / jnp.maximum(carry.episode_count, 1))
+    # train more
+    state, carry, _ = loop.run(state, carry, jax.random.PRNGKey(1), num_calls=8)
+    late = carry
+    late_return = float(
+        (late.return_sum) / jnp.maximum(late.episode_count, 1)
+    )
+    assert int(state.step) == 9 * 20
+    assert np.isfinite(late_return)
+    # cumulative mean should exceed the early mean if any learning happened
+    assert late_return > early_return, (early_return, late_return)
+
+
+def test_rollout_queue_batching():
+    spec = TrajectorySpec(unroll_length=4, batch_size=2, obs_shape=(4,), num_actions=2,
+                          obs_dtype=jnp.float32)
+    q = RolloutQueue(spec, num_slots=4)
+    i1 = q.acquire(); i2 = q.acquire()
+    q.slots[i1]["obs"][:] = 1.0
+    q.slots[i2]["obs"][:] = 2.0
+    q.commit(i1); q.commit(i2)
+    batch, idxs = q.get_batch(2, timeout=2.0)
+    assert batch["obs"].shape == (5, 4, 4)  # [T+1, 2 slots x B=2, D]
+    assert set(np.unique(batch["obs"])) == {1.0, 2.0}
+    q.recycle(idxs)
+    traj = batch_to_trajectory(batch)
+    assert traj.obs.shape == (5, 4, 4)
+    assert traj.core_state == ()
+
+
+def test_rollout_queue_error_funnel():
+    spec = TrajectorySpec(unroll_length=2, batch_size=1, obs_shape=(4,), num_actions=2)
+    q = RolloutQueue(spec, num_slots=2)
+    q.report_error(ValueError("actor exploded"))
+    with pytest.raises(RuntimeError, match="actor worker died"):
+        q.get_batch(1, timeout=0.5)
+
+
+def test_parameter_server_versioning():
+    ps = ParameterServer()
+    w, v = ps.pull()
+    assert w is None and v == 0
+    v1 = ps.push({"w": jnp.ones(3)})
+    w, v = ps.pull()
+    assert v == v1 == 1 and isinstance(w["w"], np.ndarray)
+    # current caller gets a no-op
+    w2, v2 = ps.pull(have_version=v)
+    assert w2 is None and v2 == 1
+
+
+def test_host_actor_learner_trainer_smoke(tmp_path):
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    args = _args(
+        rollout_length=8, batch_size=4, num_actors=2, num_buffers=8,
+        logger_frequency=10**9, work_dir=str(tmp_path), hidden_size=32,
+    )
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    env_fns = [
+        (lambda i=i: make_vect_envs("CartPole-v1", num_envs=2, seed=i, async_envs=False))
+        for i in range(2)
+    ]
+    trainer = HostActorLearnerTrainer(args, agent, env_fns)
+    result = trainer.train(total_frames=512)
+    assert result["env_frames"] >= 512
+    assert np.isfinite(result["total_loss"])
+    assert int(agent.state.step) > 0
+    assert trainer.param_server.version > 0
