@@ -1,0 +1,103 @@
+"""Headline benchmark: IMPALA Atari-shaped env-frames/sec on one chip.
+
+Runs the flagship path — the fully-fused on-device actor-learner loop
+(``scalerl_tpu/runtime/device_loop.py``: env step + AtariNet forward +
+action sample + V-trace learner update, all one XLA program) — on the
+synthetic Atari-shaped pixel env at real frame shapes ``[84, 84, 4]``.
+
+Baseline: the driver target (BASELINE.json north star) of >=100k
+env-frames/sec aggregate on a v5e-16, i.e. 6,250 frames/sec/chip;
+``vs_baseline`` is measured frames/sec/chip over that number.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax
+
+BASELINE_FPS_PER_CHIP = 100_000 / 16  # v5e-16 north star, per chip
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
+    from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+
+    platform = jax.default_backend()
+    # batch/unroll sized for one chip; CPU fallback shrinks to stay quick
+    on_accel = platform in ("tpu", "gpu")
+    B = 128 if on_accel else 16
+    T = 20
+    iters_per_call = 10 if on_accel else 2
+
+    args = ImpalaArguments(
+        use_lstm=False,
+        hidden_size=512,
+        rollout_length=T,
+        batch_size=B,
+        max_timesteps=0,
+    )
+    env = SyntheticPixelEnv()
+    venv = JaxVecEnv(env, num_envs=B)
+    agent = ImpalaAgent(args, obs_shape=env.observation_shape, num_actions=env.num_actions)
+    learn = make_impala_learn_fn(agent.model, agent.optimizer, args)
+    loop = DeviceActorLearnerLoop(
+        model=agent.model,
+        venv=venv,
+        learn_fn=learn,
+        unroll_length=T,
+        iters_per_call=iters_per_call,
+    )
+
+    key = jax.random.PRNGKey(0)
+    carry = loop.init_carry(key)
+    state = agent.state
+    frames_per_call = T * B * iters_per_call
+
+    # warmup: compile + one full call.  Synchronize by *fetching a scalar*:
+    # under the axon tunnel block_until_ready can return before the program
+    # finishes, but a host transfer of an output cannot.
+    state, carry, m = loop._train_many(state, carry, jax.random.PRNGKey(1))
+    float(m["total_loss"])
+
+    target_s = 20.0 if on_accel else 8.0
+    frames = 0
+    t0 = time.perf_counter()
+    i = 0
+    while True:
+        key, sub = jax.random.split(key)
+        state, carry, metrics = loop._train_many(state, carry, sub)
+        i += 1
+        frames += frames_per_call
+        float(metrics["total_loss"])
+        if time.perf_counter() - t0 >= target_s and i >= 3:
+            break
+    elapsed = time.perf_counter() - t0
+
+    fps = frames / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "impala_atari_env_frames_per_sec_per_chip",
+                "value": round(fps, 1),
+                "unit": f"frames/sec/chip ({platform})",
+                "vs_baseline": round(fps / BASELINE_FPS_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -61,9 +61,14 @@ def main() -> None:
             )
             for i in range(args.num_actors)
         ]
-        probe = env_fns[0]()
-        obs_shape = probe.single_observation_space.shape
-        num_actions = probe.single_action_space.n
+        # probe spaces with ONE plain env — the trainer builds (and keeps)
+        # its own vector probe, so spawning a second subprocess pool just to
+        # read two space attributes would double the expensive env startup
+        from scalerl_tpu.envs import make_gym_env
+
+        probe = make_gym_env(args.env_id, seed=args.seed, atari=atari)()
+        obs_shape = probe.observation_space.shape
+        num_actions = probe.action_space.n
         probe.close()
         agent = ImpalaAgent(
             args,

@@ -15,6 +15,7 @@ the reference ran NCCL all-reduce.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -176,6 +177,7 @@ class ImpalaAgent(BaseAgent):
         self.num_actions = num_actions
         key = key if key is not None else jax.random.PRNGKey(args.seed)
         self._key = key
+        self._key_lock = threading.Lock()
 
         self.model = build_model(args, obs_shape, num_actions)
         T1, B = 2, 1
@@ -217,7 +219,10 @@ class ImpalaAgent(BaseAgent):
         return self.model.initial_state(batch_size)
 
     def _next_key(self) -> jax.Array:
-        self._key, sub = jax.random.split(self._key)
+        # multiple actor threads call act() concurrently (actor_learner.py);
+        # an unsynchronized read-split-write would hand two actors the same key
+        with self._key_lock:
+            self._key, sub = jax.random.split(self._key)
         return sub
 
     def act(self, obs, last_action, reward, done, core_state):

@@ -94,7 +94,8 @@ def per_sample(
 
     # Priorities in logical order: roll so row 0 = oldest.
     logical_prio = jnp.roll(state.priorities, -start, axis=0)
-    valid = (jnp.arange(capacity) < jnp.maximum(size - n_step, 1))[:, None]
+    # window at L reads rows L..L+n_step-1 -> L <= size - n_step inclusive
+    valid = (jnp.arange(capacity) < jnp.maximum(size - n_step + 1, 1))[:, None]
     p = jnp.where(valid, logical_prio, 0.0) ** alpha
     p = jnp.where(valid, jnp.maximum(p, 1e-12), 0.0)
     flat_p = p.reshape(-1)

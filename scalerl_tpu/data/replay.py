@@ -170,8 +170,10 @@ def replay_sample(
     logical (row, env) pair for PER-style callers).
     """
     num_envs = next(iter(state.storage.values())).shape[1]
-    # valid logical rows leave room for the n-step window
-    max_l = jnp.maximum(state.size - n_step, 1)
+    # valid logical rows leave room for the n-step window: a window starting
+    # at L reads rows L..L+n_step-1, so L <= size - n_step (inclusive).
+    # Callers must warm up past n_step rows before sampling.
+    max_l = jnp.maximum(state.size - n_step + 1, 1)
     k1, k2 = jax.random.split(key)
     logical = jax.random.randint(k1, (batch_size,), 0, max_l)
     envs = jax.random.randint(k2, (batch_size,), 0, num_envs)

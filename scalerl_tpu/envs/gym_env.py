@@ -71,7 +71,12 @@ def make_vect_envs(
     # the reference's replay path assumes (store next_obs = final_obs on done).
     mode = gym.vector.AutoresetMode.SAME_STEP
     if async_envs and num_envs > 1:
-        return gym.vector.AsyncVectorEnv(thunks, shared_memory=True, autoreset_mode=mode)
+        # spawn, not fork: the parent holds JAX (multithreaded) and, in the
+        # actor-learner path, live actor threads — forked children inherit
+        # locked mutexes and deadlock (CPython popen_fork warning).
+        return gym.vector.AsyncVectorEnv(
+            thunks, shared_memory=True, autoreset_mode=mode, context="spawn"
+        )
     return gym.vector.SyncVectorEnv(thunks, autoreset_mode=mode)
 
 

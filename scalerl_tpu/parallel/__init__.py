@@ -1,0 +1,43 @@
+"""Multi-chip parallelism: device meshes, sharding rules, pjit train steps.
+
+This package is the TPU-native replacement for every distributed-compute
+mechanism in the reference (SURVEY.md §5 "Distributed communication
+backend"):
+
+- HF Accelerate / ``torch.distributed`` NCCL all-reduce
+  (``scalerl/algorithms/dqn/dqn_agent.py:173-174``,
+  ``scalerl/trainer/off_policy.py:118-126``) becomes a ``jax.sharding.Mesh``
+  over ICI with the batch axis of the trajectory sharded on ``dp`` — XLA's
+  GSPMD partitioner inserts the gradient ``psum`` automatically.
+- The ``accelerate_config.yaml`` topology file becomes a one-line mesh spec
+  string, e.g. ``"dp=4,fsdp=2"`` (``MeshSpec.parse``).
+- Multi-node rendezvous (``hpc/worker.py:300-341`` entry handshake) becomes
+  ``jax.distributed.initialize`` (``multihost.py``).
+
+Axis vocabulary (fixed, in mesh order):
+``dp`` (data), ``fsdp`` (param/optimizer shards), ``tp`` (tensor),
+``sp`` (sequence/context), ``ep`` (expert).  RL parity only *needs* ``dp``
+(SURVEY.md §2.4 parallelism inventory), but the mesh reserves the rest so
+long-context policies (ring attention over ``sp``) and sharded param states
+drop in without re-plumbing.
+"""
+
+from scalerl_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_NAMES,
+    MeshSpec,
+    make_mesh,
+)
+from scalerl_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    infer_param_spec,
+    param_sharding,
+    replicated,
+    shard_batch,
+    shard_params,
+    trajectory_sharding,
+)
+from scalerl_tpu.parallel.train_step import (  # noqa: F401
+    make_parallel_act_fn,
+    make_parallel_learn_fn,
+)
+from scalerl_tpu.parallel.multihost import initialize_multihost  # noqa: F401

@@ -82,14 +82,20 @@ class _ActorThread(threading.Thread):
                     slot["action"][t] = last_action
                     slot["reward"][t] = reward
                     slot["done"][t] = done
+                    if t == T:
+                        # row T is model-input-only: the learner reads
+                        # logits[:-1], and the boundary obs is consumed by the
+                        # next chunk's row 0 — running inference here would
+                        # advance the LSTM core over obs_T twice (slots are
+                        # recycled, so clear the stale logits row).
+                        slot["logits"][t] = 0.0
+                        break
                     # central batched inference on device
                     action, logits, core_state = agent.act(
                         obs, last_action, reward, done, core_state
                     )
                     slot["logits"][t] = np.asarray(logits)
                     self.timings.time("model")
-                    if t == T:
-                        break  # row T recorded; its action belongs to next chunk
                     obs, reward, term, trunc, _ = self.envs.step(np.asarray(action))
                     done = np.logical_or(term, trunc)
                     reward = np.asarray(reward, np.float32)

@@ -18,16 +18,24 @@ import orbax.checkpoint as ocp
 
 
 def save_checkpoint(path: str, state: Any) -> str:
-    """Save a pytree to ``path`` (atomic, overwrite-safe). Returns the path."""
-    path = os.path.abspath(path)
-    checkpointer = ocp.StandardCheckpointer()
-    if os.path.exists(path):
-        # orbax refuses to overwrite; write-new-then-swap semantics
-        import shutil
+    """Save a pytree to ``path`` (write-new-then-swap). Returns the path.
 
-        shutil.rmtree(path)
-    checkpointer.save(path, state)
+    The full save lands in a ``.tmp`` sibling first, so a crash mid-save
+    never destroys the previous checkpoint — the only unprotected window is
+    the final rmtree+rename metadata swap.
+    """
+    import shutil
+
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    checkpointer = ocp.StandardCheckpointer()
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    checkpointer.save(tmp, state)
     checkpointer.wait_until_finished()
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.rename(tmp, path)
     return path
 
 
