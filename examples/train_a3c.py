@@ -1,0 +1,49 @@
+"""A3C (sync-batched A2C) on CartPole.
+
+Parity target: ``examples/test_a3c.py`` in the reference
+(``ParallelA3C(env_name='CartPole-v0').run()``); the worker fleet is a
+vector env with central batched inference (documented divergence from
+Hogwild, see ``scalerl_tpu/agents/a3c.py``).
+
+Usage::
+
+    python examples/train_a3c.py --env-id CartPole-v1 --max-timesteps 100000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import A3CAgent
+from scalerl_tpu.config import A3CArguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OnPolicyTrainer
+
+
+def main() -> None:
+    args = parse_args(A3CArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    train_envs = make_vect_envs(args.env_id, num_envs=args.num_workers, seed=args.seed)
+    eval_envs = make_vect_envs(args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False)
+    agent = A3CAgent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        num_actions=train_envs.single_action_space.n,
+    )
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        train_envs.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""A3C family: synchronous batched advantage actor-critic on TPU.
+
+Parity target: ``ParallelA3C`` (``scalerl/algorithms/a3c/parallel_a3c.py:
+71-507``) and its variants (``parallel_ac.py``, ``ray_a3c.py``).  The
+reference's Hogwild design — per-worker CPU models pushing gradients into a
+shared-memory model under ``SharedAdam`` (``parallel_a3c.py:221-233``,
+``share_optim.py:9-122``) — is intentionally *not* reproduced: lock-free
+racing parameter writes have no XLA equivalent and waste the MXU.  Instead
+the same actor fleet feeds one synchronous batched update (documented
+divergence, SURVEY.md §7 step 8):
+
+- N actors (vector-env lanes) advance ``rollout_length`` steps using central
+  batched inference — one jitted forward over the whole ``[B]`` slab instead
+  of B per-process CPU forwards (``parallel_a3c.py:296-310``).
+- The learner computes GAE advantages (``gae_lambda=1.0`` reduces to the
+  reference's discounted-return advantage, ``parallel_a3c.py:251-262``),
+  policy-gradient + value + entropy losses (``compute_loss``,
+  ``parallel_a3c.py:235-288``), and takes ONE Adam step for the whole fleet
+  — the role ``SharedAdam`` played, without the races.
+
+The update consumes the universal ``Trajectory`` chunk, so the same pjit
+data-parallel wrapper used by IMPALA (``scalerl_tpu.parallel``) shards A3C
+across chips unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.policy_value import PolicyValueAgent, frames_counter
+from scalerl_tpu.config import A3CArguments
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.models.atari import AtariNet
+from scalerl_tpu.models.policy import MLPPolicyNet
+from scalerl_tpu.ops.losses import (
+    baseline_loss,
+    entropy_loss,
+    policy_gradient_loss,
+)
+from scalerl_tpu.ops.returns import gae_advantages
+
+
+@struct.dataclass
+class A3CTrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    env_frames: jnp.ndarray
+
+
+def a3c_loss(
+    params,
+    model,
+    traj: Trajectory,
+    gamma: float,
+    gae_lambda: float,
+    value_loss_coef: float,
+    entropy_coef: float,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """The A2C objective over one on-policy [T+1, B] trajectory chunk.
+
+    Matches ``ParallelA3C.compute_loss`` (``parallel_a3c.py:235-288``):
+    GAE advantages (value targets stop-gradiented, as the reference detaches
+    the return), NLL x advantage policy loss, 0.5 * sum(R - V)^2 value loss,
+    entropy bonus.
+    """
+    out, _ = model.apply(
+        params, traj.obs, traj.action, traj.reward, traj.done, traj.core_state
+    )
+    logits = out.policy_logits  # [T+1, B, A]
+    values = out.baseline  # [T+1, B]
+
+    actions_taken = traj.action[1:]
+    rewards = traj.reward[1:]
+    discounts = gamma * (1.0 - traj.done[1:].astype(jnp.float32))
+    advantages, vs = gae_advantages(
+        rewards, discounts, values[:-1], values[-1], lambda_=gae_lambda
+    )
+
+    pg = policy_gradient_loss(logits[:-1], actions_taken, advantages)
+    vl = value_loss_coef * baseline_loss(jax.lax.stop_gradient(vs) - values[:-1])
+    ent = entropy_coef * entropy_loss(logits[:-1])
+    total = pg + vl + ent
+    metrics = {
+        "total_loss": total,
+        "pg_loss": pg,
+        "value_loss": vl,
+        "entropy_loss": ent,
+        "mean_value": jnp.mean(values),
+        "mean_reward": jnp.mean(rewards),
+        "mean_advantage": jnp.mean(advantages),
+    }
+    return total, metrics
+
+
+def make_a3c_learn_fn(
+    model, optimizer: optax.GradientTransformation, args: A3CArguments
+) -> Callable:
+    """Build the pure (state, traj) -> (state, metrics) A2C update."""
+
+    def learn(state: A3CTrainState, traj: Trajectory):
+        (loss, metrics), grads = jax.value_and_grad(a3c_loss, has_aux=True)(
+            state.params,
+            model,
+            traj,
+            gamma=args.gamma,
+            gae_lambda=args.gae_lambda,
+            value_loss_coef=args.value_loss_coef,
+            entropy_coef=args.entropy_coef,
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        T, B = traj.reward.shape[0] - 1, traj.reward.shape[1]
+        new_state = A3CTrainState(
+            params=params,
+            opt_state=opt_state,
+            step=state.step + 1,
+            env_frames=state.env_frames + T * B,
+        )
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    return learn
+
+
+def make_a3c_optimizer(args: A3CArguments) -> optax.GradientTransformation:
+    """Adam + global-norm clip: the one optimizer the fleet shares (the
+    ``SharedAdam`` capability, ``share_optim.py:9-122``, without the
+    shared-memory races; grad clip parity ``parallel_a3c.py:368``)."""
+    return optax.chain(
+        optax.clip_by_global_norm(args.max_grad_norm),
+        optax.adam(args.learning_rate),
+    )
+
+
+def build_model(args: A3CArguments, obs_shape: Tuple[int, ...], num_actions: int):
+    """Pixel obs -> conv+LSTM AtariNet (the reference's A3C Atari model,
+    ``a3c/utils/atari_model.py:57-144``: convs + LSTMCell(256));
+    flat obs -> MLPPolicyNet (``parallel_a3c.py:27-68``)."""
+    if len(obs_shape) == 3:
+        return AtariNet(num_actions=num_actions, use_lstm=args.use_lstm, hidden_size=args.hidden_size)
+    hidden = tuple(int(h) for h in str(args.hidden_sizes).split(",") if h)
+    return MLPPolicyNet(num_actions=num_actions, hidden_sizes=hidden)
+
+
+class A3CAgent(PolicyValueAgent):
+    """Host-facing A3C agent: jitted act + batched-sync learn."""
+
+    def __init__(
+        self,
+        args: A3CArguments,
+        obs_shape: Tuple[int, ...],
+        num_actions: int,
+        obs_dtype=jnp.float32,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        self.args = args
+        model = build_model(args, obs_shape, num_actions)
+        optimizer = make_a3c_optimizer(args)
+        self._setup(
+            model=model,
+            optimizer=optimizer,
+            make_state=lambda params, opt_state: A3CTrainState(
+                params=params,
+                opt_state=opt_state,
+                step=jnp.zeros((), jnp.int32),
+                env_frames=frames_counter(),
+            ),
+            learn_fn=make_a3c_learn_fn(model, optimizer, args),
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=obs_dtype,
+            seed=args.seed,
+            key=key,
+        )

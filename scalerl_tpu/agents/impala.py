@@ -15,16 +15,14 @@ the reference ran NCCL all-reduce.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from flax import struct
 
-from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.agents.policy_value import PolicyValueAgent, frames_counter
 from scalerl_tpu.config import ImpalaArguments
 from scalerl_tpu.data.trajectory import Trajectory
 from scalerl_tpu.models.atari import AtariNet
@@ -35,7 +33,6 @@ from scalerl_tpu.ops.losses import (
     policy_gradient_loss,
 )
 from scalerl_tpu.ops.vtrace import vtrace_from_logits
-from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 
 
 @struct.dataclass
@@ -161,7 +158,7 @@ def build_model(args: ImpalaArguments, obs_shape: Tuple[int, ...], num_actions: 
     return MLPPolicyNet(num_actions=num_actions, hidden_sizes=(args.hidden_size, args.hidden_size))
 
 
-class ImpalaAgent(BaseAgent):
+class ImpalaAgent(PolicyValueAgent):
     """Host-facing IMPALA agent: jitted act + learn + weight pub/sub."""
 
     def __init__(
@@ -173,106 +170,21 @@ class ImpalaAgent(BaseAgent):
         key: Optional[jax.Array] = None,
     ) -> None:
         self.args = args
-        self.obs_shape = tuple(obs_shape)
-        self.num_actions = num_actions
-        key = key if key is not None else jax.random.PRNGKey(args.seed)
-        self._key = key
-        self._key_lock = threading.Lock()
-
-        self.model = build_model(args, obs_shape, num_actions)
-        T1, B = 2, 1
-        dummy_obs = jnp.zeros((T1, B) + self.obs_shape, obs_dtype)
-        dummy_a = jnp.zeros((T1, B), jnp.int32)
-        dummy_r = jnp.zeros((T1, B), jnp.float32)
-        dummy_d = jnp.zeros((T1, B), jnp.bool_)
-        core = self.model.initial_state(B)
-        params = self.model.init(key, dummy_obs, dummy_a, dummy_r, dummy_d, core)
-
-        self.optimizer = make_impala_optimizer(args)
-        self.state = ImpalaTrainState(
-            params=params,
-            opt_state=self.optimizer.init(params),
-            step=jnp.zeros((), jnp.int32),
-            env_frames=jnp.zeros((), jnp.int64)
-            if jax.config.jax_enable_x64
-            else jnp.zeros((), jnp.int32),
+        model = build_model(args, obs_shape, num_actions)
+        optimizer = make_impala_optimizer(args)
+        self._setup(
+            model=model,
+            optimizer=optimizer,
+            make_state=lambda params, opt_state: ImpalaTrainState(
+                params=params,
+                opt_state=opt_state,
+                step=jnp.zeros((), jnp.int32),
+                env_frames=frames_counter(),
+            ),
+            learn_fn=make_impala_learn_fn(model, optimizer, args),
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=obs_dtype,
+            seed=args.seed,
+            key=key,
         )
-        self._learn = jax.jit(make_impala_learn_fn(self.model, self.optimizer, args))
-
-        def act(params, obs, last_action, reward, done, core_state, key):
-            """One acting step: obs [B, ...] -> sampled actions, logits, state."""
-            out, new_core = self.model.apply(
-                params, obs[None], last_action[None], reward[None], done[None], core_state
-            )
-            logits = out.policy_logits[0]
-            action = jax.random.categorical(key, logits, axis=-1)
-            return action, logits, new_core
-
-        self._act = jax.jit(act)
-        self._act_greedy = jax.jit(
-            lambda params, obs, last_action, reward, done, core_state: self.model.apply(
-                params, obs[None], last_action[None], reward[None], done[None], core_state
-            )[0].policy_logits[0].argmax(-1)
-        )
-
-    def initial_state(self, batch_size: int):
-        return self.model.initial_state(batch_size)
-
-    def _next_key(self) -> jax.Array:
-        # multiple actor threads call act() concurrently (actor_learner.py);
-        # an unsynchronized read-split-write would hand two actors the same key
-        with self._key_lock:
-            self._key, sub = jax.random.split(self._key)
-        return sub
-
-    def act(self, obs, last_action, reward, done, core_state):
-        """Central batched inference for a [B, ...] slab of actor states."""
-        return self._act(
-            self.state.params,
-            jnp.asarray(obs),
-            jnp.asarray(last_action, jnp.int32),
-            jnp.asarray(reward, jnp.float32),
-            jnp.asarray(done, jnp.bool_),
-            core_state,
-            self._next_key(),
-        )
-
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
-        B = np.asarray(obs).shape[0]
-        a, _, _ = self.act(
-            obs,
-            np.zeros(B, np.int32),
-            np.zeros(B, np.float32),
-            np.zeros(B, bool),
-            self.initial_state(B),
-        )
-        return np.asarray(a)
-
-    def predict(self, obs: np.ndarray) -> np.ndarray:
-        B = np.asarray(obs).shape[0]
-        return np.asarray(
-            self._act_greedy(
-                self.state.params,
-                jnp.asarray(obs),
-                jnp.zeros(B, jnp.int32),
-                jnp.zeros(B, jnp.float32),
-                jnp.zeros(B, bool),
-                self.initial_state(B),
-            )
-        )
-
-    def learn(self, traj: Trajectory) -> Dict[str, float]:
-        self.state, metrics = self._learn(self.state, traj)
-        return {k: float(v) for k, v in metrics.items()}
-
-    def get_weights(self):
-        return self.state.params
-
-    def set_weights(self, weights) -> None:
-        self.state = self.state.replace(params=weights)
-
-    def save_checkpoint(self, path: str) -> str:
-        return save_checkpoint(path, self.state)
-
-    def load_checkpoint(self, path: str) -> None:
-        self.state = load_checkpoint(path, self.state)

@@ -1,0 +1,146 @@
+"""Shared scaffolding for policy-value agents (IMPALA, A3C, ...).
+
+Every actor-learner agent drives the uniform recurrent-policy signature
+(``models/policy.py``) and needs the same host plumbing: dummy-shape param
+init, a jitted sampling/greedy act pair, a thread-safe RNG stream (multiple
+actor threads call ``act`` concurrently), train-state stepping, and weight
+pub / checkpoint methods.  Subclasses supply the model, the optimizer, and
+the pure learn function; everything else lives here once.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+class PolicyValueAgent(BaseAgent):
+    """Host-facing agent over a recurrent policy-value model.
+
+    Subclass contract: call ``_setup(...)`` from ``__init__`` with the built
+    model, optimizer, train-state constructor, and learn fn.
+    """
+
+    def _setup(
+        self,
+        model,
+        optimizer,
+        make_state: Callable[[Any, Any], Any],  # (params, opt_state) -> TrainState
+        learn_fn: Callable,
+        obs_shape: Tuple[int, ...],
+        num_actions: int,
+        obs_dtype,
+        seed: int,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        key = key if key is not None else jax.random.PRNGKey(seed)
+        self._key = key
+        self._key_lock = threading.Lock()
+
+        self.model = model
+        T1, B = 2, 1
+        dummy_obs = jnp.zeros((T1, B) + self.obs_shape, obs_dtype)
+        dummy_a = jnp.zeros((T1, B), jnp.int32)
+        dummy_r = jnp.zeros((T1, B), jnp.float32)
+        dummy_d = jnp.zeros((T1, B), jnp.bool_)
+        core = model.initial_state(B)
+        params = model.init(key, dummy_obs, dummy_a, dummy_r, dummy_d, core)
+
+        self.optimizer = optimizer
+        self.state = make_state(params, optimizer.init(params))
+        self._learn = jax.jit(learn_fn)
+
+        def act(params, obs, last_action, reward, done, core_state, key):
+            """One acting step: obs [B, ...] -> sampled actions, logits, state."""
+            out, new_core = model.apply(
+                params, obs[None], last_action[None], reward[None], done[None], core_state
+            )
+            logits = out.policy_logits[0]
+            action = jax.random.categorical(key, logits, axis=-1)
+            return action, logits, new_core
+
+        self._act = jax.jit(act)
+        self._act_greedy = jax.jit(
+            lambda params, obs, last_action, reward, done, core_state: model.apply(
+                params, obs[None], last_action[None], reward[None], done[None], core_state
+            )[0].policy_logits[0].argmax(-1)
+        )
+
+    # ------------------------------------------------------------------
+    def initial_state(self, batch_size: int):
+        return self.model.initial_state(batch_size)
+
+    def _next_key(self) -> jax.Array:
+        # multiple actor threads call act() concurrently (actor_learner.py);
+        # an unsynchronized read-split-write would hand two actors the same key
+        with self._key_lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def act(self, obs, last_action, reward, done, core_state):
+        """Central batched inference for a [B, ...] slab of actor lanes."""
+        return self._act(
+            self.state.params,
+            jnp.asarray(obs),
+            jnp.asarray(last_action, jnp.int32),
+            jnp.asarray(reward, jnp.float32),
+            jnp.asarray(done, jnp.bool_),
+            core_state,
+            self._next_key(),
+        )
+
+    def get_action(self, obs: np.ndarray) -> np.ndarray:
+        B = np.asarray(obs).shape[0]
+        a, _, _ = self.act(
+            obs,
+            np.zeros(B, np.int32),
+            np.zeros(B, np.float32),
+            np.zeros(B, bool),
+            self.initial_state(B),
+        )
+        return np.asarray(a)
+
+    def predict(self, obs: np.ndarray) -> np.ndarray:
+        B = np.asarray(obs).shape[0]
+        return np.asarray(
+            self._act_greedy(
+                self.state.params,
+                jnp.asarray(obs),
+                jnp.zeros(B, jnp.int32),
+                jnp.zeros(B, jnp.float32),
+                jnp.zeros(B, bool),
+                self.initial_state(B),
+            )
+        )
+
+    def learn(self, traj) -> Dict[str, float]:
+        self.state, metrics = self._learn(self.state, traj)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self.state.params
+
+    def set_weights(self, weights) -> None:
+        self.state = self.state.replace(params=weights)
+
+    def save_checkpoint(self, path: str) -> str:
+        return save_checkpoint(path, self.state)
+
+    def load_checkpoint(self, path: str) -> None:
+        self.state = load_checkpoint(path, self.state)
+
+
+def frames_counter() -> jnp.ndarray:
+    """A zero env-frames counter in the widest enabled int dtype."""
+    return (
+        jnp.zeros((), jnp.int64) if jax.config.jax_enable_x64 else jnp.zeros((), jnp.int32)
+    )

@@ -146,8 +146,11 @@ class A3CArguments(RLArguments):
     value_loss_coef: float = 0.5
     entropy_coef: float = 0.01
     gae_lambda: float = 1.0
-    hidden_sizes: str = "128,128"
+    hidden_sizes: str = "128,128"  # MLP torso (flat obs)
+    use_lstm: bool = True  # pixel obs: conv+LSTM (a3c/utils/atari_model.py:57-144)
+    hidden_size: int = 256  # pixel obs: LSTM width (reference LSTMCell(256))
     max_episode_steps: int = 500
+    max_grad_norm: float = 50.0  # reference clip(50), parallel_a3c.py:368
 
 
 @dataclass

@@ -25,7 +25,6 @@ from scalerl_tpu.parallel.sharding import (
     batch_sharding_tree,
     param_sharding,
     replicated,
-    trajectory_sharding,
 )
 
 
@@ -52,9 +51,11 @@ def make_parallel_learn_fn(
     if batch_example is not None:
         data_sh = batch_sharding_tree(batch_example, mesh, time_major=batch_time_major)
     else:
-        data_sh = (
-            trajectory_sharding(mesh) if batch_time_major else batch_sharding(mesh)
-        )
+        # no example: leave the batch sharding UNSPECIFIED so jit follows
+        # whatever layout ``shard_batch`` committed.  A single broadcast
+        # NamedSharding would mis-shard mixed-layout pytrees (recurrent
+        # ``core_state`` leaves are [B, ...], not [T+1, B, ...]).
+        data_sh = None
     rep = replicated(mesh)
 
     jitted = jax.jit(
@@ -68,9 +69,12 @@ def make_parallel_learn_fn(
         return jax.device_put(state, st_sh)
 
     def shard_batch(batch: Any) -> Any:
-        if batch_example is not None:
-            return jax.device_put(batch, data_sh)
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, data_sh), batch)
+        sh = (
+            data_sh
+            if data_sh is not None
+            else batch_sharding_tree(batch, mesh, time_major=batch_time_major)
+        )
+        return jax.device_put(batch, sh)
 
     jitted.shard_state = shard_state  # type: ignore[attr-defined]
     jitted.shard_batch = shard_batch  # type: ignore[attr-defined]

@@ -1,2 +1,3 @@
 from scalerl_tpu.trainer.base import BaseTrainer  # noqa: F401
 from scalerl_tpu.trainer.off_policy import OffPolicyTrainer  # noqa: F401
+from scalerl_tpu.trainer.on_policy import OnPolicyTrainer  # noqa: F401

@@ -1,0 +1,180 @@
+"""On-policy trainer: the A3C/A2C runtime over a vector-env actor fleet.
+
+Parity target: ``ParallelA3C.run`` (``scalerl/algorithms/a3c/parallel_a3c.py:
+468-507``) — N rollout workers plus one evaluator — re-architected for TPU:
+the N worker processes' env lanes become one vector env; per-worker CPU
+forwards become one central jitted batched inference; the Hogwild gradient
+hand-off becomes one synchronous batched update (see ``agents/a3c.py``).
+
+The rollout loop maintains the universal ``[T+1, B]`` trajectory layout
+(row t holds obs[t] plus the last-action/reward/done *leading into* it), so
+recurrent policies carry their LSTM state across chunk boundaries exactly
+like the IMPALA path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from scalerl_tpu.agents.a3c import A3CAgent
+from scalerl_tpu.config import A3CArguments
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.metrics import EpisodeMetrics
+
+
+class OnPolicyTrainer(BaseTrainer):
+    def __init__(
+        self,
+        args: A3CArguments,
+        agent: A3CAgent,
+        train_envs,
+        eval_envs=None,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.train_envs = train_envs
+        self.eval_envs = eval_envs
+        self.num_envs = getattr(train_envs, "num_envs", 1)
+        self.global_step = 0
+        self.learn_steps = 0
+        self.metrics = EpisodeMetrics(self.num_envs)
+
+    # ------------------------------------------------------------------
+    def collect_rollout(self, obs, last_action, last_reward, last_done, core_state):
+        """Advance the fleet ``rollout_length`` steps; returns the trajectory
+        chunk plus the carried state for the next chunk."""
+        T = self.args.rollout_length
+        B = self.num_envs
+        obs_buf = np.zeros((T + 1, B) + obs.shape[1:], dtype=np.asarray(obs).dtype)
+        act_buf = np.zeros((T + 1, B), np.int32)
+        rew_buf = np.zeros((T + 1, B), np.float32)
+        done_buf = np.zeros((T + 1, B), bool)
+        logits_buf = np.zeros((T + 1, B, self.agent.num_actions), np.float32)
+
+        obs_buf[0] = obs
+        act_buf[0] = last_action
+        rew_buf[0] = last_reward
+        done_buf[0] = last_done
+        entering_core = core_state
+
+        for t in range(T):
+            action, logits, core_state = self.agent.act(
+                obs, act_buf[t], rew_buf[t], done_buf[t], core_state
+            )
+            action = np.asarray(action)
+            logits_buf[t] = np.asarray(logits)
+            next_obs, reward, term, trunc, _ = self.train_envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_buf[t + 1] = next_obs
+            act_buf[t + 1] = action
+            rew_buf[t + 1] = reward
+            done_buf[t + 1] = done
+            self.metrics.step(reward, done)
+            obs = next_obs
+            self.global_step += B
+
+        # row T logits stay zero: no consumer reads them (the A2C loss
+        # recomputes logits from params and reads behavior rows [:-1] only)
+        traj = Trajectory(
+            obs=jax.numpy.asarray(obs_buf),
+            action=jax.numpy.asarray(act_buf),
+            reward=jax.numpy.asarray(rew_buf),
+            done=jax.numpy.asarray(done_buf),
+            logits=jax.numpy.asarray(logits_buf),
+            core_state=entering_core,
+        )
+        carry = (obs, act_buf[T], rew_buf[T], done_buf[T], core_state)
+        return traj, carry
+
+    def run_evaluate_episodes(self, n_episodes: Optional[int] = None) -> Dict[str, float]:
+        """Greedy evaluation (the reference's dedicated eval process,
+        ``parallel_a3c.py:391-447``, inlined between updates)."""
+        envs = self.eval_envs or self.train_envs
+        n_episodes = n_episodes or self.args.eval_episodes
+        num_envs = getattr(envs, "num_envs", 1)
+        obs, _ = envs.reset(seed=self.args.seed + 100)
+        returns: list = []
+        ep_ret = np.zeros(num_envs)
+        ep_len = np.zeros(num_envs, int)
+        while len(returns) < n_episodes:
+            actions = self.agent.predict(obs)
+            obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
+            ep_ret += reward
+            ep_len += 1
+            done = np.logical_or(term, trunc)
+            for i in np.nonzero(done)[0]:
+                returns.append((ep_ret[i], ep_len[i]))
+                ep_ret[i] = 0.0
+                ep_len[i] = 0
+        rets = np.array([r for r, _ in returns[:n_episodes]])
+        lens = np.array([l for _, l in returns[:n_episodes]])
+        return {
+            "reward_mean": float(rets.mean()),
+            "reward_std": float(rets.std()),
+            "length_mean": float(lens.mean()),
+        }
+
+    # ------------------------------------------------------------------
+    def run(self) -> Dict[str, float]:
+        args = self.args
+        B = self.num_envs
+        obs, _ = self.train_envs.reset(seed=args.seed)
+        carry = (
+            obs,
+            np.zeros(B, np.int32),
+            np.zeros(B, np.float32),
+            np.zeros(B, bool),
+            self.agent.initial_state(B),
+        )
+        start = time.time()
+        last_log = 0
+        last_eval = 0
+        last_save = 0
+        train_info: Dict[str, float] = {}
+
+        while self.global_step < args.max_timesteps:
+            traj, carry = self.collect_rollout(*carry)
+            train_info = self.agent.learn(traj)
+            self.learn_steps += 1
+
+            if self.global_step - last_log >= args.logger_frequency:
+                last_log = self.global_step
+                fps = int(self.global_step / max(time.time() - start, 1e-8))
+                summary = self.metrics.summary()
+                info = {**train_info, "fps": fps, "learn_steps": self.learn_steps, **summary}
+                self.logger.log_train_data(info, self.global_step)
+                if self.is_main_process:
+                    ret = summary.get("return_mean", float("nan"))
+                    self.text_logger.info(
+                        f"step {self.global_step} | fps {fps} | return {ret:.1f} "
+                        f"| loss {train_info.get('total_loss', float('nan')):.4f}"
+                    )
+
+            if self.eval_envs is not None and self.global_step - last_eval >= args.eval_frequency:
+                last_eval = self.global_step
+                eval_info = self.run_evaluate_episodes()
+                self.logger.log_test_data(eval_info, self.global_step)
+                if self.is_main_process:
+                    self.text_logger.info(
+                        f"eval @ {self.global_step}: return "
+                        f"{eval_info['reward_mean']:.1f} +- {eval_info['reward_std']:.1f}"
+                    )
+
+            if (
+                args.save_model
+                and not args.disable_checkpoint
+                and self.global_step - last_save >= args.save_frequency
+            ):
+                last_save = self.global_step
+                if self.is_main_process:
+                    self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_{self.global_step}")
+
+        if args.save_model and not args.disable_checkpoint and self.is_main_process:
+            self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_final")
+        return self.metrics.summary()

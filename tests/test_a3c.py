@@ -1,0 +1,197 @@
+"""A3C family tests: loss math vs hand-computed fixtures, learn step,
+on-policy trainer e2e, and CartPole learning smoke."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.agents.a3c import (
+    A3CAgent,
+    a3c_loss,
+    build_model,
+    make_a3c_learn_fn,
+    make_a3c_optimizer,
+)
+from scalerl_tpu.config import A3CArguments
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OnPolicyTrainer
+
+
+def _args(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        rollout_length=8,
+        num_workers=4,
+        hidden_sizes="32,32",
+        logger_backend="none",
+        save_model=False,
+    )
+    base.update(kw)
+    return A3CArguments(**base)
+
+
+def _random_traj(key, T, B, A, obs_dim=4):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return Trajectory(
+        obs=jax.random.normal(k1, (T + 1, B, obs_dim)),
+        action=jax.random.randint(k2, (T + 1, B), 0, A),
+        reward=jax.random.normal(k3, (T + 1, B)),
+        done=jax.random.bernoulli(k4, 0.1, (T + 1, B)),
+        logits=jnp.zeros((T + 1, B, A)),
+        core_state=(),
+    )
+
+
+def test_a3c_loss_matches_numpy_fixture():
+    """The A2C objective vs a from-scratch numpy computation (GAE lambda=1
+    reduces to discounted-return advantages, parallel_a3c.py:251-262)."""
+    args = _args(gae_lambda=1.0, gamma=0.9, value_loss_coef=0.5, entropy_coef=0.01)
+    agent = A3CAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = 3, 2
+    traj = _random_traj(jax.random.PRNGKey(1), T, B, 2)
+    loss, metrics = a3c_loss(
+        agent.state.params,
+        agent.model,
+        traj,
+        gamma=args.gamma,
+        gae_lambda=args.gae_lambda,
+        value_loss_coef=args.value_loss_coef,
+        entropy_coef=args.entropy_coef,
+    )
+
+    out, _ = agent.model.apply(
+        agent.state.params, traj.obs, traj.action, traj.reward, traj.done, ()
+    )
+    logits = np.asarray(out.policy_logits, np.float64)
+    values = np.asarray(out.baseline, np.float64)
+    rewards = np.asarray(traj.reward[1:], np.float64)
+    done = np.asarray(traj.done[1:], np.float64)
+    actions = np.asarray(traj.action[1:])
+    disc = args.gamma * (1.0 - done)
+
+    # backward discounted returns seeded with the bootstrap value
+    R = values[-1].copy()
+    returns = np.zeros((T, B))
+    for t in reversed(range(T)):
+        R = rewards[t] + disc[t] * R
+        returns[t] = R
+    adv = returns - values[:-1]
+
+    logp = logits - jax.nn.logsumexp(jnp.asarray(logits), axis=-1, keepdims=True)
+    logp = np.asarray(logp, np.float64)
+    nll = -np.take_along_axis(logp[:-1], actions[..., None], axis=-1)[..., 0]
+    pg_ref = np.sum(nll * adv)
+    vl_ref = args.value_loss_coef * 0.5 * np.sum(adv**2)
+    p = np.exp(logp[:-1])
+    ent_ref = args.entropy_coef * np.sum(p * logp[:-1])
+
+    np.testing.assert_allclose(float(metrics["pg_loss"]), pg_ref, rtol=1e-4)
+    np.testing.assert_allclose(float(metrics["value_loss"]), vl_ref, rtol=1e-4)
+    np.testing.assert_allclose(float(metrics["entropy_loss"]), ent_ref, rtol=1e-4)
+    np.testing.assert_allclose(float(loss), pg_ref + vl_ref + ent_ref, rtol=1e-4)
+
+
+def test_a3c_learn_step_updates_state():
+    args = _args()
+    agent = A3CAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = args.rollout_length, 4
+    traj = _random_traj(jax.random.PRNGKey(0), T, B, 2)
+    m1 = agent.learn(traj)
+    m2 = agent.learn(traj)
+    assert np.isfinite(m1["total_loss"]) and np.isfinite(m2["total_loss"])
+    assert m1["total_loss"] != m2["total_loss"]
+    assert int(agent.state.step) == 2
+    assert int(agent.state.env_frames) == 2 * T * B
+
+
+def test_a3c_pixel_lstm_model():
+    args = _args(use_lstm=True, hidden_size=64)
+    model = build_model(args, (84, 84, 4), 6)
+    T1, B = 3, 2
+    obs = jnp.zeros((T1, B, 84, 84, 4), jnp.uint8)
+    core = model.initial_state(B)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        obs,
+        jnp.zeros((T1, B), jnp.int32),
+        jnp.zeros((T1, B), jnp.float32),
+        jnp.zeros((T1, B), bool),
+        core,
+    )
+    out, new_core = model.apply(
+        params,
+        obs,
+        jnp.zeros((T1, B), jnp.int32),
+        jnp.zeros((T1, B), jnp.float32),
+        jnp.zeros((T1, B), bool),
+        core,
+    )
+    assert out.policy_logits.shape == (T1, B, 6)
+    assert out.baseline.shape == (T1, B)
+    assert jax.tree_util.tree_structure(new_core) == jax.tree_util.tree_structure(core)
+
+
+def test_a3c_gradient_direction():
+    """Positive-advantage actions should get their probability pushed up."""
+    args = _args(entropy_coef=0.0, value_loss_coef=0.0, gae_lambda=1.0)
+    agent = A3CAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = 4, 2
+    traj = Trajectory(
+        obs=jnp.ones((T + 1, B, 4)),
+        action=jnp.ones((T + 1, B), jnp.int32),
+        reward=jnp.ones((T + 1, B)),  # all-positive rewards -> positive advantage early
+        done=jnp.zeros((T + 1, B), bool),
+        logits=jnp.zeros((T + 1, B, 2)),
+        core_state=(),
+    )
+
+    def probs(params):
+        out, _ = agent.model.apply(params, traj.obs, traj.action, traj.reward, traj.done, ())
+        return jax.nn.softmax(out.policy_logits)[..., 1].mean()
+
+    learn = jax.jit(make_a3c_learn_fn(agent.model, agent.optimizer, args))
+    p_before = float(probs(agent.state.params))
+    state = agent.state
+    for _ in range(5):
+        state, _ = learn(state, traj)
+    p_after = float(probs(state.params))
+    assert p_after > p_before
+
+
+def test_on_policy_trainer_cartpole_smoke(tmp_path):
+    args = _args(
+        max_timesteps=2000,
+        logger_frequency=500,
+        eval_frequency=10**9,
+        work_dir=str(tmp_path),
+        num_workers=4,
+        rollout_length=16,
+        learning_rate=3e-3,
+    )
+    envs = make_vect_envs(args.env_id, num_envs=args.num_workers, seed=0, async_envs=False)
+    agent = A3CAgent(
+        args,
+        obs_shape=envs.single_observation_space.shape,
+        num_actions=envs.single_action_space.n,
+    )
+    trainer = OnPolicyTrainer(args, agent, envs)
+    try:
+        summary = trainer.run()
+        assert trainer.global_step >= args.max_timesteps
+        assert trainer.learn_steps > 0
+        assert np.isfinite(summary.get("return_mean", np.nan))
+        eval_info = trainer.run_evaluate_episodes(n_episodes=2)
+        assert np.isfinite(eval_info["reward_mean"])
+    finally:
+        trainer.close()
+        envs.close()
+
+
+def test_a3c_optimizer_clips():
+    args = _args(max_grad_norm=1e-6)
+    opt = make_a3c_optimizer(args)
+    params = {"w": jnp.ones(4)}
+    state = opt.init(params)
+    updates, _ = opt.update({"w": jnp.full(4, 1e3)}, state, params)
+    assert float(jnp.linalg.norm(updates["w"])) < 1.0
