@@ -1,0 +1,55 @@
+"""Ape-X DQN on CartPole: N prioritized actors + one PER learner.
+
+Parity target: the reference's (import-broken) Ape-X entry
+(``scalerl/algorithms/apex/apex_train.py``), working and TPU-shaped — see
+``scalerl_tpu/trainer/apex.py``.
+
+Usage::
+
+    python examples/train_apex.py --num-actors 4 --max-timesteps 100000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import DQNAgent
+from scalerl_tpu.config import ApexArguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer.apex import ApexTrainer
+
+
+def main() -> None:
+    args = parse_args(ApexArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+
+    def make_envs(actor_id: int):
+        return make_vect_envs(
+            args.env_id, num_envs=args.num_envs, seed=args.seed + 1000 * actor_id
+        )
+
+    eval_envs = make_vect_envs(args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False)
+    probe = make_envs(0)
+    agent = DQNAgent(
+        args,
+        obs_shape=probe.single_observation_space.shape,
+        action_dim=probe.single_action_space.n,
+        donate_state=False,  # actors read params concurrently with learn
+    )
+    probe.close()
+    trainer = ApexTrainer(args, agent, make_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,29 @@ def make_dqn_learn_fn(
     return learn
 
 
+def make_dqn_priority_fn(network: QNet, gamma: float, double_dqn: bool):
+    """Build the pure |TD-error| function actors use to compute initial
+    Ape-X priorities for their own transitions (``apex/worker.py:59-79``).
+
+    Shapes: obs/next_obs [B, ...], action/reward/done/n_steps [B].
+    """
+
+    def priority(params, target_params, obs, action, reward, next_obs, done, n_steps):
+        discounts = (1.0 - done.astype(jnp.float32)) * (
+            gamma ** n_steps.astype(jnp.float32)
+        )
+        q_next_online = network.apply(params, next_obs)
+        q_next_target = network.apply(target_params, next_obs)
+        targets = double_dqn_targets(
+            q_next_online, q_next_target, reward, discounts, double_dqn=double_dqn
+        )
+        q = network.apply(params, obs)
+        q_sa = jnp.take_along_axis(q, action.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+        return jnp.abs(q_sa - targets)
+
+    return priority
+
+
 class DQNAgent(BaseAgent):
     def __init__(
         self,
@@ -120,7 +143,11 @@ class DQNAgent(BaseAgent):
         obs_shape: Tuple[int, ...],
         action_dim: int,
         key: Optional[jax.Array] = None,
+        donate_state: bool = True,
     ) -> None:
+        # donate_state=False is required when actor threads read
+        # ``state.params`` concurrently with ``learn`` (Ape-X): donation
+        # invalidates the old param buffers mid-read.
         self.args = args
         self.action_dim = action_dim
         self.obs_shape = tuple(obs_shape)
@@ -173,7 +200,7 @@ class DQNAgent(BaseAgent):
                 soft_update_tau=args.soft_update_tau,
                 target_update_frequency=args.target_update_frequency,
             ),
-            donate_argnums=0,
+            donate_argnums=(0,) if donate_state else (),
         )
 
         def act(params, obs, eps, key):

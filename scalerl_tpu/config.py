@@ -218,10 +218,18 @@ class ApexArguments(DQNArguments):
     algo_name: str = "apex"
     use_per: bool = True
     num_actors: int = 4
-    actor_update_frequency: int = 100  # pull fresh weights every N env steps
+    actor_update_frequency: int = 100  # publish a weight snapshot every N learn steps
     priority_update_frequency: int = 1
     eps_greedy_base: float = 0.4
     eps_greedy_alpha: float = 7.0  # per-actor eps = base ** (1 + i/(N-1) * alpha)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.rollout_length < self.n_steps:
+            raise ValueError(
+                f"rollout_length ({self.rollout_length}) must be >= n_steps "
+                f"({self.n_steps}): actors fold n-step windows inside each chunk"
+            )
 
 
 # --------------------------------------------------------------------------

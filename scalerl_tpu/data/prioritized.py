@@ -61,6 +61,25 @@ def per_add(state: PrioritizedState, step) -> PrioritizedState:
     return state.replace(replay=replay, priorities=priorities)
 
 
+def per_add_with_priorities(
+    state: PrioritizedState,
+    step,
+    priorities: jnp.ndarray,  # [num_envs] raw priorities for this row
+) -> PrioritizedState:
+    """Add one vector step with caller-supplied initial priorities.
+
+    The Ape-X protocol: *actors* compute initial TD-error priorities for
+    their own transitions (``apex/worker.py:59-79``), so new rows enter the
+    distribution at their true priority instead of max.
+    """
+    pos = state.replay.pos
+    replay = replay_add(state.replay, step)
+    priorities = jnp.maximum(priorities.astype(jnp.float32), 1e-6)
+    new_prio = state.priorities.at[pos].set(priorities)
+    new_max = jnp.maximum(state.max_priority, jnp.max(priorities))
+    return state.replace(replay=replay, priorities=new_prio, max_priority=new_max)
+
+
 def _flat_physical(state: PrioritizedState, flat_logical: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Map flat logical indices (row-major over [logical_row, env]) to
     physical (row, env)."""
@@ -146,8 +165,11 @@ class PrioritizedReplayBuffer:
         alpha: float = 0.6,
         n_step: int = 1,
         gamma: float = 0.99,
+        extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
     ) -> None:
-        self.spec = transition_spec(obs_shape, obs_dtype)
+        self.spec = dict(transition_spec(obs_shape, obs_dtype))
+        if extra_fields:
+            self.spec.update(extra_fields)
         self.capacity = capacity
         self.num_envs = num_envs
         self.alpha = alpha
@@ -155,6 +177,7 @@ class PrioritizedReplayBuffer:
         self.gamma = gamma
         self.state = per_init(self.spec, capacity, num_envs)
         self._add = jax.jit(per_add, donate_argnums=0)
+        self._add_prio = jax.jit(per_add_with_priorities, donate_argnums=0)
         # alpha/beta are *traced* args: beta follows a per-step schedule and
         # making it static would recompile the sampler on every train step
         self._sample = jax.jit(
@@ -165,19 +188,28 @@ class PrioritizedReplayBuffer:
     def __len__(self) -> int:
         return int(self.state.replay.size) * self.num_envs
 
-    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
-        step = {
-            "obs": jnp.asarray(obs),
-            "next_obs": jnp.asarray(next_obs),
-            "action": jnp.asarray(action),
-            "reward": jnp.asarray(reward),
-            "done": jnp.asarray(done),
-        }
+    def _coerce_step(self, step: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        step = {k: jnp.asarray(v) for k, v in step.items()}
         for k, v in step.items():
             want = (self.num_envs,) + tuple(self.spec[k][0])
             if v.shape != want:
                 step[k] = v.reshape(want)
-        self.state = self._add(self.state, step)
+        return step
+
+    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
+        self.state = self._add(
+            self.state,
+            self._coerce_step(
+                {"obs": obs, "next_obs": next_obs, "action": action, "reward": reward, "done": done}
+            ),
+        )
+
+    def add_with_priorities(self, step: Dict[str, jnp.ndarray], priorities) -> None:
+        """Add one vector step (any spec fields) with actor-computed
+        priorities (the Ape-X insert path)."""
+        self.state = self._add_prio(
+            self.state, self._coerce_step(step), jnp.asarray(priorities, jnp.float32)
+        )
 
     def sample(self, batch_size: int, beta: float = 0.4, key: Optional[jax.Array] = None):
         if key is None:

@@ -146,7 +146,7 @@ def gather_transitions(
 
     row0 = rows[:, 0]
     row_last = jnp.take_along_axis(rows, last_idx[:, None], axis=1)[:, 0]
-    return {
+    batch = {
         "obs": state.storage["obs"][row0, envs],
         "action": state.storage["action"][row0, envs],
         "reward": reward_n,
@@ -155,6 +155,15 @@ def gather_transitions(
         "n_steps": (last_idx + 1).astype(jnp.int32),
         "indices": logical * num_envs + envs,  # flat logical index
     }
+    # Extra storage fields (beyond the standard five) pass through, gathered
+    # at the window head; a stored field may override a computed key — e.g.
+    # Ape-X actors store pre-folded transitions whose realized ``n_steps``
+    # must survive sampling (the buffer then runs with n_step=1).
+    standard = {"obs", "next_obs", "action", "reward", "done"}
+    for name, arr in state.storage.items():
+        if name not in standard:
+            batch[name] = arr[row0, envs]
+    return batch
 
 
 def replay_sample(

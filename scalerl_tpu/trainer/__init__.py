@@ -1,3 +1,4 @@
 from scalerl_tpu.trainer.base import BaseTrainer  # noqa: F401
 from scalerl_tpu.trainer.off_policy import OffPolicyTrainer  # noqa: F401
 from scalerl_tpu.trainer.on_policy import OnPolicyTrainer  # noqa: F401
+from scalerl_tpu.trainer.apex import ApexTrainer  # noqa: F401

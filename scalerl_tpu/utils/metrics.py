@@ -23,19 +23,28 @@ class EpisodeMetrics:
         self.episode_returns: List[float] = []
         self.episode_lengths: List[int] = []
 
-    def step(self, rewards: np.ndarray, dones: np.ndarray) -> int:
-        """Accumulate one vector step. Returns number of episodes completed."""
-        rewards = np.asarray(rewards, dtype=np.float64).reshape(self.num_envs)
-        dones = np.asarray(dones).reshape(self.num_envs).astype(bool)
-        self._returns += rewards
-        self._lengths += 1
+    def step(self, rewards: np.ndarray, dones: np.ndarray, lane0: int = 0) -> int:
+        """Accumulate one vector step. Returns number of episodes completed.
+
+        ``lane0`` lets a sub-fleet (e.g. one Ape-X actor's env slab) update
+        only its own contiguous lane block; different actors touch disjoint
+        lanes, so concurrent threaded updates stay well-defined.
+        """
+        rewards = np.asarray(rewards, dtype=np.float64).ravel()
+        width = rewards.shape[0]
+        dones = np.asarray(dones).reshape(width).astype(bool)
+        lanes = slice(lane0, lane0 + width)
+        self._returns[lanes] += rewards
+        self._lengths[lanes] += 1
         finished = int(dones.sum())
         if finished:
             for i in np.nonzero(dones)[0]:
-                self.episode_returns.append(float(self._returns[i]))
-                self.episode_lengths.append(int(self._lengths[i]))
-            self._returns[dones] = 0.0
-            self._lengths[dones] = 0
+                self.episode_returns.append(float(self._returns[lane0 + i]))
+                self.episode_lengths.append(int(self._lengths[lane0 + i]))
+            ret_block = self._returns[lanes]
+            len_block = self._lengths[lanes]
+            ret_block[dones] = 0.0
+            len_block[dones] = 0
         return finished
 
     @property

@@ -1,0 +1,202 @@
+"""Ape-X tests: host n-step fold vs oracle, priority fn math, prioritized
+insert path, and the threaded actor/learner runtime e2e."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.agents.dqn import DQNAgent, make_dqn_priority_fn
+from scalerl_tpu.config import ApexArguments
+from scalerl_tpu.data.prioritized import PrioritizedReplayBuffer
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer.apex import ApexTrainer, fold_n_step
+
+
+def _args(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        num_actors=2,
+        num_envs=2,
+        rollout_length=10,
+        n_steps=3,
+        batch_size=16,
+        buffer_size=4096,
+        warmup_learn_steps=32,
+        hidden_sizes="32,32",
+        logger_backend="none",
+        save_model=False,
+        use_per=True,
+    )
+    base.update(kw)
+    return ApexArguments(**base)
+
+
+def test_fold_n_step_oracle():
+    """Host fold vs a brute-force per-window oracle (terminations and
+    truncations both cut the window; only terminations set done)."""
+    rng = np.random.default_rng(0)
+    T, W, n, gamma = 7, 3, 3, 0.9
+    obs = rng.normal(size=(T, W, 4)).astype(np.float32)
+    next_obs = rng.normal(size=(T, W, 4)).astype(np.float32)
+    action = rng.integers(0, 2, size=(T, W))
+    reward = rng.normal(size=(T, W)).astype(np.float32)
+    term = rng.random((T, W)) < 0.2
+    trunc = (rng.random((T, W)) < 0.15) & ~term
+
+    out = fold_n_step(obs, action, reward, next_obs, term, trunc, gamma, n)
+    m = T - n + 1
+    for t in range(m):
+        for w in range(W):
+            acc, disc, last = 0.0, 1.0, n - 1
+            for k in range(n):
+                acc += disc * reward[t + k, w]
+                if term[t + k, w] or trunc[t + k, w]:
+                    last = k
+                    break
+                disc *= gamma
+            i = t * W + w
+            np.testing.assert_allclose(out["reward"][i], acc, rtol=1e-5)
+            assert out["n_steps"][i] == last + 1
+            # done only when the window ended in a true termination
+            assert out["done"][i] == bool(term[t + last, w])
+            np.testing.assert_allclose(out["next_obs"][i], next_obs[t + last, w])
+            np.testing.assert_allclose(out["obs"][i], obs[t, w])
+            assert out["action"][i] == action[t, w]
+
+
+def test_fold_n_step_truncation_bootstraps_without_reward_leak():
+    """A window crossing a truncation stops there: no reward from the next
+    (autoreset) episode, done=False so the target still bootstraps from the
+    stashed final obs."""
+    T, W, n, gamma = 4, 1, 3, 0.5
+    obs = np.arange(T, dtype=np.float32).reshape(T, W, 1)
+    next_obs = 100.0 + np.arange(T, dtype=np.float32).reshape(T, W, 1)
+    action = np.zeros((T, W), np.int64)
+    reward = np.ones((T, W), np.float32)
+    term = np.zeros((T, W), bool)
+    trunc = np.zeros((T, W), bool)
+    trunc[1, 0] = True  # truncation at step 1
+
+    out = fold_n_step(obs, action, reward, next_obs, term, trunc, gamma, n)
+    # window at t=0: r0 + gamma*r1, stops at the truncation
+    np.testing.assert_allclose(out["reward"][0], 1.0 + gamma)
+    assert out["n_steps"][0] == 2
+    assert not out["done"][0]  # truncated -> bootstrap
+    np.testing.assert_allclose(out["next_obs"][0], next_obs[1, 0])
+
+
+def test_priority_fn_matches_manual_td():
+    args = _args()
+    agent = DQNAgent(args, obs_shape=(4,), action_dim=2, donate_state=False)
+    fn = jax.jit(make_dqn_priority_fn(agent.network, args.gamma, args.double_dqn))
+    B = 8
+    rng = np.random.default_rng(1)
+    obs = jnp.asarray(rng.normal(size=(B, 4)), jnp.float32)
+    next_obs = jnp.asarray(rng.normal(size=(B, 4)), jnp.float32)
+    action = jnp.asarray(rng.integers(0, 2, B))
+    reward = jnp.asarray(rng.normal(size=B), jnp.float32)
+    done = jnp.asarray(rng.random(B) < 0.3)
+    n_steps = jnp.asarray(rng.integers(1, 4, B), jnp.int32)
+
+    prio = fn(
+        agent.state.params, agent.state.target_params, obs, action, reward, next_obs, done, n_steps
+    )
+    q = agent.network.apply(agent.state.params, obs)
+    qn_online = agent.network.apply(agent.state.params, next_obs)
+    qn_target = agent.network.apply(agent.state.target_params, next_obs)
+    sel = jnp.argmax(qn_online, -1)
+    qn = jnp.take_along_axis(qn_target, sel[:, None], -1)[:, 0]
+    disc = (1.0 - done.astype(jnp.float32)) * args.gamma ** n_steps.astype(jnp.float32)
+    target = reward + disc * qn
+    q_sa = jnp.take_along_axis(q, jnp.asarray(action)[:, None], -1)[:, 0]
+    np.testing.assert_allclose(np.asarray(prio), np.abs(np.asarray(q_sa - target)), rtol=1e-5)
+
+
+def test_per_add_with_priorities_enters_distribution():
+    buf = PrioritizedReplayBuffer(
+        obs_shape=(2,), capacity=8, num_envs=4, alpha=1.0, extra_fields={"n_steps": ((), jnp.int32)}
+    )
+    hot = {
+        "obs": np.ones((4, 2), np.float32),
+        "next_obs": np.ones((4, 2), np.float32),
+        "action": np.ones(4, np.int32),
+        "reward": np.ones(4, np.float32),
+        "done": np.zeros(4, bool),
+        "n_steps": np.full(4, 2, np.int32),
+    }
+    cold = {k: np.zeros_like(v) for k, v in hot.items()}
+    buf.add_with_priorities(cold, np.full(4, 1e-6))
+    buf.add_with_priorities(hot, np.full(4, 100.0))
+    batch = buf.sample(32, beta=1.0, key=jax.random.PRNGKey(0))
+    # hot row dominates the proportional distribution
+    assert float(batch["reward"].mean()) > 0.9
+    # stored n_steps field survives sampling (not the computed window length)
+    assert set(np.asarray(batch["n_steps"]).tolist()) <= {0, 2}
+    assert np.all(np.isfinite(np.asarray(batch["weights"])))
+
+
+def test_apex_trainer_e2e_learns_cartpole(tmp_path):
+    args = _args(
+        max_timesteps=6000,
+        logger_frequency=1000,
+        eval_frequency=10**9,
+        work_dir=str(tmp_path),
+        learning_rate=3e-3,
+    )
+
+    def make_envs(actor_id):
+        return make_vect_envs(
+            args.env_id, num_envs=args.num_envs, seed=args.seed + actor_id, async_envs=False
+        )
+
+    agent = DQNAgent(args, obs_shape=(4,), action_dim=2, donate_state=False)
+    eval_envs = make_vect_envs(args.env_id, num_envs=2, seed=123, async_envs=False)
+    trainer = ApexTrainer(args, agent, make_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        assert trainer.global_step >= args.max_timesteps
+        assert trainer.learn_steps > 0
+        assert len(trainer.buffer) > 0
+        assert summary.get("episodes", 0) > 0
+        assert trainer.param_server.version >= 1
+        eval_info = trainer.run_evaluate_episodes(n_episodes=2)
+        assert np.isfinite(eval_info["reward_mean"])
+    finally:
+        trainer.close()
+        eval_envs.close()
+
+
+def test_apex_actor_crash_funnels():
+    args = _args(max_timesteps=10**9)
+
+    class Boom:
+        num_envs = 2
+        single_observation_space = None
+
+        def reset(self, seed=None):
+            raise RuntimeError("env exploded")
+
+        def close(self):
+            pass
+
+    def make_envs(actor_id):
+        if actor_id == 0:
+            env = make_vect_envs(args.env_id, num_envs=2, seed=0, async_envs=False)
+            return env
+        return Boom()
+
+    # Boom lacks single_observation_space shape; give trainer a real env first
+    envs0 = make_vect_envs(args.env_id, num_envs=2, seed=0, async_envs=False)
+
+    def make_envs2(actor_id):
+        return envs0 if actor_id == 0 else Boom()
+
+    agent = DQNAgent(args, obs_shape=(4,), action_dim=2, donate_state=False)
+    trainer = ApexTrainer(args, agent, make_envs2)
+    try:
+        import pytest
+
+        with pytest.raises(RuntimeError, match="apex actor 1 crashed"):
+            trainer.run()
+    finally:
+        trainer.close()
