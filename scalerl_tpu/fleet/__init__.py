@@ -1,0 +1,57 @@
+"""DCN actor-fleet layer: off-mesh CPU actors feeding the TPU learner host.
+
+Capability parity with ``scalerl/hpc/`` (SURVEY.md §2.1): framed transport,
+connection hub, job executor, worker/gather/server fleet protocol with entry
+handshake + weight caching + batched uploads, and turn-based episode
+generation — rebuilt on a flat binary codec instead of pickle.
+"""
+
+from scalerl_tpu.fleet.cluster import (
+    FleetConfig,
+    Gather,
+    LocalCluster,
+    RemoteCluster,
+    WorkerServer,
+    worker_loop,
+)
+from scalerl_tpu.fleet.framing import pack_message, unpack_message
+from scalerl_tpu.fleet.generation import (
+    EpisodeGenerator,
+    discounted_returns,
+    make_generation_runner,
+    masked_softmax,
+)
+from scalerl_tpu.fleet.hub import JobExecutor, QueueHub
+from scalerl_tpu.fleet.transport import (
+    Connection,
+    PipeConnection,
+    SocketConnection,
+    connect_socket,
+    listen_socket,
+    open_worker_pipes,
+    send_recv,
+)
+
+__all__ = [
+    "FleetConfig",
+    "Gather",
+    "LocalCluster",
+    "RemoteCluster",
+    "WorkerServer",
+    "worker_loop",
+    "pack_message",
+    "unpack_message",
+    "EpisodeGenerator",
+    "discounted_returns",
+    "make_generation_runner",
+    "masked_softmax",
+    "JobExecutor",
+    "QueueHub",
+    "Connection",
+    "PipeConnection",
+    "SocketConnection",
+    "connect_socket",
+    "listen_socket",
+    "open_worker_pipes",
+    "send_recv",
+]

@@ -1,0 +1,496 @@
+"""Actor-fleet protocol: workers, gathers, server, local/remote clusters.
+
+Parity target: ``scalerl/hpc/worker.py`` (27-352) — the HandyRL-style fleet
+that the reference vendors import-broken (SURVEY.md §2.1 caveat): a server
+hands out rollout/eval tasks, per-host *gathers* fan 16-ish workers into one
+uplink with task prefetch, model-blob caching, and batched result upload;
+remote hosts join via an entry handshake.
+
+TPU-shaped differences: this is the DCN control plane for **off-mesh CPU
+actors** feeding a central TPU learner host (SEED-RL topology).  Weights are
+versioned snapshots from ``runtime.param_server.ParameterServer`` (the
+reference fetched models by monotonically increasing id with an unbounded
+cache; here a gather caches only the newest version).  All payloads ride the
+flat binary codec, with zlib on the rollout uplink.
+
+Wire protocol (dicts over ``fleet.transport.Connection``):
+
+    worker→gather   {"kind": "task"}                      request next task
+                    {"kind": "params", "have": v}         fetch weights if stale
+                    {"kind": "result", "v": {...}}        one episode result
+    gather→server   {"kind": "task_batch", "n": k}        prefetch k tasks
+                    {"kind": "params", "have": v}
+                    {"kind": "result_batch", "v": [...]}  batched upload
+    server→gather   {"kind": "task_batch", "v": [t...]}   t=None means stop
+                    {"kind": "params", "version": v, "weights": tree}
+    entry handshake {"kind": "entry", "num_workers": n, "host": h}
+                    → {"kind": "entry_ack", "base_worker_id": b, "config": {...}}
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from scalerl_tpu.fleet.hub import QueueHub
+from scalerl_tpu.fleet.transport import (
+    Connection,
+    PipeConnection,
+    accept_connection,
+    connect_socket,
+    listen_socket,
+    open_worker_pipes,
+    send_recv,
+    wait_readable,
+)
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ENTRY_PORT = 9999
+WORKER_PORT = 9998
+
+# EpisodeRunner: (task dict, weights pytree, worker_id) -> result dict
+EpisodeRunner = Callable[[Dict[str, Any], Any, int], Dict[str, Any]]
+
+
+@dataclass
+class FleetConfig:
+    num_workers: int = 4
+    workers_per_gather: int = 16
+    task_prefetch: int = 0          # 0 → 1 + workers/4, like the reference
+    upload_batch: int = 4           # results batched per uplink message
+    compress_uplink: bool = True
+    entry_port: int = ENTRY_PORT
+    worker_port: int = WORKER_PORT
+    server_host: str = "127.0.0.1"
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def num_gathers(self) -> int:
+        return 1 + max(0, self.num_workers - 1) // self.workers_per_gather
+
+    def prefetch(self, workers: int) -> int:
+        return self.task_prefetch or 1 + workers // 4
+
+
+# ---------------------------------------------------------------------------
+# worker
+
+
+def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None:
+    """Task loop: parity with ``Worker.run`` (``hpc/worker.py:96-120``)."""
+    weights: Any = None
+    version = -1
+    try:
+        while True:
+            task = send_recv(conn, {"kind": "task"})
+            if task is None:
+                break
+            want = int(task.get("param_version", -1))
+            if want >= 0 and want != version:
+                reply = send_recv(conn, {"kind": "params", "have": version})
+                if reply is not None:
+                    version = int(reply["version"])
+                    weights = reply["weights"]
+            result = runner(task, weights, worker_id)
+            result["worker_id"] = worker_id
+            result["param_version"] = version
+            conn.send({"kind": "result", "v": result})
+    except (EOFError, OSError, ConnectionError, KeyboardInterrupt):
+        pass
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# gather
+
+
+class Gather:
+    """Per-host fan-in proxy: parity with ``Gather.run`` (``hpc/worker.py:153-232``)."""
+
+    def __init__(
+        self,
+        server_conn: Connection,
+        config: FleetConfig,
+        runner: EpisodeRunner,
+        base_worker_id: int,
+        num_workers: int,
+    ) -> None:
+        self.server = server_conn
+        self.config = config
+        self.tasks: "queue.Queue[Any]" = queue.Queue()
+        self.results: List[Dict[str, Any]] = []
+        self._params_version = -1
+        self._params_msg: Any = None
+        self.worker_conns, self.worker_procs = open_worker_pipes(
+            num_workers,
+            worker_loop,
+            lambda i: (base_worker_id + i, runner),
+        )
+        # task source exhausted: serve None to further requests, but keep
+        # running until every worker has drained its final result and closed
+        self._exhausted = False
+
+    def run(self) -> None:
+        try:
+            while self.worker_conns:
+                ready, dead = wait_readable(self.worker_conns, timeout=0.02)
+                for conn in dead:
+                    self.worker_conns.remove(conn)
+                for conn in ready:
+                    try:
+                        msg = conn.recv()
+                    except (EOFError, OSError, ConnectionError):
+                        self.worker_conns.remove(conn)
+                        continue
+                    self._handle(conn, msg)
+        finally:
+            self._flush_results()
+            for c in self.worker_conns:
+                c.close()
+
+    def _handle(self, conn: Connection, msg: Dict[str, Any]) -> None:
+        kind = msg["kind"]
+        if kind == "task":
+            if self.tasks.empty() and not self._exhausted:
+                n = self.config.prefetch(len(self.worker_conns))
+                batch = send_recv(self.server, {"kind": "task_batch", "n": n})
+                for t in batch["v"]:
+                    self.tasks.put(t)
+            task = None if self._exhausted else self.tasks.get()
+            if task is None:
+                self._exhausted = True
+            conn.send(task)
+        elif kind == "params":
+            have = int(msg["have"])
+            if self._params_version < 0 or have == self._params_version:
+                # cache miss (or worker already at our version → check server)
+                reply = send_recv(
+                    self.server, {"kind": "params", "have": self._params_version}
+                )
+                if reply is not None:
+                    self._params_version = int(reply["version"])
+                    self._params_msg = reply
+            if self._params_msg is not None and have != self._params_version:
+                conn.send(self._params_msg)
+            else:
+                conn.send(None)
+        elif kind == "result":
+            self.results.append(msg["v"])
+            if len(self.results) >= self.config.upload_batch:
+                self._flush_results()
+        else:
+            logger.warning("gather: unknown message kind %r", kind)
+
+    def _flush_results(self) -> None:
+        if self.results:
+            self.server.send(
+                {"kind": "result_batch", "v": self.results},
+                compress=self.config.compress_uplink,
+            )
+            self.results = []
+
+
+def gather_main(
+    server_conn: Connection,
+    config: FleetConfig,
+    runner: EpisodeRunner,
+    base_worker_id: int,
+    num_workers: int,
+) -> None:
+    try:
+        Gather(server_conn, config, runner, base_worker_id, num_workers).run()
+    except (KeyboardInterrupt, ConnectionError, EOFError, OSError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+class WorkerServer:
+    """Learner-side fleet endpoint.
+
+    Parity with ``WorkerServer`` + ``ParameterServer`` capability
+    (``hpc/worker.py:269-297``, ``hpc/parameter_server.py``): an entry
+    listener hands out worker-id ranges to remote hosts; a worker listener
+    feeds gather connections into a ``QueueHub``; the trainer publishes
+    weights and drains episode results.
+    """
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        task_source: Callable[[], Optional[Dict[str, Any]]],
+        result_maxsize: int = 4096,
+    ) -> None:
+        self.config = config
+        self.task_source = task_source
+        self.params = ParameterServer()
+        self.hub = QueueHub()
+        self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
+        self.total_results = 0
+        self.dropped_results = 0
+        self._next_worker_id = 0
+        self._id_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._server_socks: List[Any] = []
+
+    # -- trainer API ---------------------------------------------------
+    def publish(self, weights: Any) -> int:
+        return self.params.push(weights)
+
+    def get_result(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        try:
+            return self.results.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def assign_worker_ids(self, n: int) -> int:
+        with self._id_lock:
+            base = self._next_worker_id
+            self._next_worker_id += n
+            return base
+
+    # -- bring-up ------------------------------------------------------
+    def start(self, listen: bool = False) -> None:
+        self._threads.append(
+            threading.Thread(target=self._serve_loop, daemon=True)
+        )
+        if listen:
+            entry = listen_socket(self.config.entry_port)
+            workers = listen_socket(self.config.worker_port)
+            self._server_socks = [entry, workers]
+            self._threads.append(
+                threading.Thread(target=self._entry_loop, args=(entry,), daemon=True)
+            )
+            self._threads.append(
+                threading.Thread(target=self._accept_loop, args=(workers,), daemon=True)
+            )
+        for t in self._threads:
+            t.start()
+
+    def add_gather_connection(self, conn: Connection) -> None:
+        self.hub.add_connection(conn)
+
+    def _entry_loop(self, sock) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = accept_connection(sock, timeout=0.5)
+            except (TimeoutError, OSError):
+                continue
+            try:
+                msg = conn.recv(timeout=10.0)
+                n = int(msg["num_workers"])
+                base = self.assign_worker_ids(n)
+                conn.send(
+                    {
+                        "kind": "entry_ack",
+                        "base_worker_id": base,
+                        "config": {
+                            "workers_per_gather": self.config.workers_per_gather,
+                            "upload_batch": self.config.upload_batch,
+                            "worker_port": self.config.worker_port,
+                            "extra": self.config.extra,
+                        },
+                    }
+                )
+            except Exception:
+                logger.exception("entry handshake failed")
+            finally:
+                conn.close()
+
+    def _accept_loop(self, sock) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = accept_connection(sock, timeout=0.5)
+            except (TimeoutError, OSError):
+                continue
+            self.hub.add_connection(conn)
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, msg = self.hub.recv(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(conn, msg)
+            except Exception:
+                logger.exception("server: failed handling %r", msg.get("kind"))
+
+    def _handle(self, conn: Connection, msg: Dict[str, Any]) -> None:
+        kind = msg["kind"]
+        if kind == "task_batch":
+            n = int(msg["n"])
+            tasks = []
+            for _ in range(n):
+                t = None if self._stop.is_set() else self.task_source()
+                tasks.append(t)
+                if t is None:
+                    break
+            self.hub.send(conn, {"kind": "task_batch", "v": tasks})
+        elif kind == "params":
+            weights, version = self.params.pull(int(msg["have"]))
+            if weights is None:
+                self.hub.send(conn, None)
+            else:
+                self.hub.send(
+                    conn, {"kind": "params", "version": version, "weights": weights}
+                )
+        elif kind == "result_batch":
+            for r in msg["v"]:
+                self.total_results += 1
+                try:
+                    self.results.put_nowait(r)
+                except queue.Full:
+                    # backpressure: evict the stalest queued result so the
+                    # freshest episodes survive (off-policy freshness)
+                    try:
+                        self.results.get_nowait()
+                        self.dropped_results += 1
+                    except queue.Empty:
+                        pass
+                    try:
+                        self.results.put_nowait(r)
+                    except queue.Full:
+                        self.dropped_results += 1
+        else:
+            logger.warning("server: unknown message kind %r", kind)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.hub.close()
+        for s in self._server_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# clusters
+
+
+class LocalCluster:
+    """Gathers as local processes over pipes (parity: ``WorkerCluster``,
+    ``hpc/worker.py:241-258``) — doubles as the multi-node simulator."""
+
+    def __init__(
+        self, server: WorkerServer, config: FleetConfig, runner: EpisodeRunner
+    ) -> None:
+        self.server = server
+        self.config = config
+        self.runner = runner
+        self.procs: List[mp.Process] = []
+
+    def start(self) -> None:
+        per = self.config.workers_per_gather
+        remaining = self.config.num_workers
+        for _g in range(self.config.num_gathers):
+            n = min(per, remaining)
+            remaining -= n
+            base = self.server.assign_worker_ids(n)
+            parent, child = mp.get_context().Pipe(duplex=True)
+            # gathers spawn worker children, so they cannot be daemonic;
+            # join() terminates stragglers and their daemonic workers
+            proc = mp.get_context().Process(
+                target=gather_main,
+                args=(PipeConnection(child), self.config, self.runner, base, n),
+            )
+            proc.start()
+            child.close()
+            self.server.add_gather_connection(PipeConnection(parent))
+            self.procs.append(proc)
+
+    def join(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                p.terminate()
+
+
+class RemoteCluster:
+    """Remote-host side: entry handshake then socket gathers (parity:
+    ``RemoteWorkerCluster.run`` + ``entry``, ``hpc/worker.py:300-341``)."""
+
+    def __init__(
+        self,
+        config: FleetConfig,
+        runner: EpisodeRunner,
+        num_workers: Optional[int] = None,
+    ) -> None:
+        self.config = config
+        self.runner = runner
+        self.num_workers = num_workers or config.num_workers
+        self.procs: List[mp.Process] = []
+
+    def entry(self) -> Tuple[int, Dict[str, Any]]:
+        conn = connect_socket(self.config.server_host, self.config.entry_port)
+        try:
+            ack = send_recv(
+                conn, {"kind": "entry", "num_workers": self.num_workers, "host": ""}
+            )
+            return int(ack["base_worker_id"]), ack["config"]
+        finally:
+            conn.close()
+
+    def start(self) -> None:
+        import dataclasses
+
+        base, remote_cfg = self.entry()
+        # adopt the learner side's fleet policy from the handshake
+        config = dataclasses.replace(
+            self.config,
+            workers_per_gather=int(
+                remote_cfg.get("workers_per_gather", self.config.workers_per_gather)
+            ),
+            worker_port=int(
+                remote_cfg.get("worker_port", self.config.worker_port)
+            ),
+            upload_batch=int(
+                remote_cfg.get("upload_batch", self.config.upload_batch)
+            ),
+            extra={**self.config.extra, **remote_cfg.get("extra", {})},
+        )
+        per = config.workers_per_gather
+        remaining = self.num_workers
+        offset = 0
+        while remaining > 0:
+            n = min(per, remaining)
+            proc = mp.get_context().Process(
+                target=_remote_gather_main,
+                args=(
+                    self.config.server_host,
+                    config.worker_port,
+                    config,
+                    self.runner,
+                    base + offset,
+                    n,
+                ),
+            )
+            proc.start()
+            self.procs.append(proc)
+            remaining -= n
+            offset += n
+
+    def join(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        for p in self.procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            if p.is_alive():
+                p.terminate()
+
+
+def _remote_gather_main(host, port, config, runner, base, n) -> None:
+    conn = connect_socket(host, port)
+    gather_main(conn, config, runner, base, n)

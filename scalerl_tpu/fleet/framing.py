@@ -1,0 +1,169 @@
+"""Flat binary message codec for the DCN actor-fleet data plane.
+
+Parity target: the reference's pickle-over-TCP framing
+(``scalerl/hpc/connection.py:26-83`` — 4-byte ``!i`` length prefix around a
+pickle blob) and its bz2-compressed episode payloads
+(``scalerl/hpc/generation.py:150-162``).
+
+TPU-shaped differences (SURVEY.md §7 "off-mesh actor transport"): pickle
+won't hit DCN throughput for pixel rollouts and is unsafe across trust
+boundaries, so the codec here is a *flat* binary layout — a JSON structure
+header describing a pytree of numpy arrays + scalars, followed by the raw
+array bytes concatenated — with optional zlib compression of the array
+section.  Arrays round-trip zero-parse (one ``np.frombuffer`` per leaf) and
+the header stays human-debuggable.
+
+Frame layout (network byte order):
+
+    magic  b'SRL1'      4 bytes
+    flags  u8           bit0 = array section zlib-compressed
+    hlen   u32          JSON header length
+    blen   u64          array-section length (compressed size if bit0)
+    header hlen bytes   JSON
+    body   blen bytes   concatenated array buffers
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from typing import Any, List, Tuple
+
+import numpy as np
+
+MAGIC = b"SRL1"
+_HEADER = struct.Struct("!4sBIQ")
+FLAG_ZLIB = 1
+# sanity cap: a single frame larger than this is a protocol error, not data
+MAX_FRAME = 1 << 34
+
+
+def _encode_node(obj: Any, bufs: List[bytes], offset: List[int]) -> Any:
+    if isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("fleet codec cannot encode object-dtype arrays")
+        raw = np.ascontiguousarray(obj)
+        data = raw.tobytes()
+        node = {
+            "t": "a",
+            "d": raw.dtype.str,
+            "s": list(raw.shape),
+            "o": offset[0],
+            "n": len(data),
+        }
+        bufs.append(data)
+        offset[0] += len(data)
+        return node
+    if isinstance(obj, (np.integer,)):
+        return {"t": "i", "v": int(obj)}
+    if isinstance(obj, (np.floating,)):
+        return {"t": "f", "v": float(obj)}
+    if isinstance(obj, (np.bool_,)):
+        return {"t": "b", "v": bool(obj)}
+    if isinstance(obj, bytes):
+        node = {"t": "y", "o": offset[0], "n": len(obj)}
+        bufs.append(obj)
+        offset[0] += len(obj)
+        return node
+    if isinstance(obj, dict):
+        # keys are encoded as nodes so int keys (e.g. player ids) round-trip
+        # faithfully instead of being coerced to str by JSON
+        for k in obj.keys():
+            if not (k is None or isinstance(k, (str, int, float, bool))):
+                raise TypeError(f"fleet codec dict key {type(k).__name__}")
+        return {
+            "t": "d",
+            "k": [_encode_node(k, bufs, offset) for k in obj.keys()],
+            "v": [_encode_node(v, bufs, offset) for v in obj.values()],
+        }
+    if isinstance(obj, tuple):
+        return {"t": "u", "v": [_encode_node(v, bufs, offset) for v in obj]}
+    if isinstance(obj, list):
+        return {"t": "l", "v": [_encode_node(v, bufs, offset) for v in obj]}
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return {"t": "p", "v": obj}
+    raise TypeError(f"fleet codec cannot encode {type(obj).__name__}")
+
+
+def _decode_node(node: Any, body: memoryview) -> Any:
+    t = node["t"]
+    if t == "a":
+        arr = np.frombuffer(
+            body[node["o"]: node["o"] + node["n"]], dtype=np.dtype(node["d"])
+        )
+        return arr.reshape(node["s"])
+    if t == "y":
+        return bytes(body[node["o"]: node["o"] + node["n"]])
+    if t == "d":
+        return {
+            _decode_node(k, body): _decode_node(v, body)
+            for k, v in zip(node["k"], node["v"])
+        }
+    if t == "u":
+        return tuple(_decode_node(v, body) for v in node["v"])
+    if t == "l":
+        return [_decode_node(v, body) for v in node["v"]]
+    if t in ("p", "i", "f", "b"):
+        return node["v"]
+    raise ValueError(f"fleet codec: unknown node type {t!r}")
+
+
+def pack_message(obj: Any, compress: bool = False) -> bytes:
+    """Encode a pytree of numpy arrays / scalars / str / bytes into a frame."""
+    bufs: List[bytes] = []
+    offset = [0]
+    tree = _encode_node(obj, bufs, offset)
+    header = json.dumps(tree, separators=(",", ":")).encode()
+    body = b"".join(bufs)
+    flags = 0
+    if compress and body:
+        packed = zlib.compress(body, level=1)
+        if len(packed) < len(body):
+            body = packed
+            flags |= FLAG_ZLIB
+    return _HEADER.pack(MAGIC, flags, len(header), len(body)) + header + body
+
+
+def unpack_message(frame: bytes) -> Any:
+    magic, flags, hlen, blen = _HEADER.unpack_from(frame, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad frame magic {magic!r}")
+    header_end = _HEADER.size + hlen
+    tree = json.loads(frame[_HEADER.size:header_end])
+    body = frame[header_end:header_end + blen]
+    if flags & FLAG_ZLIB:
+        body = zlib.decompress(body)
+    # one body copy into a writable buffer so decoded arrays are mutable
+    # views (np.frombuffer over immutable bytes yields read-only arrays)
+    return _decode_node(tree, memoryview(bytearray(body)))
+
+
+# ---------------------------------------------------------------------------
+# socket-level framing: u32 length prefix around a packed message, mirroring
+# the reference's '!i' prefix (connection.py:57-83) but with the flat codec.
+_LEN = struct.Struct("!Q")
+
+
+def send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise ValueError(f"frame of {n} bytes exceeds MAX_FRAME")
+    return _recv_exact(sock, n)

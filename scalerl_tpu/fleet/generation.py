@@ -1,0 +1,171 @@
+"""Episode generation for fleet workers.
+
+Parity target: ``Generator`` (``scalerl/hpc/generation.py:16-183``) — turn
+-based multi-player rollouts with legal-action masking, per-player discounted
+returns, and episodes shipped as compressed fixed-size chunks.
+
+TPU-shaped differences: steps are accumulated into *fixed-shape* numpy
+chunks (padded, with an explicit ``length``) so the learner host can stack
+them straight into ``[T, B]`` device batches (SURVEY.md §7 "dynamic episode
+lengths vs static shapes"); masking uses an additive ``-inf`` mask + stable
+softmax rather than the reference's ``+1e32`` legal-logit trick
+(``generation.py:109-118``).  Compression happens at the transport layer
+(``FleetConfig.compress_uplink``), not with per-episode bz2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+
+class TurnBasedEnv(Protocol):
+    """Minimal turn-based multi-player env protocol (HandyRL-style)."""
+
+    def reset(self, seed: Optional[int] = None) -> None: ...
+    def players(self) -> Sequence[int]: ...
+    def turn(self) -> int: ...
+    def terminal(self) -> bool: ...
+    def observation(self, player: int) -> np.ndarray: ...
+    def legal_actions(self, player: int) -> Sequence[int]: ...
+    def play(self, action: int) -> None: ...
+    def outcome(self) -> Dict[int, float]: ...
+
+
+# PolicyFn: (weights, observation, player) -> action logits [num_actions]
+PolicyFn = Callable[[Any, np.ndarray, int], np.ndarray]
+
+
+def masked_softmax(logits: np.ndarray, legal: Sequence[int]) -> np.ndarray:
+    """Probabilities over all actions with illegal ones exactly zero."""
+    mask = np.full(logits.shape, -np.inf, dtype=np.float32)
+    mask[list(legal)] = 0.0
+    z = logits.astype(np.float32) + mask
+    z -= z[list(legal)].max()
+    e = np.where(np.isneginf(z), 0.0, np.exp(z))
+    return e / e.sum()
+
+
+def discounted_returns(rewards: np.ndarray, gamma: float) -> np.ndarray:
+    """Per-step discounted return (reverse accumulation, reference
+    ``generation.py:143-147``)."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+class EpisodeGenerator:
+    """Runs one turn-based episode and emits fixed-shape padded chunks."""
+
+    def __init__(
+        self,
+        env: TurnBasedEnv,
+        policy_fn: PolicyFn,
+        num_actions: int,
+        gamma: float = 1.0,
+        chunk_len: int = 64,
+        temperature: float = 1.0,
+    ) -> None:
+        self.env = env
+        self.policy_fn = policy_fn
+        self.num_actions = num_actions
+        self.gamma = gamma
+        self.chunk_len = chunk_len
+        self.temperature = temperature
+
+    def generate(
+        self, weights: Any, seed: Optional[int] = None, greedy: bool = False
+    ) -> Dict[str, Any]:
+        rng = np.random.default_rng(seed)
+        env = self.env
+        env.reset(seed=seed)
+        obs_l: List[np.ndarray] = []
+        act_l: List[int] = []
+        probs_l: List[np.ndarray] = []
+        player_l: List[int] = []
+        while not env.terminal():
+            player = env.turn()
+            obs = np.asarray(env.observation(player))
+            legal = env.legal_actions(player)
+            logits = self.policy_fn(weights, obs, player)
+            probs = masked_softmax(logits / max(self.temperature, 1e-6), legal)
+            if greedy:
+                action = int(np.argmax(probs))
+            else:
+                action = int(rng.choice(self.num_actions, p=probs))
+            env.play(action)
+            obs_l.append(obs)
+            act_l.append(action)
+            probs_l.append(probs)
+            player_l.append(player)
+        outcome = env.outcome()
+        T = len(act_l)
+        players = np.asarray(player_l, dtype=np.int32)
+        # per-player reward stream: outcome at that player's last move,
+        # discounted back through *their own* moves
+        returns = np.zeros(T, dtype=np.float32)
+        for p, score in outcome.items():
+            idx = np.nonzero(players == p)[0]
+            if len(idx) == 0:
+                continue
+            r = np.zeros(len(idx), dtype=np.float32)
+            r[-1] = float(score)
+            returns[idx] = discounted_returns(r, self.gamma)
+        episode = {
+            "obs": np.stack(obs_l) if obs_l else np.zeros((0,), np.float32),
+            "action": np.asarray(act_l, dtype=np.int32),
+            "probs": np.stack(probs_l) if probs_l else np.zeros((0,), np.float32),
+            "player": players,
+            "returns": returns,
+            "length": T,
+            "outcome": {int(k): float(v) for k, v in outcome.items()},
+        }
+        return {"chunks": self._chunk(episode), "length": T,
+                "outcome": episode["outcome"]}
+
+    def _chunk(self, episode: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Split into fixed-shape, zero-padded chunks of ``chunk_len``."""
+        T = episode["length"]
+        chunks = []
+        for start in range(0, max(T, 1), self.chunk_len):
+            end = min(start + self.chunk_len, T)
+            n = end - start
+            chunk: Dict[str, Any] = {"start": start, "length": n}
+            for key in ("obs", "action", "probs", "player", "returns"):
+                arr = episode[key][start:end]
+                if n < self.chunk_len:
+                    pad = [(0, self.chunk_len - n)] + [(0, 0)] * (arr.ndim - 1)
+                    arr = np.pad(arr, pad)
+                chunk[key] = arr
+            chunks.append(chunk)
+        return chunks
+
+
+def make_generation_runner(
+    env_fn: Callable[[], TurnBasedEnv],
+    policy_fn: PolicyFn,
+    num_actions: int,
+    gamma: float = 1.0,
+    chunk_len: int = 64,
+):
+    """Build a fleet ``EpisodeRunner`` that runs turn-based generation
+    (``role='rollout'``) or greedy evaluation (``role='eval'``), mirroring
+    the reference's ``role=='g'``/``'e'`` split (``hpc/worker.py:108-116``)."""
+    state: Dict[str, Any] = {}
+
+    def runner(task: Dict[str, Any], weights: Any, worker_id: int) -> Dict[str, Any]:
+        if "gen" not in state:
+            state["gen"] = EpisodeGenerator(
+                env_fn(), policy_fn, num_actions, gamma=gamma, chunk_len=chunk_len
+            )
+        gen: EpisodeGenerator = state["gen"]
+        greedy = task.get("role") == "eval"
+        out = gen.generate(weights, seed=task.get("seed"), greedy=greedy)
+        out["role"] = task.get("role", "rollout")
+        return out
+
+    return runner

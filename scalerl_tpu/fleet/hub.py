@@ -1,0 +1,194 @@
+"""Connection hub + job executor: the learner-host message plumbing.
+
+Parity targets (``scalerl/hpc/connection.py``):
+- ``QueueCommunicator`` (:271-327) → ``QueueHub``: async send/recv pump
+  threads over a *set* of connections with bounded queues; dead connections
+  are dropped, not fatal (a worker that dies mid-fleet must not take the
+  learner down — SURVEY.md §5 failure-detection notes).
+- ``MultiProcessJobExecutor`` (:207-268) → ``JobExecutor``: dispatches jobs
+  from a generator to idle worker processes and funnels (optionally
+  post-processed) results into a bounded output queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, List, Optional, Set, Tuple
+
+from scalerl_tpu.fleet.transport import (
+    Connection,
+    open_worker_pipes,
+    wait_readable,
+)
+
+
+class QueueHub:
+    """Pumps a dynamic set of connections through in/out queues."""
+
+    def __init__(self, maxsize: int = 256) -> None:
+        self.input_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
+        self.output_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
+        self._conns: Set[Connection] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._recv_loop, daemon=True),
+            threading.Thread(target=self._send_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def connection_count(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def add_connection(self, conn: Connection) -> None:
+        with self._lock:
+            self._conns.add(conn)
+
+    def disconnect(self, conn: Connection) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[Connection, Any]:
+        """Next (connection, message); raises queue.Empty on timeout."""
+        return self.input_queue.get(timeout=timeout)
+
+    def send(self, conn: Connection, msg: Any, compress: bool = False) -> None:
+        self.output_queue.put((conn, (msg, compress)))
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def _recv_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                conns = list(self._conns)
+            if not conns:
+                self._stop.wait(0.05)
+                continue
+            ready, dead = wait_readable(conns, timeout=0.05)
+            for conn in dead:
+                self.disconnect(conn)
+            for conn in ready:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError, ConnectionError, ValueError):
+                    self.disconnect(conn)
+                    continue
+                self.input_queue.put((conn, msg))
+
+    def _send_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, (msg, compress) = self.output_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                conn.send(msg, compress=compress)
+            except (BrokenPipeError, OSError, ConnectionError):
+                self.disconnect(conn)
+
+
+class JobExecutor:
+    """Feed jobs from a generator to N pipe workers; collect results.
+
+    The worker ``target(conn, *args)`` loop should ``conn.recv()`` a job,
+    process it, and ``conn.send(result)``; ``None`` job means shutdown.
+    """
+
+    def __init__(
+        self,
+        target: Callable[..., None],
+        job_source: Iterator[Any],
+        num_workers: int,
+        postprocess: Optional[Callable[[Any], Any]] = None,
+        out_maxsize: int = 8,
+    ) -> None:
+        self._job_source = job_source
+        self._postprocess = postprocess
+        self.results: "queue.Queue[Any]" = queue.Queue(out_maxsize)
+        self._stop = threading.Event()
+        self._retry: "queue.Queue[Any]" = queue.Queue()
+        self._idle: "queue.Queue[Connection]" = queue.Queue()
+        self._conns, self._procs = open_worker_pipes(
+            num_workers, target, lambda i: (i,)
+        )
+        for c in self._conns:
+            self._idle.put(c)
+        self._threads = [
+            threading.Thread(target=self._dispatch_loop, daemon=True),
+            threading.Thread(target=self._collect_loop, daemon=True),
+        ]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn = self._idle.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                job = self._retry.get_nowait()
+            except queue.Empty:
+                try:
+                    job = next(self._job_source)
+                except StopIteration:
+                    self._idle.put(conn)
+                    return
+            try:
+                conn.send(job)
+            except (BrokenPipeError, OSError):
+                # worker died: the generator cannot replay, so requeue the
+                # job for the next idle worker instead of dropping it
+                self._retry.put(job)
+                continue
+
+    def _collect_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._conns:
+                self._stop.wait(0.05)
+                continue
+            ready, dead = wait_readable(list(self._conns), timeout=0.02)
+            for conn in dead:
+                self._conns.remove(conn)
+            for conn in ready:
+                try:
+                    result = conn.recv()
+                except (EOFError, OSError, ConnectionError):
+                    if conn in self._conns:
+                        self._conns.remove(conn)
+                    continue
+                if self._postprocess is not None:
+                    result = self._postprocess(result)
+                self.results.put(result)
+                self._idle.put(conn)
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        for conn in self._conns:
+            try:
+                conn.send(None)
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=timeout)
+            if proc.is_alive():
+                proc.terminate()
+        for conn in self._conns:
+            conn.close()

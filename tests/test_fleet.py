@@ -1,0 +1,359 @@
+"""Fleet layer tests: codec, transport, hub, executor, clusters, generation.
+
+Strategy per SURVEY.md §4: the local-pipes mode doubles as the multi-node
+simulator; the remote path is exercised over localhost sockets.
+"""
+
+import multiprocessing as mp
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalerl_tpu.fleet import (
+    EpisodeGenerator,
+    FleetConfig,
+    JobExecutor,
+    LocalCluster,
+    QueueHub,
+    RemoteCluster,
+    WorkerServer,
+    connect_socket,
+    discounted_returns,
+    listen_socket,
+    make_generation_runner,
+    masked_softmax,
+    pack_message,
+    unpack_message,
+)
+from scalerl_tpu.fleet.transport import (
+    PipeConnection,
+    accept_connection,
+)
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+def test_codec_roundtrip_nested():
+    msg = {
+        "kind": "result",
+        "arrays": {
+            "obs": np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+            "rew": np.array([1.5, -2.0], dtype=np.float32),
+        },
+        "meta": [1, 2.5, "x", None, True, (7, "y")],
+        "blob": b"\x00\x01\xff",
+    }
+    out = unpack_message(pack_message(msg))
+    assert out["kind"] == "result"
+    np.testing.assert_array_equal(out["arrays"]["obs"], msg["arrays"]["obs"])
+    np.testing.assert_array_equal(out["arrays"]["rew"], msg["arrays"]["rew"])
+    assert out["meta"][:5] == [1, 2.5, "x", None, True]
+    assert out["meta"][5] == (7, "y")
+    assert out["blob"] == b"\x00\x01\xff"
+
+
+def test_codec_compression_smaller_and_lossless():
+    arr = np.zeros((64, 64), dtype=np.float32)
+    plain = pack_message({"a": arr})
+    packed = pack_message({"a": arr}, compress=True)
+    assert len(packed) < len(plain)
+    np.testing.assert_array_equal(unpack_message(packed)["a"], arr)
+
+
+def test_codec_rejects_unknown_types():
+    with pytest.raises(TypeError):
+        pack_message({"bad": object()})
+    with pytest.raises(TypeError):
+        pack_message({"bad": np.array([object()], dtype=object)})
+
+
+def test_codec_int_dict_keys_roundtrip():
+    out = unpack_message(pack_message({"outcome": {0: 1.0, 1: -1.0}}))
+    assert out["outcome"] == {0: 1.0, 1: -1.0}
+    assert 0 in out["outcome"]
+
+
+def test_codec_decoded_arrays_are_writable():
+    arr = unpack_message(pack_message({"a": np.ones(4, np.float32)}))["a"]
+    arr += 1.0
+    np.testing.assert_array_equal(arr, np.full(4, 2.0, np.float32))
+    packed = unpack_message(pack_message({"a": np.zeros(64, np.float32)}, compress=True))
+    packed["a"][0] = 5.0
+
+
+# ---------------------------------------------------------------------------
+# transport
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_socket_connection_roundtrip():
+    port = _free_port()
+    server_sock = listen_socket(port)
+    results = {}
+
+    def server():
+        conn = accept_connection(server_sock, timeout=5.0)
+        results["got"] = conn.recv()
+        conn.send({"echo": results["got"]["x"] * 2})
+        conn.close()
+
+    t = threading.Thread(target=server)
+    t.start()
+    client = connect_socket("127.0.0.1", port)
+    client.send({"x": np.ones(4, np.float32)})
+    reply = client.recv(timeout=5.0)
+    t.join(timeout=5.0)
+    server_sock.close()
+    client.close()
+    np.testing.assert_array_equal(reply["echo"], np.full(4, 2.0, np.float32))
+
+
+def test_pipe_connection_roundtrip():
+    a, b = mp.Pipe(duplex=True)
+    ca, cb = PipeConnection(a), PipeConnection(b)
+    ca.send({"v": np.arange(3)})
+    msg = cb.recv(timeout=2.0)
+    np.testing.assert_array_equal(msg["v"], np.arange(3))
+    with pytest.raises(TimeoutError):
+        cb.recv(timeout=0.05)
+
+
+# ---------------------------------------------------------------------------
+# hub
+
+
+def test_queue_hub_pumps_and_drops_dead():
+    a1, b1 = mp.Pipe(duplex=True)
+    a2, b2 = mp.Pipe(duplex=True)
+    hub = QueueHub()
+    hub.add_connection(PipeConnection(a1))
+    hub.add_connection(PipeConnection(a2))
+    PipeConnection(b1).send({"id": 1})
+    PipeConnection(b2).send({"id": 2})
+    got = {hub.recv(timeout=5.0)[1]["id"], hub.recv(timeout=5.0)[1]["id"]}
+    assert got == {1, 2}
+    # dead connection is dropped, not fatal
+    b1.close()
+    a1_conn = None
+    deadline = time.monotonic() + 5.0
+    while hub.connection_count() > 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert hub.connection_count() == 1
+    hub.close()
+
+
+# ---------------------------------------------------------------------------
+# executor
+
+
+def _square_worker(conn, idx):
+    while True:
+        job = conn.recv()
+        if job is None:
+            return
+        conn.send({"out": job["x"] ** 2})
+
+
+def test_job_executor():
+    jobs = iter([{"x": i} for i in range(6)])
+    ex = JobExecutor(_square_worker, jobs, num_workers=2)
+    ex.start()
+    got = sorted(ex.results.get(timeout=10.0)["out"] for _ in range(6))
+    assert got == [0, 1, 4, 9, 16, 25]
+    ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet end-to-end (local pipes == multi-node simulator)
+
+
+def _bandit_runner(task, weights, worker_id):
+    """Toy episode: 'reward' is weights['w'] dot a fixed feature."""
+    w = weights["w"] if weights is not None else np.zeros(2, np.float32)
+    seed = int(task.get("seed", 0))
+    return {
+        "role": task.get("role", "rollout"),
+        "seed": seed,
+        "reward": float(w.sum()) + seed * 0.0,
+        "frames": np.zeros((4, 2), np.float32),
+    }
+
+
+def _make_task_source(n, param_server=lambda: 0):
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if counter["i"] >= n:
+                return None
+            counter["i"] += 1
+            return {"role": "rollout", "seed": counter["i"],
+                    "param_version": param_server()}
+
+    return source
+
+
+def _drain(server, n, timeout=30.0):
+    results = []
+    deadline = time.monotonic() + timeout
+    while len(results) < n and time.monotonic() < deadline:
+        r = server.get_result(timeout=0.2)
+        if r is not None:
+            results.append(r)
+    return results
+
+
+def test_local_cluster_end_to_end():
+    config = FleetConfig(num_workers=4, workers_per_gather=2, upload_batch=2)
+    server = WorkerServer(config, _make_task_source(12, lambda: server.params.version))
+    version = server.publish({"w": np.array([1.0, 2.0], np.float32)})
+    assert version == 1
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, _bandit_runner)
+    cluster.start()
+    results = _drain(server, 12)
+    cluster.join()
+    server.stop()
+    assert len(results) == 12
+    assert {r["seed"] for r in results} == set(range(1, 13))
+    # every worker pulled the published weights (version 1)
+    assert all(r["param_version"] == 1 for r in results)
+    assert all(abs(r["reward"] - 3.0) < 1e-6 for r in results)
+    worker_ids = {r["worker_id"] for r in results}
+    assert worker_ids <= set(range(4)) and len(worker_ids) >= 2
+
+
+def test_remote_cluster_over_sockets():
+    entry_port, worker_port = _free_port(), _free_port()
+    config = FleetConfig(
+        num_workers=2,
+        workers_per_gather=2,
+        upload_batch=1,
+        entry_port=entry_port,
+        worker_port=worker_port,
+    )
+    server = WorkerServer(config, _make_task_source(6, lambda: server.params.version))
+    server.publish({"w": np.array([0.5, 0.5], np.float32)})
+    server.start(listen=True)
+    remote = RemoteCluster(config, _bandit_runner)
+    remote.start()
+    results = _drain(server, 6)
+    remote.join()
+    server.stop()
+    assert len(results) == 6
+    assert all(abs(r["reward"] - 1.0) < 1e-6 for r in results)
+    assert server.total_results == 6
+
+
+# ---------------------------------------------------------------------------
+# generation
+
+
+class _TicTacToeLite:
+    """3-cell line game: players alternate claiming cells; 2 cells wins."""
+
+    def reset(self, seed=None):
+        self.board = np.zeros(3, np.int8)
+        self.current = 0
+        self.moves = 0
+
+    def players(self):
+        return [0, 1]
+
+    def turn(self):
+        return self.current
+
+    def terminal(self):
+        return self.moves >= 3 or not (self.board == 0).any()
+
+    def observation(self, player):
+        return self.board.astype(np.float32)
+
+    def legal_actions(self, player):
+        return [i for i in range(3) if self.board[i] == 0]
+
+    def play(self, action):
+        assert self.board[action] == 0
+        self.board[action] = self.current + 1
+        self.current = 1 - self.current
+        self.moves += 1
+
+    def outcome(self):
+        counts = [(self.board == 1).sum(), (self.board == 2).sum()]
+        if counts[0] > counts[1]:
+            return {0: 1.0, 1: -1.0}
+        if counts[1] > counts[0]:
+            return {0: -1.0, 1: 1.0}
+        return {0: 0.0, 1: 0.0}
+
+
+def test_masked_softmax_zeroes_illegal():
+    probs = masked_softmax(np.array([5.0, 1.0, 3.0], np.float32), legal=[1, 2])
+    assert probs[0] == 0.0
+    assert abs(probs.sum() - 1.0) < 1e-6
+    assert probs[2] > probs[1]
+
+
+def test_discounted_returns_matches_hand_computed():
+    r = np.array([0.0, 0.0, 1.0], np.float32)
+    np.testing.assert_allclose(
+        discounted_returns(r, 0.5), [0.25, 0.5, 1.0], rtol=1e-6
+    )
+
+
+def test_episode_generator_turn_based():
+    def policy(weights, obs, player):
+        return np.zeros(3, np.float32)
+
+    gen = EpisodeGenerator(
+        _TicTacToeLite(), policy, num_actions=3, gamma=0.9, chunk_len=2
+    )
+    out = gen.generate(weights=None, seed=0)
+    assert out["length"] == 3
+    chunks = out["chunks"]
+    assert len(chunks) == 2  # ceil(3/2) with fixed shapes
+    assert chunks[0]["obs"].shape == (2, 3)
+    assert chunks[1]["length"] == 1
+    # padded region is zero
+    assert chunks[1]["action"][1] == 0
+    # player-0 made moves 0 and 2 and won (2 cells): their returns discount
+    players = np.concatenate([c["player"][: c["length"]] for c in chunks])
+    returns = np.concatenate([c["returns"][: c["length"]] for c in chunks])
+    p0 = returns[players == 0]
+    assert p0[-1] == pytest.approx(1.0)
+    assert p0[0] == pytest.approx(0.9)
+    assert returns[players == 1][-1] == pytest.approx(-1.0)
+
+
+def test_generation_runner_in_local_cluster():
+    def policy(weights, obs, player):
+        return np.zeros(3, np.float32)
+
+    runner = make_generation_runner(
+        _TicTacToeLite, policy, num_actions=3, gamma=1.0, chunk_len=4
+    )
+    config = FleetConfig(num_workers=2, workers_per_gather=2, upload_batch=1)
+    server = WorkerServer(config, _make_task_source(4))
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, runner)
+    cluster.start()
+    results = _drain(server, 4)
+    cluster.join()
+    server.stop()
+    assert len(results) == 4
+    for r in results:
+        assert r["length"] == 3
+        assert r["chunks"][0]["obs"].shape == (4, 3)
