@@ -5,3 +5,14 @@ from scalerl_tpu.envs.jax_envs import (  # noqa: F401
     SyntheticPixelEnv,
     make_jax_vec_env,
 )
+from scalerl_tpu.envs.multi_agent import (  # noqa: F401
+    AutoResetParallelWrapper,
+    PursuitToyEnv,
+    SingleAgentAdapter,
+    make_multi_agent_vec_env,
+    make_shared_vec_envs,
+)
+from scalerl_tpu.envs.vector import (  # noqa: F401
+    AsyncMultiAgentVecEnv,
+    SharedObservationPlane,
+)

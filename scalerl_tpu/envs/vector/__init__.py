@@ -1,0 +1,11 @@
+from scalerl_tpu.envs.vector.async_vec import (  # noqa: F401
+    AlreadyPendingCallError,
+    AsyncMultiAgentVecEnv,
+    AsyncState,
+    ClosedEnvError,
+    NoAsyncCallError,
+)
+from scalerl_tpu.envs.vector.spec import (  # noqa: F401
+    ExperienceSpec,
+    SharedObservationPlane,
+)

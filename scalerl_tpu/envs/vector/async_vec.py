@@ -1,0 +1,373 @@
+"""Async subprocess vector env with a shared-memory observation plane.
+
+Parity target: ``AsyncPettingZooVecEnv``
+(``scalerl/envs/vector/pz_async_vec_env.py:36-897``, the reference's largest
+component): subprocess-per-env, an async DEFAULT/WAITING_RESET/WAITING_STEP/
+WAITING_CALL state machine, ``call``/``get_attr``/``set_attr`` passthrough,
+autoreset, per-worker error funneling via an ``error_queue`` with targeted
+teardown, and zero-copy shared-memory observations.
+
+Works for any env speaking the PettingZoo *parallel* API (``possible_agents``,
+``reset``, dict-keyed ``step``) — including single-agent gym envs via
+``SingleAgentAdapter`` — so this one class is both the multi-agent vec env
+and the shared-memory infeed staging plane for the TPU learner host.
+"""
+
+from __future__ import annotations
+
+import enum
+import multiprocessing as mp
+import sys
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from scalerl_tpu.envs.vector.spec import ExperienceSpec, SharedObservationPlane
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class AsyncState(enum.Enum):
+    DEFAULT = "default"
+    WAITING_RESET = "reset"
+    WAITING_STEP = "step"
+    WAITING_CALL = "call"
+
+
+class AlreadyPendingCallError(RuntimeError):
+    pass
+
+
+class NoAsyncCallError(RuntimeError):
+    pass
+
+
+class ClosedEnvError(RuntimeError):
+    pass
+
+
+def _probe_spaces(env_fn: Callable[[], Any]):
+    """Create one env in-process to read agent names + obs/action spaces."""
+    env = env_fn()
+    try:
+        agents = list(env.possible_agents)
+        obs_spaces = {}
+        action_spaces = {}
+        for a in agents:
+            space = env.observation_space(a)
+            obs_spaces[a] = (tuple(space.shape), space.dtype)
+            action_spaces[a] = env.action_space(a)
+        return agents, obs_spaces, action_spaces
+    finally:
+        close = getattr(env, "close", None)
+        if close:
+            close()
+
+
+class AsyncMultiAgentVecEnv:
+    """N env subprocesses writing observations into a shared plane.
+
+    ``context``: on a JAX learner host prefer ``"forkserver"`` or
+    ``"spawn"`` — the default start method on Linux is fork, and forking
+    after JAX has started backend threads can deadlock the child.  Env
+    factories must be picklable under those contexts (module-level
+    callables, not lambdas).
+    """
+
+    def __init__(
+        self,
+        env_fns: Sequence[Callable[[], Any]],
+        obs_spaces: Optional[Dict[str, Tuple[Tuple[int, ...], Any]]] = None,
+        autoreset: bool = True,
+        context: Optional[str] = None,
+    ) -> None:
+        self.num_envs = len(env_fns)
+        ctx = mp.get_context(context)
+        if obs_spaces is None:
+            self.agents, obs_spaces, self.action_spaces = _probe_spaces(env_fns[0])
+        else:
+            self.agents = list(obs_spaces.keys())
+            self.action_spaces = {}
+        self.spec = ExperienceSpec(obs_spaces, self.num_envs)
+        self.plane = SharedObservationPlane(self.spec, ctx=ctx)
+        self.error_queue: mp.Queue = ctx.Queue()
+        self._state = AsyncState.DEFAULT
+        self._closed = False
+        self.parent_pipes = []
+        self.processes = []
+        for index, env_fn in enumerate(env_fns):
+            parent, child = ctx.Pipe(duplex=True)
+            proc = ctx.Process(
+                target=_async_worker,
+                args=(
+                    index,
+                    env_fn,
+                    child,
+                    parent,
+                    self.plane,
+                    self.agents,
+                    autoreset,
+                    self.error_queue,
+                ),
+                daemon=True,
+            )
+            proc.start()
+            child.close()
+            self.parent_pipes.append(parent)
+            self.processes.append(proc)
+
+    # -- async API -----------------------------------------------------
+    def _assert_default(self, op: str) -> None:
+        if self._closed:
+            raise ClosedEnvError("vec env is closed")
+        if self._state is not AsyncState.DEFAULT:
+            raise AlreadyPendingCallError(
+                f"cannot {op} while waiting for `{self._state.value}`"
+            )
+
+    def reset_async(self, seed: Optional[int] = None, options=None) -> None:
+        self._assert_default("reset")
+        for i, pipe in enumerate(self.parent_pipes):
+            env_seed = None if seed is None else seed + i
+            pipe.send(("reset", (env_seed, options)))
+        self._state = AsyncState.WAITING_RESET
+
+    def reset_wait(self, timeout: Optional[float] = 60.0):
+        if self._state is not AsyncState.WAITING_RESET:
+            raise NoAsyncCallError("no reset pending")
+        results, successes = self._collect(timeout)
+        self._state = AsyncState.DEFAULT
+        self._raise_if_errors(successes)
+        infos = [r for r in results]
+        return self.plane.read_batch(), infos
+
+    def reset(self, seed: Optional[int] = None, options=None, timeout=60.0):
+        self.reset_async(seed=seed, options=options)
+        return self.reset_wait(timeout)
+
+    def step_async(self, actions: Dict[str, np.ndarray]) -> None:
+        """``actions[agent]`` is a length-``num_envs`` batch; transposed to
+        per-env dicts (reference ``pz_vec_env.py:53-68``)."""
+        self._assert_default("step")
+        for i, pipe in enumerate(self.parent_pipes):
+            per_env = {agent: np.asarray(acts)[i] for agent, acts in actions.items()}
+            pipe.send(("step", per_env))
+        self._state = AsyncState.WAITING_STEP
+
+    def step_wait(self, timeout: Optional[float] = 60.0):
+        if self._state is not AsyncState.WAITING_STEP:
+            raise NoAsyncCallError("no step pending")
+        results, successes = self._collect(timeout)
+        self._state = AsyncState.DEFAULT
+        self._raise_if_errors(successes)
+        rewards = {a: np.zeros(self.num_envs, np.float32) for a in self.agents}
+        terms = {a: np.zeros(self.num_envs, np.bool_) for a in self.agents}
+        truncs = {a: np.zeros(self.num_envs, np.bool_) for a in self.agents}
+        infos: List[dict] = []
+        for i, (rew, term, trunc, info) in enumerate(results):
+            for a in self.agents:
+                rewards[a][i] = rew.get(a, 0.0)
+                terms[a][i] = term.get(a, True)
+                truncs[a][i] = trunc.get(a, False)
+            infos.append(info)
+        return self.plane.read_batch(), rewards, terms, truncs, infos
+
+    def step(self, actions: Dict[str, np.ndarray], timeout: Optional[float] = 60.0):
+        self.step_async(actions)
+        return self.step_wait(timeout)
+
+    # -- attribute passthrough ----------------------------------------
+    def call_async(self, name: str, *args, **kwargs) -> None:
+        self._assert_default("call")
+        for pipe in self.parent_pipes:
+            pipe.send(("call", (name, args, kwargs)))
+        self._state = AsyncState.WAITING_CALL
+
+    def call_wait(self, timeout: Optional[float] = 60.0) -> list:
+        if self._state is not AsyncState.WAITING_CALL:
+            raise NoAsyncCallError("no call pending")
+        results, successes = self._collect(timeout)
+        self._state = AsyncState.DEFAULT
+        self._raise_if_errors(successes)
+        return results
+
+    def call(self, name: str, *args, **kwargs) -> list:
+        self.call_async(name, *args, **kwargs)
+        return self.call_wait()
+
+    def get_attr(self, name: str) -> list:
+        return self.call(name)
+
+    def set_attr(self, name: str, values: Any) -> None:
+        if not isinstance(values, (list, tuple)):
+            values = [values] * self.num_envs
+        if len(values) != self.num_envs:
+            raise ValueError(
+                f"set_attr needs {self.num_envs} values, got {len(values)}"
+            )
+        self._assert_default("set_attr")
+        for pipe, value in zip(self.parent_pipes, values):
+            pipe.send(("setattr", (name, value)))
+        self._state = AsyncState.WAITING_CALL
+        self.call_wait()
+
+    # -- plumbing ------------------------------------------------------
+    def _collect(self, timeout: Optional[float]):
+        """Gather one (result, success) pair per worker, with deadline.
+
+        On timeout the state machine resets to DEFAULT before raising
+        (gymnasium ``AsyncVectorEnv`` semantics) so the env is not wedged in
+        a WAITING state forever — though replies already consumed from
+        faster workers are lost for that step.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results, successes = [], []
+        for i, pipe in enumerate(self.parent_pipes):
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and (
+                remaining <= 0 or not pipe.poll(remaining)
+            ):
+                self._state = AsyncState.DEFAULT
+                raise TimeoutError(f"worker {i} did not respond in {timeout}s")
+            result, ok = pipe.recv()
+            results.append(result)
+            successes.append(ok)
+        return results, successes
+
+    def _raise_if_errors(self, successes: Sequence[bool]) -> None:
+        if all(successes):
+            return
+        num_errors = successes.count(False)
+        last: Optional[BaseException] = None
+        for _ in range(num_errors):
+            index, exc_name, tb = self.error_queue.get()
+            logger.error("env worker %d failed:\n%s", index, tb)
+            # targeted teardown of the failed worker only
+            self.parent_pipes[index].close()
+            proc = self.processes[index]
+            proc.join(timeout=1.0)
+            if proc.is_alive():
+                proc.terminate()
+            last = RuntimeError(f"env worker {index} raised {exc_name}:\n{tb}")
+        assert last is not None
+        raise last
+
+    def close(self, terminate: bool = False) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for pipe in self.parent_pipes:
+            try:
+                if not terminate:
+                    pipe.send(("close", None))
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self.processes:
+            proc.join(timeout=2.0)
+            if proc.is_alive():
+                proc.terminate()
+        for pipe in self.parent_pipes:
+            try:
+                pipe.close()
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close(terminate=True)
+        except Exception:
+            pass
+
+
+def _fill_missing(obs: dict, agents: Sequence[str], spec: ExperienceSpec) -> dict:
+    """Dead agents keep zero observations (reference 'fill dead agents',
+    ``pz_async_vec_env.py:844-856``)."""
+    out = dict(obs)
+    for a in agents:
+        if a not in out:
+            slot = spec.slots[a]
+            out[a] = np.zeros(slot.shape, slot.dtype)
+    return out
+
+
+def _async_worker(
+    index: int,
+    env_fn: Callable[[], Any],
+    pipe,
+    parent_pipe,
+    plane: SharedObservationPlane,
+    agents: Sequence[str],
+    autoreset: bool,
+    error_queue,
+) -> None:
+    parent_pipe.close()
+    env = None
+    try:
+        env = env_fn()
+        episode_return = {a: 0.0 for a in agents}
+        episode_length = 0
+        while True:
+            command, payload = pipe.recv()
+            if command == "reset":
+                seed, options = payload
+                obs, infos = env.reset(seed=seed, options=options)
+                plane.write_env(index, _fill_missing(obs, agents, plane.spec))
+                episode_return = {a: 0.0 for a in agents}
+                episode_length = 0
+                pipe.send((infos, True))
+            elif command == "step":
+                obs, rew, term, trunc, infos = env.step(payload)
+                episode_length += 1
+                for a, r in rew.items():
+                    episode_return[a] = episode_return.get(a, 0.0) + float(r)
+                all_done = all(
+                    term.get(a, True) or trunc.get(a, False) for a in agents
+                )
+                if all_done and autoreset:
+                    infos = dict(infos) if infos else {}
+                    infos["final_observation"] = obs
+                    infos["episode"] = {
+                        "r": dict(episode_return),
+                        "l": episode_length,
+                    }
+                    obs, reset_infos = env.reset()
+                    episode_return = {a: 0.0 for a in agents}
+                    episode_length = 0
+                plane.write_env(index, _fill_missing(obs, agents, plane.spec))
+                pipe.send(((rew, term, trunc, infos), True))
+            elif command == "call":
+                name, args, kwargs = payload
+                if name in ("reset", "step", "close"):
+                    raise ValueError(
+                        f"use the dedicated API for `{name}`, not call()"
+                    )
+                attr = getattr(env, name)
+                result = attr(*args, **kwargs) if callable(attr) else attr
+                pipe.send((result, True))
+            elif command == "setattr":
+                name, value = payload
+                setattr(env, name, value)
+                pipe.send((None, True))
+            elif command == "close":
+                pipe.send((None, True))
+                break
+            else:
+                raise RuntimeError(f"unknown command {command!r}")
+    except (KeyboardInterrupt, EOFError):
+        pass
+    except Exception:
+        error_queue.put((index, type(sys.exc_info()[1]).__name__,
+                         traceback.format_exc()))
+        try:
+            pipe.send((None, False))
+        except (BrokenPipeError, OSError):
+            pass
+    finally:
+        if env is not None and hasattr(env, "close"):
+            try:
+                env.close()
+            except Exception:
+                pass

@@ -94,7 +94,9 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
                 break
             want = int(task.get("param_version", -1))
             if want >= 0 and want != version:
-                reply = send_recv(conn, {"kind": "params", "have": version})
+                reply = send_recv(
+                    conn, {"kind": "params", "have": version, "want": want}
+                )
                 if reply is not None:
                     version = int(reply["version"])
                     weights = reply["weights"]
@@ -170,8 +172,12 @@ class Gather:
             conn.send(task)
         elif kind == "params":
             have = int(msg["have"])
-            if self._params_version < 0 or have == self._params_version:
-                # cache miss (or worker already at our version → check server)
+            want = int(msg.get("want", -1))
+            if (
+                self._params_version < 0          # cache miss
+                or have == self._params_version   # worker already at cache
+                or want > self._params_version    # task needs newer weights
+            ):
                 reply = send_recv(
                     self.server, {"kind": "params", "have": self._params_version}
                 )

@@ -1,0 +1,203 @@
+"""Async shared-memory vector env tests (multi-agent + single-agent)."""
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from scalerl_tpu.envs.multi_agent import (
+    AutoResetParallelWrapper,
+    PursuitToyEnv,
+    SingleAgentAdapter,
+    make_multi_agent_vec_env,
+    make_shared_vec_envs,
+)
+from scalerl_tpu.envs.vector import (
+    AlreadyPendingCallError,
+    AsyncMultiAgentVecEnv,
+    ExperienceSpec,
+    NoAsyncCallError,
+    SharedObservationPlane,
+)
+
+NUM_ENVS = 3
+
+
+# ---------------------------------------------------------------------------
+# shared plane
+
+
+def test_shared_plane_layout_and_zero_copy():
+    spec = ExperienceSpec(
+        {"a": ((2, 2), np.uint8), "b": ((3,), np.float32)}, num_envs=4
+    )
+    plane = SharedObservationPlane(spec)
+    assert plane.view("a").shape == (4, 2, 2)
+    assert plane.view("a").dtype == np.uint8
+    plane.write_env(2, {"a": np.full((2, 2), 7, np.uint8), "b": np.ones(3)})
+    # a second view over the same RawArray sees the write (zero-copy)
+    np.testing.assert_array_equal(plane.view("a")[2], 7)
+    batch = plane.read_batch(copy=False)
+    assert batch["b"][2, 0] == 1.0
+    assert batch["a"][0].sum() == 0
+
+
+def test_shared_plane_visible_across_processes():
+    spec = ExperienceSpec({"x": ((2,), np.float32)}, num_envs=2)
+    plane = SharedObservationPlane(spec)
+
+    def child(plane, idx):
+        plane.write_env(idx, {"x": np.array([3.0, 4.0], np.float32)})
+
+    p = mp.Process(target=child, args=(plane, 1))
+    p.start()
+    p.join(timeout=10.0)
+    np.testing.assert_array_equal(plane.view("x")[1], [3.0, 4.0])
+
+
+# ---------------------------------------------------------------------------
+# async vec env (multi-agent)
+
+
+@pytest.fixture
+def vec():
+    env = AsyncMultiAgentVecEnv([PursuitToyEnv for _ in range(NUM_ENVS)])
+    yield env
+    env.close()
+
+
+def test_reset_and_step_shapes(vec):
+    obs, infos = vec.reset(seed=0)
+    assert set(obs.keys()) == {"chaser", "runner"}
+    assert obs["chaser"].shape == (NUM_ENVS, 4)
+    assert len(infos) == NUM_ENVS
+    actions = {
+        "chaser": np.ones(NUM_ENVS, np.int64),
+        "runner": np.zeros(NUM_ENVS, np.int64),
+    }
+    obs, rewards, terms, truncs, infos = vec.step(actions)
+    assert obs["runner"].shape == (NUM_ENVS, 4)
+    assert rewards["chaser"].shape == (NUM_ENVS,)
+    assert terms["chaser"].dtype == np.bool_
+    # different seeds -> different initial positions -> different obs rows
+    assert not np.allclose(obs["chaser"][0], obs["chaser"][1]) or not np.allclose(
+        obs["chaser"][1], obs["chaser"][2]
+    )
+
+
+def test_autoreset_reports_episode(vec):
+    vec.reset(seed=0)
+    stay = {
+        "chaser": np.ones(NUM_ENVS, np.int64),
+        "runner": np.ones(NUM_ENVS, np.int64),
+    }
+    saw_episode = False
+    for _ in range(40):  # episode_limit=32 forces truncation + autoreset
+        _, _, terms, truncs, infos = vec.step(stay)
+        for info in infos:
+            if "episode" in info:
+                saw_episode = True
+                assert info["episode"]["l"] > 0
+                assert "final_observation" in info
+    assert saw_episode
+
+
+def test_state_machine_guards(vec):
+    vec.reset(seed=0)
+    vec.step_async(
+        {
+            "chaser": np.zeros(NUM_ENVS, np.int64),
+            "runner": np.zeros(NUM_ENVS, np.int64),
+        }
+    )
+    with pytest.raises(AlreadyPendingCallError):
+        vec.reset_async()
+    vec.step_wait()
+    with pytest.raises(NoAsyncCallError):
+        vec.step_wait()
+
+
+def test_call_and_attrs(vec):
+    limits = vec.get_attr("episode_limit")
+    assert limits == [32] * NUM_ENVS
+    vec.set_attr("episode_limit", [8, 16, 24])
+    assert vec.get_attr("episode_limit") == [8, 16, 24]
+    spaces = vec.call("action_space", "chaser")
+    assert all(s.n == 3 for s in spaces)
+
+
+class _CrashingEnv(PursuitToyEnv):
+    def step(self, actions):
+        raise RuntimeError("boom at step")
+
+
+def test_worker_error_funneled():
+    env = AsyncMultiAgentVecEnv(
+        [PursuitToyEnv, _CrashingEnv], obs_spaces={
+            "chaser": ((4,), np.float32), "runner": ((4,), np.float32)}
+    )
+    try:
+        env.reset(seed=0)
+        with pytest.raises(RuntimeError, match="boom at step"):
+            env.step(
+                {
+                    "chaser": np.zeros(2, np.int64),
+                    "runner": np.zeros(2, np.int64),
+                }
+            )
+    finally:
+        env.close(terminate=True)
+
+
+# ---------------------------------------------------------------------------
+# wrappers + single-agent path
+
+
+def test_autoreset_wrapper_resets():
+    env = AutoResetParallelWrapper(PursuitToyEnv(episode_limit=2))
+    env.reset(seed=1)
+    acts = {"chaser": 1, "runner": 1}
+    for _ in range(6):  # runs past several episode boundaries without error
+        obs, rew, term, trunc, infos = env.step(acts)
+    assert obs["chaser"].shape == (4,)
+
+
+def test_single_agent_adapter_cartpole():
+    gym = pytest.importorskip("gymnasium")
+    vec = make_shared_vec_envs(lambda: gym.make("CartPole-v1"), num_envs=2)
+    try:
+        obs, _ = vec.reset(seed=0)
+        assert obs["agent_0"].shape == (2, 4)
+        obs, rew, term, trunc, infos = vec.step(
+            {"agent_0": np.zeros(2, np.int64)}
+        )
+        assert rew["agent_0"].shape == (2,)
+        assert obs["agent_0"].dtype == np.float32
+    finally:
+        vec.close()
+
+
+def test_forkserver_context_with_picklable_factories():
+    # spawn-family contexts are the safe choice on a JAX learner host;
+    # they require picklable factories and a picklable shared plane
+    vec = AsyncMultiAgentVecEnv(
+        [PursuitToyEnv, PursuitToyEnv], context="forkserver"
+    )
+    try:
+        obs, _ = vec.reset(seed=0)
+        assert obs["chaser"].shape == (2, 4)
+        obs, rew, *_ = vec.step(
+            {"chaser": np.zeros(2, np.int64), "runner": np.zeros(2, np.int64)}
+        )
+        assert rew["runner"].shape == (2,)
+    finally:
+        vec.close()
+
+
+def test_make_multi_agent_vec_env_helper():
+    vec = make_multi_agent_vec_env(PursuitToyEnv, num_envs=2)
+    try:
+        obs, _ = vec.reset(seed=3)
+        assert obs["chaser"].shape == (2, 4)
+    finally:
+        vec.close()
