@@ -1,4 +1,8 @@
 from scalerl_tpu.models.atari import AtariNet, AtariNetOutput  # noqa: F401
+from scalerl_tpu.models.transformer import (  # noqa: F401
+    TransformerOutput,
+    TransformerPolicy,
+)
 from scalerl_tpu.models.mlp import (  # noqa: F401
     ActorCriticNet,
     ActorNet,

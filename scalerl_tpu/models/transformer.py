@@ -1,0 +1,113 @@
+"""Decoder-only transformer policy for long-horizon trajectories.
+
+No counterpart in the reference (its sequence machinery tops out at a
+2-layer LSTM, ``scalerl/algorithms/utils/atari_model.py:109-120``); this is
+the long-context model family the TPU build adds: a causal transformer over
+the trajectory time axis producing per-step policy logits and baseline, with
+an attention implementation that can be swapped for sequence-parallel
+:func:`scalerl_tpu.ops.ring_attention.ring_attention` under ``shard_map``.
+
+Design notes for sequence parallelism: everything except attention is
+position-wise (LayerNorm, MLP, heads), so the module is valid when the time
+axis is sharded across the ``sp`` mesh axis — callers pass ``positions``
+(global step indices) so positional embeddings stay correct per shard.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from scalerl_tpu.ops.ring_attention import full_attention
+
+# (q, k, v) -> attention output, all [B, T, H, D]
+AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+class TransformerOutput(NamedTuple):
+    policy_logits: jnp.ndarray  # [B, T, num_actions]
+    baseline: jnp.ndarray  # [B, T]
+
+
+class _Block(nn.Module):
+    d_model: int
+    num_heads: int
+    mlp_ratio: int
+    attn_fn: AttentionFn
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        B, T, _ = x.shape
+        head_dim = self.d_model // self.num_heads
+        h = nn.LayerNorm(use_bias=False)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, T, self.num_heads, head_dim)
+        out = self.attn_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        out = nn.Dense(self.d_model, use_bias=False, name="proj")(
+            out.reshape(B, T, self.d_model)
+        )
+        x = x + out
+        h = nn.LayerNorm(use_bias=False)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, name="mlp_out")(h)
+        return x + h
+
+
+class TransformerPolicy(nn.Module):
+    """Causal transformer actor-critic over ``[B, T, obs_dim]`` features.
+
+    ``attn_fn``: defaults to single-device causal :func:`full_attention`;
+    pass a closed-over :func:`ring_attention` (inside ``shard_map``) for
+    sequence-parallel execution.  NOTE: a custom ``attn_fn`` must apply its
+    own causal masking — the default here is causal.
+    """
+
+    num_actions: int
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    max_len: int = 4096
+    attn_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(
+        self, obs: jnp.ndarray, positions: Optional[jnp.ndarray] = None
+    ) -> TransformerOutput:
+        B, T = obs.shape[:2]
+        if T > self.max_len:
+            # out-of-range gathers clamp silently under jit, which would
+            # alias every late position onto one embedding
+            raise ValueError(
+                f"sequence length {T} exceeds max_len={self.max_len}"
+            )
+        attn = self.attn_fn
+        if attn is None:
+            attn = lambda q, k, v: full_attention(q, k, v, causal=True)  # noqa: E731
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        x = nn.Dense(self.d_model, name="obs_embed")(
+            obs.reshape(B, T, -1).astype(jnp.float32)
+        )
+        pos_tab = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        x = x + pos_tab[positions]
+        for i in range(self.num_layers):
+            x = _Block(
+                self.d_model,
+                self.num_heads,
+                self.mlp_ratio,
+                attn,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(use_bias=False, name="final_norm")(x)
+        policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
+        baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
+        return TransformerOutput(policy_logits, baseline)

@@ -5,6 +5,11 @@ from scalerl_tpu.ops.losses import (  # noqa: F401
     entropy_loss,
     policy_gradient_loss,
 )
+from scalerl_tpu.ops.ring_attention import (  # noqa: F401
+    full_attention,
+    make_ring_attention_fn,
+    ring_attention,
+)
 from scalerl_tpu.ops.returns import (  # noqa: F401
     discounted_returns,
     gae_advantages,

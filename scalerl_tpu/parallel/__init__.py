@@ -41,3 +41,6 @@ from scalerl_tpu.parallel.train_step import (  # noqa: F401
     make_parallel_learn_fn,
 )
 from scalerl_tpu.parallel.multihost import initialize_multihost  # noqa: F401
+from scalerl_tpu.parallel.sequence import (  # noqa: F401
+    make_sequence_parallel_apply,
+)
