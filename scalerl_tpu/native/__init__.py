@@ -1,0 +1,10 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The reference's "native muscle" was all third-party (NCCL/CUDA via torch —
+SURVEY.md §2 intro); this package is the TPU build's own native layer:
+a lock-free shared-memory rollout ring (``csrc/shm_ring.cpp``) used by the
+actor->learner hot path.  Everything degrades gracefully: if no compiler is
+available the callers fall back to pure-Python implementations.
+"""
+
+from scalerl_tpu.native.build import load_ring_lib, native_available  # noqa: F401

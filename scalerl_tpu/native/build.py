@@ -1,0 +1,116 @@
+"""Lazy g++ build + ctypes loader for the native runtime library."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_CSRC = Path(__file__).resolve().parents[2] / "csrc"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+_LIB_PATH = _BUILD_DIR / "libsrl_ring.so"
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build() -> Optional[Path]:
+    src = _CSRC / "shm_ring.cpp"
+    if not src.exists():
+        return None
+    if _LIB_PATH.exists() and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime:
+        return _LIB_PATH
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    # cross-process safety: serialize concurrent builds with a file lock and
+    # publish via atomic rename so no process can dlopen a half-written .so
+    import fcntl
+
+    lock_path = _BUILD_DIR / ".build.lock"
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            if (
+                _LIB_PATH.exists()
+                and _LIB_PATH.stat().st_mtime >= src.stat().st_mtime
+            ):
+                return _LIB_PATH  # another process built it while we waited
+            tmp = _BUILD_DIR / f"libsrl_ring.{os.getpid()}.tmp.so"
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                "-o", str(tmp), str(src), "-lpthread",
+            ]
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, text=True, timeout=120
+                )
+                os.replace(tmp, _LIB_PATH)
+            except (OSError, subprocess.SubprocessError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                logger.warning(
+                    "native build failed, using Python fallback: %s", detail
+                )
+                tmp.unlink(missing_ok=True)
+                return None
+            return _LIB_PATH
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
+def _annotate(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.srl_ring_bytes.argtypes = [ctypes.c_uint32]
+    lib.srl_ring_bytes.restype = ctypes.c_uint64
+    lib.srl_ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.srl_ring_init.restype = ctypes.c_int
+    lib.srl_ring_check.argtypes = [ctypes.c_void_p]
+    lib.srl_ring_check.restype = ctypes.c_int
+    lib.srl_ring_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.srl_ring_acquire.restype = ctypes.c_int32
+    lib.srl_ring_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.srl_ring_commit.restype = ctypes.c_int
+    lib.srl_ring_pop_full.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.srl_ring_pop_full.restype = ctypes.c_int32
+    lib.srl_ring_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.srl_ring_release.restype = ctypes.c_int
+    lib.srl_ring_close.argtypes = [ctypes.c_void_p]
+    lib.srl_ring_close.restype = None
+    lib.srl_ring_closed.argtypes = [ctypes.c_void_p]
+    lib.srl_ring_closed.restype = ctypes.c_int
+    lib.srl_gather_batch.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.c_uint32,
+        ctypes.c_uint64,
+    ]
+    lib.srl_gather_batch.restype = None
+    return lib
+
+
+def load_ring_lib() -> Optional[ctypes.CDLL]:
+    """Build (once) and load the native ring library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("SCALERL_TPU_NO_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            _LIB = _annotate(ctypes.CDLL(str(path)))
+        except OSError as e:
+            logger.warning("could not load native lib: %s", e)
+            _LIB = None
+        return _LIB
+
+
+def native_available() -> bool:
+    return load_ring_lib() is not None

@@ -1,0 +1,230 @@
+"""Cross-process rollout slot ring over shared memory.
+
+The process-grade big brother of :class:`~scalerl_tpu.runtime.rollout_queue.
+RolloutQueue` (which is thread-scoped): actor *processes* acquire fixed-size
+trajectory slots, fill them through zero-copy numpy views, and commit; the
+learner drains committed slots and recycles them.  Index handoff goes
+through the lock-free C++ ring (``csrc/shm_ring.cpp``) when the native
+toolchain is present, else through ``multiprocessing`` queues — the payload
+path (shared-memory numpy slots) is identical either way.
+
+Parity target: the reference's shared-tensor pool + SimpleQueue index cycle
+(``scalerl/impala/impala_atari.py:122-151,416-437``), minus the per-handoff
+pickle and with multi-producer/multi-consumer safety.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+from multiprocessing import shared_memory
+from typing import Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from scalerl_tpu.native import load_ring_lib
+
+_ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class SlotSpec:
+    """Field layout of one trajectory slot: name -> (shape, dtype)."""
+
+    def __init__(self, fields: Mapping[str, Tuple[Tuple[int, ...], np.dtype]]):
+        self.fields: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {
+            k: (tuple(s), np.dtype(d)) for k, (s, d) in fields.items()
+        }
+        self.offsets: Dict[str, int] = {}
+        off = 0
+        for name, (shape, dtype) in self.fields.items():
+            self.offsets[name] = off
+            off += _aligned(int(np.prod(shape)) * dtype.itemsize)
+        self.slot_bytes = _aligned(off)
+
+    def views(self, buf: memoryview) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, (shape, dtype) in self.fields.items():
+            start = self.offsets[name]
+            n = int(np.prod(shape)) * dtype.itemsize
+            out[name] = np.frombuffer(
+                buf[start:start + n], dtype=dtype
+            ).reshape(shape)
+        return out
+
+
+class ShmRolloutRing:
+    """MPMC slot ring shared by actor processes and the learner."""
+
+    def __init__(
+        self,
+        spec: SlotSpec,
+        num_slots: int,
+        use_native: Optional[bool] = None,
+    ) -> None:
+        if num_slots < 2:
+            raise ValueError(f"num_slots must be >= 2, got {num_slots}")
+        self.spec = spec
+        self.num_slots = num_slots
+        lib = load_ring_lib() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("native ring requested but unavailable")
+        self.native = lib is not None
+        ctrl_bytes = (
+            int(lib.srl_ring_bytes(num_slots)) if self.native else 0
+        )
+        self._ctrl_bytes = _aligned(ctrl_bytes)
+        total = self._ctrl_bytes + num_slots * spec.slot_bytes
+        self.shm = shared_memory.SharedMemory(create=True, size=total)
+        self._owner = True
+        if self.native:
+            self.shm.buf[:self._ctrl_bytes] = b"\x00" * self._ctrl_bytes
+            rc = lib.srl_ring_init(self._base_ptr(), num_slots)
+            assert rc == 0
+            self._free = self._full = None
+        else:
+            ctx = mp.get_context()
+            self._free = ctx.Queue()
+            self._full = ctx.Queue()
+            for i in range(num_slots):
+                self._free.put(i)
+            self._closed = ctx.Event()
+
+    # -- pickling: children re-attach by shm name ----------------------
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["shm"] = None
+        state["_shm_name"] = self.shm.name
+        state["_owner"] = False
+        return state
+
+    def __setstate__(self, state):
+        name = state.pop("_shm_name")
+        self.__dict__.update(state)
+        self.shm = shared_memory.SharedMemory(name=name)
+
+    def _base_ptr(self) -> int:
+        return ctypes.addressof(ctypes.c_char.from_buffer(self.shm.buf))
+
+    def _lib(self):
+        lib = load_ring_lib()
+        assert lib is not None, "native lib vanished across processes"
+        return lib
+
+    def _fallback_get(self, q, timeout: Optional[float]) -> Optional[int]:
+        """Queue get that also wakes on close() (mirrors native rc=-2)."""
+        import queue as _q
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while not self._closed.is_set():
+            step = 0.1
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return None
+                step = min(step, remaining)
+            try:
+                return q.get(timeout=step)
+            except _q.Empty:
+                continue
+        return None
+
+    # -- actor side ----------------------------------------------------
+    def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Free slot index, or None on timeout/closed."""
+        if self.native:
+            us = -1 if timeout is None else int(timeout * 1e6)
+            idx = int(self._lib().srl_ring_acquire(self._base_ptr(), us))
+            return idx if idx >= 0 else None
+        return self._fallback_get(self._free, timeout)
+
+    def commit(self, idx: int) -> None:
+        if self.native:
+            rc = self._lib().srl_ring_commit(self._base_ptr(), idx)
+            if rc != 0:
+                raise RuntimeError(f"ring commit failed rc={rc}")
+        else:
+            self._full.put(idx)
+
+    # -- learner side --------------------------------------------------
+    def pop_full(self, timeout: Optional[float] = None) -> Optional[int]:
+        if self.native:
+            us = -1 if timeout is None else int(timeout * 1e6)
+            idx = int(self._lib().srl_ring_pop_full(self._base_ptr(), us))
+            return idx if idx >= 0 else None
+        return self._fallback_get(self._full, timeout)
+
+    def release(self, idx: int) -> None:
+        if self.native:
+            rc = self._lib().srl_ring_release(self._base_ptr(), idx)
+            if rc != 0:
+                raise RuntimeError(f"ring release failed rc={rc}")
+        else:
+            self._free.put(idx)
+
+    # -- payload -------------------------------------------------------
+    def slot(self, idx: int) -> Dict[str, np.ndarray]:
+        """Zero-copy field views of slot ``idx`` in shared memory."""
+        if not 0 <= idx < self.num_slots:
+            raise IndexError(idx)
+        start = self._ctrl_bytes + idx * self.spec.slot_bytes
+        return self.spec.views(self.shm.buf[start:start + self.spec.slot_bytes])
+
+    def gather_batch(
+        self, idxs: List[int], out: Optional[Dict[str, np.ndarray]] = None
+    ) -> Dict[str, np.ndarray]:
+        """Stack slots into ``[len(idxs), ...]`` per-field batches (native
+        memcpy when the C++ lib is loaded, Python copy loop otherwise)."""
+        if out is None:
+            out = {
+                name: np.empty((len(idxs),) + shape, dtype)
+                for name, (shape, dtype) in self.spec.fields.items()
+            }
+        if self.native and idxs:
+            lib = self._lib()
+            base = self._base_ptr() + self._ctrl_bytes
+            n = len(idxs)
+            for name, (shape, dtype) in self.spec.fields.items():
+                nbytes = int(np.prod(shape)) * dtype.itemsize
+                srcs = (ctypes.c_char_p * n)(
+                    *(
+                        base + idx * self.spec.slot_bytes + self.spec.offsets[name]
+                        for idx in idxs
+                    )
+                )
+                dst = out[name]
+                assert dst.flags["C_CONTIGUOUS"]
+                lib.srl_gather_batch(
+                    dst.ctypes.data_as(ctypes.c_char_p), srcs, n, nbytes
+                )
+            return out
+        for b, idx in enumerate(idxs):
+            for name, view in self.slot(idx).items():
+                out[name][b] = view
+        return out
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        if self.native:
+            self._lib().srl_ring_close(self._base_ptr())
+        else:
+            self._closed.set()
+
+    def detach(self) -> None:
+        try:
+            self.shm.close()
+        except (BufferError, OSError):
+            pass
+
+    def unlink(self) -> None:
+        """Owner-side final cleanup of the shared segment."""
+        self.detach()
+        if self._owner:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
