@@ -36,6 +36,12 @@ def main() -> None:
     if args.env_backend == "jax":
         from scalerl_tpu.trainer.actor_learner import DeviceActorLearnerTrainer
 
+        if args.mesh_shape:
+            print(
+                "WARNING: --mesh-shape is not wired into the fused jax "
+                "backend yet; use --env-backend gym for a sharded learner",
+                flush=True,
+            )
         venv = make_jax_vec_env(args.env_id, num_envs=args.num_envs)
         agent = ImpalaAgent(
             args,
@@ -76,6 +82,11 @@ def main() -> None:
             num_actions=num_actions,
             obs_dtype=jnp.uint8 if len(obs_shape) == 3 else jnp.float32,
         )
+        if args.mesh_shape:
+            # shard the learn step over the mesh; batches arrive host-side
+            # here (unlike the fused jax backend), so this is the path that
+            # exercises dp/fsdp/tp sharding with real envs
+            agent.enable_mesh(args.mesh_shape)
         trainer = HostActorLearnerTrainer(args, agent, env_fns)
 
     try:

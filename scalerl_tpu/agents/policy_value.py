@@ -57,7 +57,10 @@ class PolicyValueAgent(BaseAgent):
 
         self.optimizer = optimizer
         self.state = make_state(params, optimizer.init(params))
+        self._learn_fn = learn_fn  # raw (un-jitted) for enable_mesh re-wrap
         self._learn = jax.jit(learn_fn)
+        self._shard_batch = None
+        self.mesh = None
 
         def act(params, obs, last_action, reward, done, core_state, key):
             """One acting step: obs [B, ...] -> sampled actions, logits, state."""
@@ -122,7 +125,31 @@ class PolicyValueAgent(BaseAgent):
             )
         )
 
+    def enable_mesh(self, mesh_or_spec, batch_example=None) -> None:
+        """Shard the learn step over a device mesh (the ``--mesh-shape``
+        path): batch over dp×fsdp, params/opt state over fsdp/tp where
+        divisible, gradient psum inserted by GSPMD.  Call once, before
+        training; subsequent ``learn()`` calls shard incoming batches."""
+        from jax.sharding import Mesh
+
+        from scalerl_tpu.parallel import make_mesh, make_parallel_learn_fn
+
+        mesh = (
+            mesh_or_spec
+            if isinstance(mesh_or_spec, Mesh)
+            else make_mesh(mesh_or_spec)
+        )
+        plearn = make_parallel_learn_fn(
+            self._learn_fn, mesh, self.state, batch_example=batch_example
+        )
+        self.mesh = mesh
+        self.state = plearn.shard_state(self.state)
+        self._learn = plearn
+        self._shard_batch = plearn.shard_batch
+
     def learn(self, traj) -> Dict[str, float]:
+        if self._shard_batch is not None:
+            traj = self._shard_batch(traj)
         self.state, metrics = self._learn(self.state, traj)
         return {k: float(v) for k, v in metrics.items()}
 

@@ -99,14 +99,19 @@ def per_sample(
     beta: jnp.ndarray,
     n_step: int = 1,
     gamma: float = 0.99,
+    method: str = "hierarchical",
 ) -> Dict[str, jnp.ndarray]:
     """Stratified proportional sample; returns transitions + ``weights``.
 
     The distribution is ``p_i^alpha`` over valid logical rows (those with a
-    full n-step window); sampling is a cumsum + stratified searchsorted
-    (plan A of SURVEY.md §7; Pallas tree is plan B if this path ever
-    dominates the profile).
+    full n-step window).  ``method`` picks the search implementation
+    (``ops/pallas_per.py``): ``cumsum`` is SURVEY.md §7's plan A,
+    ``hierarchical`` a two-level XLA search that avoids materializing the
+    full-capacity cumsum, ``pallas`` the TPU kernel with scalar-prefetched
+    block DMA.
     """
+    from scalerl_tpu.ops.pallas_per import proportional_sample
+
     capacity, num_envs = state.priorities.shape
     start = _logical_start(state.replay, capacity)
     size = state.replay.size
@@ -118,14 +123,12 @@ def per_sample(
     p = jnp.where(valid, logical_prio, 0.0) ** alpha
     p = jnp.where(valid, jnp.maximum(p, 1e-12), 0.0)
     flat_p = p.reshape(-1)
-    cum = jnp.cumsum(flat_p)
-    total = cum[-1]
+    total = jnp.sum(flat_p)
 
     # Stratified uniforms: one per bucket.
     u = jax.random.uniform(key, (batch_size,))
     targets = (jnp.arange(batch_size) + u) / batch_size * total
-    flat_logical = jnp.searchsorted(cum, targets, side="left")
-    flat_logical = jnp.clip(flat_logical, 0, capacity * num_envs - 1)
+    flat_logical = proportional_sample(flat_p, targets, method=method)
 
     probs = flat_p[flat_logical] / jnp.maximum(total, 1e-12)
     n_valid = jnp.maximum(jnp.sum(valid) * num_envs, 1).astype(jnp.float32)
@@ -166,6 +169,7 @@ class PrioritizedReplayBuffer:
         n_step: int = 1,
         gamma: float = 0.99,
         extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
+        sample_method: str = "hierarchical",
     ) -> None:
         self.spec = dict(transition_spec(obs_shape, obs_dtype))
         if extra_fields:
@@ -175,13 +179,14 @@ class PrioritizedReplayBuffer:
         self.alpha = alpha
         self.n_step = n_step
         self.gamma = gamma
+        self.sample_method = sample_method
         self.state = per_init(self.spec, capacity, num_envs)
         self._add = jax.jit(per_add, donate_argnums=0)
         self._add_prio = jax.jit(per_add_with_priorities, donate_argnums=0)
         # alpha/beta are *traced* args: beta follows a per-step schedule and
         # making it static would recompile the sampler on every train step
         self._sample = jax.jit(
-            per_sample, static_argnames=("batch_size", "n_step", "gamma")
+            per_sample, static_argnames=("batch_size", "n_step", "gamma", "method")
         )
         self._update = jax.jit(per_update_priorities, donate_argnums=0)
 
@@ -222,6 +227,7 @@ class PrioritizedReplayBuffer:
             beta=jnp.float32(beta),
             n_step=self.n_step,
             gamma=self.gamma,
+            method=self.sample_method,
         )
 
     def update_priorities(self, indices, priorities) -> None:

@@ -212,6 +212,7 @@ class ReplayBuffer:
         if device is not None:
             self.state = jax.device_put(self.state, device)
         self._add = jax.jit(replay_add, donate_argnums=0)
+        self._add_chunk = jax.jit(replay_add_chunk, donate_argnums=0)
         self._sample = jax.jit(
             replay_sample, static_argnames=("batch_size", "n_step", "gamma")
         )
@@ -238,6 +239,21 @@ class ReplayBuffer:
             if v.shape != want:
                 step[k] = v.reshape(want)
         self.state = self._add(self.state, step)
+
+    def save_chunk(self, **chunk) -> None:
+        """Add a ``[T, ...]`` transition chunk in one device call.
+
+        Callers feeding single-transition streams (e.g. fleet episode
+        uploads) should batch into *fixed-size* chunks so this compiles
+        once; varying T recompiles per length.
+        """
+        step = {k: jnp.asarray(v) for k, v in chunk.items()}
+        T = next(iter(step.values())).shape[0]
+        for k, v in step.items():
+            want = (T, self.num_envs) + tuple(self.spec[k][0])
+            if v.shape != want:
+                step[k] = v.reshape(want)
+        self.state = self._add_chunk(self.state, step)
 
     def sample(self, batch_size: int, key: Optional[jax.Array] = None) -> Dict[str, jnp.ndarray]:
         if key is None:

@@ -1,4 +1,8 @@
-from scalerl_tpu.envs.gym_env import make_gym_env, make_vect_envs  # noqa: F401
+from scalerl_tpu.envs.gym_env import (  # noqa: F401
+    make_gym_env,
+    make_multi_agent_vect_envs,
+    make_vect_envs,
+)
 from scalerl_tpu.envs.jax_envs import (  # noqa: F401
     JaxCartPole,
     JaxVecEnv,

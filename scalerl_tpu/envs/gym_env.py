@@ -86,7 +86,7 @@ def make_multi_agent_vect_envs(
     **env_kwargs,
 ):
     """PettingZoo parallel-env pool (``env_utils.py:97-120`` parity)."""
-    from scalerl_tpu.envs.vector.pz_async_vec_env import AsyncPettingZooVecEnv
+    from scalerl_tpu.envs.vector import AsyncMultiAgentVecEnv
 
     env_fns = [partial(env_fn, **env_kwargs) for _ in range(num_envs)]
-    return AsyncPettingZooVecEnv(env_fns)
+    return AsyncMultiAgentVecEnv(env_fns)

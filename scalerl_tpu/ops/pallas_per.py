@@ -1,0 +1,132 @@
+"""Hierarchical prioritized-replay sampling: XLA two-level + Pallas kernel.
+
+SURVEY.md §7 called cumsum-over-capacity "plan A" and a Pallas path "plan B
+if this ever dominates the profile".  Both live here:
+
+- :func:`hierarchical_sample` (XLA, any backend): split the priority plane
+  into blocks; a tiny block-sum cumsum picks each sample's block, then only
+  the selected blocks (``[S, block]``) are scanned — O(N + S·block) instead
+  of a full O(N) cumsum materialized per sample batch, and the big array is
+  read once, streaming.
+- :func:`pallas_sample` (TPU): the within-block phase as a Pallas kernel
+  with **scalar-prefetched block indices** — each grid step DMAs exactly one
+  priority block HBM→VMEM via the prefetched index map (no ``[S, block]``
+  gather materialization in HBM at all) and runs the cumsum+count search on
+  the VPU.
+
+Both produce the same sample for the same uniform targets (same float
+summation order within blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_targets(
+    flat_p: jnp.ndarray, targets: jnp.ndarray, block_size: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Phase 1 (shared): per-block sums -> block choice + residual target.
+
+    Returns (blocks [nb, bs], block_idx [S], within_target [S]).
+    """
+    n = flat_p.shape[0]
+    pad = (-n) % block_size
+    if pad:
+        flat_p = jnp.pad(flat_p, (0, pad))
+    blocks = flat_p.reshape(-1, block_size)
+    block_cum = jnp.cumsum(blocks.sum(axis=1))
+    b_idx = jnp.clip(
+        jnp.searchsorted(block_cum, targets, side="left"),
+        0,
+        blocks.shape[0] - 1,
+    )
+    prev = jnp.where(b_idx > 0, block_cum[b_idx - 1], 0.0)
+    return blocks, b_idx.astype(jnp.int32), targets - prev
+
+
+def hierarchical_sample(
+    flat_p: jnp.ndarray, targets: jnp.ndarray, block_size: int = 1024
+) -> jnp.ndarray:
+    """Two-level proportional search; returns flat indices, one per target."""
+    blocks, b_idx, within_t = _split_targets(flat_p, targets, block_size)
+    rows = blocks[b_idx]                      # [S, bs]
+    row_cum = jnp.cumsum(rows, axis=1)
+    w_idx = jnp.sum(row_cum < within_t[:, None], axis=1)
+    w_idx = jnp.clip(w_idx, 0, block_size - 1)
+    return jnp.clip(
+        b_idx * block_size + w_idx, 0, flat_p.shape[0] - 1
+    ).astype(jnp.int32)
+
+
+def _within_block_kernel(b_idx_ref, t_ref, p_ref, out_ref):
+    """One sample per grid step: search the prefetch-selected block."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    t = t_ref[i, 0]
+    cum = jnp.cumsum(p_ref[0, :])
+    w = jnp.sum((cum < t).astype(jnp.int32))
+    bs = p_ref.shape[-1]
+    w = jnp.minimum(w, bs - 1)
+    out_ref[i, 0] = b_idx_ref[i] * bs + w
+
+
+def pallas_sample(
+    flat_p: jnp.ndarray,
+    targets: jnp.ndarray,
+    block_size: int = 1024,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas within-block search; distribution-identical to
+    :func:`hierarchical_sample`."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    blocks, b_idx, within_t = _split_targets(flat_p, targets, block_size)
+    S = targets.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,              # b_idx steers the DMA index map
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((S, 1), lambda i, b_idx_ref: (0, 0)),
+            pl.BlockSpec(
+                (1, block_size), lambda i, b_idx_ref: (b_idx_ref[i], 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((S, 1), lambda i, b_idx_ref: (0, 0)),
+    )
+    out = pl.pallas_call(
+        _within_block_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, 1), jnp.int32),
+        interpret=interpret,
+    )(b_idx, within_t[:, None], blocks)
+    return jnp.clip(out[:, 0], 0, flat_p.shape[0] - 1)
+
+
+def proportional_sample(
+    flat_p: jnp.ndarray,
+    targets: jnp.ndarray,
+    method: str = "hierarchical",
+    block_size: int = 1024,
+) -> jnp.ndarray:
+    """Dispatch: ``cumsum`` (flat plan A), ``hierarchical``, or ``pallas``."""
+    if method == "cumsum":
+        cum = jnp.cumsum(flat_p)
+        idx = jnp.searchsorted(cum, targets, side="left")
+        return jnp.clip(idx, 0, flat_p.shape[0] - 1).astype(jnp.int32)
+    if method == "hierarchical":
+        return hierarchical_sample(flat_p, targets, block_size)
+    if method == "pallas":
+        return pallas_sample(flat_p, targets, block_size)
+    raise ValueError(f"unknown sampling method {method!r}")
+
+
+@functools.partial(jax.jit, static_argnames=("method", "block_size"))
+def _jitted_proportional_sample(flat_p, targets, method, block_size):
+    return proportional_sample(flat_p, targets, method, block_size)

@@ -23,6 +23,9 @@ from typing import Dict, List, Mapping, Optional, Tuple
 import numpy as np
 
 from scalerl_tpu.native import load_ring_lib
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 _ALIGN = 64
 
@@ -208,6 +211,15 @@ class ShmRolloutRing:
         return out
 
     # -- lifecycle -----------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        """True once any holder called close() — lets pollers distinguish
+        shutdown from a timeout (both return None from acquire/pop_full):
+        ``while not ring.closed: idx = ring.pop_full(timeout=1.0) ...``"""
+        if self.native:
+            return bool(self._lib().srl_ring_closed(self._base_ptr()))
+        return self._closed.is_set()
+
     def close(self) -> None:
         if self.native:
             self._lib().srl_ring_close(self._base_ptr())
@@ -215,9 +227,24 @@ class ShmRolloutRing:
             self._closed.set()
 
     def detach(self) -> None:
+        """Drop this process's mapping.  Callers must release every
+        ``slot()`` view first — live views keep the buffer exported and the
+        mapping cannot close (warned, not silently leaked)."""
+        import gc
+
         try:
             self.shm.close()
-        except (BufferError, OSError):
+        except BufferError:
+            gc.collect()  # drop unreferenced slot views, then retry once
+            try:
+                self.shm.close()
+            except BufferError:
+                logger.warning(
+                    "shm ring %s not closed: slot views still alive "
+                    "(release them before detach/unlink)",
+                    self.shm.name,
+                )
+        except OSError:
             pass
 
     def unlink(self) -> None:

@@ -42,13 +42,38 @@ class _ColorFormatter(logging.Formatter):
 
 
 def process_index() -> int:
-    """Current distributed process index (0 on single-host)."""
-    try:
-        import jax
+    """Current distributed process index (0 on single-host).
 
-        return jax.process_index()
-    except Exception:  # pragma: no cover - jax import/uninit edge
-        return int(os.environ.get("SCALERL_PROCESS_INDEX", "0"))
+    Deliberately does NOT force JAX backend initialization:
+    ``get_logger`` runs at module-import time all over the package, and
+    ``jax.process_index()`` would spin up the device runtime (on the axon
+    TPU tunnel this can block for minutes while another process holds the
+    chip).  If no backend exists yet, the multihost process id — when
+    ``jax.distributed`` was initialized — or the env override decides.
+    """
+    # env override wins (also the escape hatch if the private-API probes
+    # below break on a jax upgrade — they are each isolated so a rename
+    # degrades to the next probe, never to an exception)
+    env = os.environ.get("SCALERL_PROCESS_INDEX")
+    if env is not None:
+        return int(env)
+    try:  # multihost: jax.distributed.initialize() recorded a process id
+        from jax._src import distributed
+
+        pid = getattr(distributed.global_state, "process_id", None)
+        if pid:  # 0 is also the uninitialized default -> fall through
+            return int(pid)
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    try:  # backend already up -> querying it is cheap and safe
+        import jax
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            return jax.process_index()
+    except Exception:  # pragma: no cover - private-API drift
+        pass
+    return 0
 
 
 def get_logger(

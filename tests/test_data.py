@@ -185,3 +185,29 @@ def test_trajectory_spec():
     spec1 = TrajectorySpec(unroll_length=3, batch_size=1, obs_shape=(4,), num_actions=2)
     stacked = stack_trajectories([spec1.zeros(), spec1.zeros()])
     assert stacked.obs.shape == (4, 2, 4)
+
+
+def test_replay_save_chunk_matches_stepwise():
+    import numpy as np
+
+    from scalerl_tpu.data.replay import ReplayBuffer
+
+    rng = np.random.default_rng(0)
+    a = ReplayBuffer(obs_shape=(3,), capacity=32, num_envs=1)
+    b = ReplayBuffer(obs_shape=(3,), capacity=32, num_envs=1)
+    T = 8
+    obs = rng.normal(size=(T, 1, 3)).astype(np.float32)
+    nxt = rng.normal(size=(T, 1, 3)).astype(np.float32)
+    act = rng.integers(0, 2, size=(T, 1))
+    rew = rng.normal(size=(T, 1)).astype(np.float32)
+    done = np.zeros((T, 1), bool)
+    for t in range(T):
+        a.save_to_memory(obs[t], nxt[t], act[t], rew[t], done[t])
+    b.save_chunk(obs=obs, next_obs=nxt, action=act, reward=rew, done=done)
+    assert len(a) == len(b) == T
+    np.testing.assert_allclose(
+        np.asarray(a.state.storage["obs"]), np.asarray(b.state.storage["obs"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.state.storage["action"]), np.asarray(b.state.storage["action"])
+    )
