@@ -1,0 +1,126 @@
+"""Mixture-of-experts layer with expert parallelism over the ``ep`` axis.
+
+No counterpart in the reference (SURVEY.md §2.4: expert parallelism absent);
+this completes the mesh's parallelism vocabulary.  The design is the
+GShard/Switch dispatch-combine formulation, which is the TPU-native shape
+for MoE: routing becomes dense einsums over a ``[tokens, experts, capacity]``
+one-hot dispatch tensor, experts are a single ``[E, ...]``-leading batch of
+matmuls, and sharding that leading axis over ``ep`` makes XLA insert the
+token all-to-alls — no hand-written communication.
+
+Top-1 (Switch) routing with capacity dropping: tokens beyond an expert's
+capacity pass through the residual only.  A load-balancing auxiliary loss
+(Switch Transformer eq. 4) is returned for the trainer to add.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEOutput(NamedTuple):
+    out: jnp.ndarray        # [N, d_model] combined expert outputs
+    aux_loss: jnp.ndarray   # scalar load-balancing loss
+    dispatch_frac: jnp.ndarray  # scalar: fraction of tokens not dropped
+
+
+def top1_dispatch(
+    gates: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Build dispatch/combine tensors for top-1 routing.
+
+    gates: [N, E] softmax router outputs.
+    Returns (dispatch [N, E, C] bool-ish float, combine [N, E, C], aux).
+    """
+    N, E = gates.shape
+    expert = jnp.argmax(gates, axis=-1)                    # [N]
+    onehot = jax.nn.one_hot(expert, E, dtype=gates.dtype)  # [N, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [N, E], 0-based
+    keep = (pos < capacity).astype(gates.dtype) * onehot
+    pos_cap = jax.nn.one_hot(
+        jnp.clip(pos.astype(jnp.int32), 0, capacity - 1), capacity,
+        dtype=gates.dtype,
+    )                                                      # [N, E, C]
+    dispatch = keep[..., None] * pos_cap
+    gate_val = jnp.sum(gates * onehot, axis=-1, keepdims=True)  # [N, 1]
+    combine = dispatch * gate_val[..., None]
+    # Switch aux loss: E * sum_e mean_tokens(router prob_e) * frac_tokens_e
+    frac_tokens = onehot.mean(axis=0)
+    mean_prob = gates.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * mean_prob)
+    return dispatch, combine, aux
+
+
+class MoEMLP(nn.Module):
+    """Switch-routed expert FFN over flattened tokens ``[N, d_model]``."""
+
+    num_experts: int
+    d_model: int
+    d_hidden: int
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> MoEOutput:
+        N, M = x.shape
+        E = self.num_experts
+        C = max(int(self.capacity_factor * N / E), 1)
+        gates = jax.nn.softmax(
+            nn.Dense(E, use_bias=False, name="router")(x), axis=-1
+        )
+        dispatch, combine, aux = top1_dispatch(gates, C)
+        # [E, C, M] expert input batches — the tensor whose leading axis is
+        # sharded over 'ep' (XLA derives the all-to-all from the shardings)
+        expert_in = jnp.einsum("nec,nm->ecm", dispatch, x)
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (E, M, self.d_hidden)
+        )
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (E, self.d_hidden, M)
+        )
+        h = jax.nn.relu(jnp.einsum("ecm,emh->ech", expert_in, w_in))
+        expert_out = jnp.einsum("ech,ehm->ecm", h, w_out)
+        out = jnp.einsum("nec,ecm->nm", combine, expert_out)
+        dispatched = jnp.sum(dispatch) / N
+        return MoEOutput(out, aux, dispatched)
+
+
+class MoEPolicy(nn.Module):
+    """Small actor-critic whose trunk is dense->MoE->dense (per-step obs
+    features ``[B, obs_dim]``) — the expert-parallel model family entry."""
+
+    num_actions: int
+    d_model: int = 128
+    num_experts: int = 8
+    d_hidden: int = 256
+    capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray):
+        x = nn.relu(nn.Dense(self.d_model, name="embed")(
+            obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        ))
+        moe = MoEMLP(
+            self.num_experts,
+            self.d_model,
+            self.d_hidden,
+            self.capacity_factor,
+            name="moe",
+        )(x)
+        x = nn.LayerNorm()(x + moe.out)
+        policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
+        baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
+        return policy_logits, baseline, moe.aux_loss
+
+
+def expert_sharding_rule(path: Tuple[str, ...]) -> Optional[Tuple]:
+    """Param-spec rule for :func:`scalerl_tpu.parallel.sharding
+    .infer_param_spec`-style use: shard expert-leading tensors over ep."""
+    name = path[-1] if path else ""
+    if name in ("w_in", "w_out"):
+        return ("ep", None, None)
+    return None

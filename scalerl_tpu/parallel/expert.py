@@ -1,0 +1,45 @@
+"""Expert parallelism: run an MoE model with experts sharded over ``ep``.
+
+Completes the mesh's parallelism families (SURVEY.md §2.4 lists EP as
+absent in the reference).  No shard_map needed: the expert-batched einsums
+of :class:`scalerl_tpu.models.moe.MoEMLP` carry an ``[E, ...]`` leading
+axis, so sharding the expert params and constraining the dispatched-token
+tensor over ``ep`` lets GSPMD derive the token all-to-alls.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def expert_param_sharding(params: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree: expert-leading tensors (``w_in``/``w_out``,
+    dim0 = num_experts) over ``ep``; everything else replicated."""
+
+    def rule(path, leaf):
+        name = str(path[-1].key) if path else ""
+        ep = mesh.shape.get("ep", 1)
+        if name in ("w_in", "w_out") and leaf.ndim == 3 and leaf.shape[0] % ep == 0:
+            return NamedSharding(mesh, P("ep", None, None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def make_expert_parallel_apply(model, mesh: Mesh, params: Any):
+    """jit ``model.apply`` with experts sharded over ``ep``.
+
+    Returns ``(apply_fn, sharded_params)``; inputs stay replicated (token
+    dispatch redistributes work across experts, hence across ``ep``).
+    """
+    p_sh = expert_param_sharding(params, mesh)
+    sharded_params = jax.device_put(params, p_sh)
+    rep = NamedSharding(mesh, P())
+
+    apply_fn = jax.jit(
+        model.apply, in_shardings=(p_sh, rep), out_shardings=None
+    )
+    return apply_fn, sharded_params

@@ -16,10 +16,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Fixed axis order.  dp outermost (DCN/ICI-friendly data parallel), then the
-# param-sharding axis, then tensor / sequence / expert innermost where
-# collectives are most frequent and must ride the fastest ICI hops.
-AXIS_NAMES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp", "ep")
+# Fixed axis order.  dp outermost (DCN/ICI-friendly data parallel), then
+# pipeline stages, then the param-sharding axis, then tensor / sequence /
+# expert innermost where collectives are most frequent and must ride the
+# fastest ICI hops.
+AXIS_NAMES: Tuple[str, ...] = ("dp", "pp", "fsdp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)

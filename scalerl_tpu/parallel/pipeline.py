@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pp`` axis.
+
+No counterpart in the reference (SURVEY.md §2.4 lists PP as absent); this
+completes the mesh's parallelism families.  Homogeneous stages (same
+input/output shape) are stacked on a leading ``[S, ...]`` param axis sharded
+over ``pp``; inside ``shard_map`` each device runs its stage and hands
+activations to its right neighbor via a non-cyclic ``ppermute`` shift.  The
+classic GPipe bubble applies: ``S + M - 1`` steps for ``M`` microbatches.
+
+This is the correctness-first formulation (activations are dense every
+step; idle stages compute on zeros).  It exists so ``pp`` is a real,
+executable axis — RL-parity models are far too small to need it, which is
+why the flagship trainers default to dp/fsdp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+# stage_fn(stage_params, x[mb, ...]) -> y[mb, ...] (same shape)
+StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def make_pipeline_apply(
+    stage_fn: StageFn,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = "pp",
+):
+    """Build ``apply(stacked_params, x) -> y`` running stages in pipeline.
+
+    ``stacked_params``: pytree whose leaves lead with the stage axis
+    ``[S, ...]`` (sharded over ``axis_name``).  ``x``: ``[B, ...]`` with
+    ``B`` divisible by ``num_microbatches``; output has the same shape.
+    """
+    M = num_microbatches
+
+    def body(params_blk, x):
+        S = jax.lax.psum(1, axis_name)
+        stage = jax.lax.axis_index(axis_name)
+        params_local = jax.tree_util.tree_map(lambda p: p[0], params_blk)
+        B = x.shape[0]
+        mb = B // M
+        mbs = x.reshape((M, mb) + x.shape[1:])
+
+        out0 = jnp.zeros_like(mbs)
+        cur0 = jnp.zeros((mb,) + x.shape[1:], x.dtype)
+
+        def step(t, carry):
+            outputs, cur = carry
+            k = t - stage  # microbatch index flowing through this stage
+            active = jnp.logical_and(k >= 0, k < M)
+            k_safe = jnp.clip(k, 0, M - 1)
+            # stage 0 pulls fresh microbatches; others take the neighbor's
+            x_in = jnp.where(stage == 0, mbs[k_safe], cur)
+            y = stage_fn(params_local, x_in)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            outputs = jnp.where(
+                jnp.logical_and(active, stage == S - 1),
+                outputs.at[k_safe].set(y),
+                outputs,
+            )
+            # non-cyclic right shift: stage i -> i+1 (stage 0 receives zeros)
+            nxt = jax.lax.ppermute(
+                y, axis_name, [(i, i + 1) for i in range(S - 1)]
+            )
+            return outputs, nxt
+
+        outputs, _ = jax.lax.fori_loop(0, M + S - 1, step, (out0, cur0))
+        # only the last stage holds real outputs; psum replicates them
+        outputs = jax.lax.psum(
+            jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name,
+        )
+        return outputs.reshape(x.shape)
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+
+
+def sequential_apply(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray):
+    """Reference semantics: stages applied one after another (no pipeline)."""
+    S = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    for s in range(S):
+        params_s = jax.tree_util.tree_map(lambda p: p[s], stacked_params)
+        x = stage_fn(params_s, x)
+    return x

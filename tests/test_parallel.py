@@ -157,3 +157,23 @@ def test_batch_sharding_tree_core_state_dim0():
     tree = batch_sharding_tree(traj, mesh)
     assert tree.obs.spec == jax.sharding.PartitionSpec(None, ("dp", "fsdp"))
     assert tree.core_state[0].spec == jax.sharding.PartitionSpec(("dp", "fsdp"))
+
+
+def test_agent_enable_mesh_matches_unsharded():
+    """agent.enable_mesh (the --mesh-shape path) == plain agent.learn."""
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=32, rollout_length=5, batch_size=8,
+        max_timesteps=0,
+    )
+    traj = _tiny_traj(jax.random.PRNGKey(3), B=8)
+    plain = ImpalaAgent(args, obs_shape=(8,), num_actions=4, obs_dtype=jnp.float32)
+    meshed = ImpalaAgent(args, obs_shape=(8,), num_actions=4, obs_dtype=jnp.float32)
+    meshed.enable_mesh("dp=4,fsdp=2")
+    m_plain = plain.learn(traj)
+    m_mesh = meshed.learn(traj)
+    assert abs(m_plain["total_loss"] - m_mesh["total_loss"]) < 1e-4
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.state.params),
+        jax.tree_util.tree_leaves(meshed.state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
