@@ -154,6 +154,7 @@ def build_model(args: ImpalaArguments, obs_shape: Tuple[int, ...], num_actions: 
             num_actions=num_actions,
             use_lstm=args.use_lstm,
             hidden_size=args.hidden_size,
+            dtype=jnp.dtype(getattr(args, "compute_dtype", "float32")),
         )
     return MLPPolicyNet(num_actions=num_actions, hidden_sizes=(args.hidden_size, args.hidden_size))
 

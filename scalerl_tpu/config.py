@@ -165,6 +165,10 @@ class ImpalaArguments(RLArguments):
     # Model
     use_lstm: bool = True
     hidden_size: int = 512
+    # Compute dtype for the conv/dense torso ("float32" | "bfloat16").
+    # bfloat16 feeds the MXU at full rate; params and the V-trace math stay
+    # float32 (standard mixed precision)
+    compute_dtype: str = "float32"
     # Rollout pipeline
     rollout_length: int = 80
     num_actors: int = 8

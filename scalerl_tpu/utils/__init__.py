@@ -5,6 +5,12 @@ from scalerl_tpu.utils.schedulers import (  # noqa: F401
     MultiStepScheduler,
     PiecewiseScheduler,
 )
+from scalerl_tpu.utils.profiling import (  # noqa: F401
+    annotate,
+    maybe_trace,
+    step_marker,
+    trace,
+)
 from scalerl_tpu.utils.timers import Timer, Timings  # noqa: F401
 from scalerl_tpu.utils.tree import (  # noqa: F401
     hard_target_update,

@@ -1,0 +1,55 @@
+"""Device-side tracing: jax.profiler integration.
+
+The TPU half of the observability story (SURVEY.md §5): the reference had
+only host timers (``scalerl/utils/profile.py``) — ported as
+``utils.timers`` — with no device tracing at all.  Here ``trace()`` wraps
+``jax.profiler.trace`` (XPlane/perfetto output for TensorBoard's profile
+plugin) and ``annotate()`` names host regions so queue waits and env
+stepping line up against device streams in the trace viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a device+host profile into ``log_dir``.
+
+    View with TensorBoard's profile plugin, or pass
+    ``create_perfetto_link=True`` for a perfetto URL (blocks at exit).
+    """
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str) -> "jax.profiler.TraceAnnotation":
+    """Name a host-side region so it shows up in the captured trace:
+
+        with annotate("drain_rollout_queue"):
+            batch, idxs = queue.get_batch(...)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_marker(step: int) -> "jax.profiler.StepTraceAnnotation":
+    """Mark one train step (enables per-step breakdowns in the viewer)."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """``trace`` when a directory is configured, no-op otherwise — lets
+    trainers accept a ``--profile-dir`` flag unconditionally."""
+    if log_dir:
+        with trace(log_dir):
+            yield
+    else:
+        yield

@@ -188,3 +188,36 @@ def test_host_actor_learner_trainer_smoke(tmp_path):
     assert np.isfinite(result["total_loss"])
     assert int(agent.state.step) > 0
     assert trainer.param_server.version > 0
+
+
+def test_impala_bfloat16_compute_dtype():
+    """bf16 torso trains: finite loss/grads, f32 params preserved."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.data.trajectory import Trajectory
+
+    T, B = 4, 2
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=32, rollout_length=T, batch_size=B,
+        max_timesteps=0, compute_dtype="bfloat16",
+    )
+    agent = ImpalaAgent(args, obs_shape=(84, 84, 4), num_actions=4)
+    assert agent.model.dtype == jnp.bfloat16
+    # params stay f32 (mixed precision contract)
+    assert all(
+        leaf.dtype == jnp.float32
+        for leaf in jax.tree_util.tree_leaves(agent.state.params)
+    )
+    traj = Trajectory(
+        obs=jnp.zeros((T + 1, B, 84, 84, 4), jnp.uint8),
+        action=jnp.zeros((T + 1, B), jnp.int32),
+        reward=jnp.ones((T + 1, B), jnp.float32),
+        done=jnp.zeros((T + 1, B), jnp.bool_),
+        logits=jnp.zeros((T + 1, B, 4), jnp.float32),
+        core_state=(),
+    )
+    metrics = agent.learn(traj)
+    assert all(m == m for m in metrics.values())  # finite

@@ -82,3 +82,19 @@ def test_target_updates():
     np.testing.assert_allclose(np.asarray(new_t["w"]), 0.1 * np.ones(3), rtol=1e-6)
     hard = hard_target_update(online, target)
     np.testing.assert_allclose(np.asarray(hard["w"]), np.ones(3))
+
+
+def test_profiling_trace_and_annotate(tmp_path):
+    import jax.numpy as jnp
+
+    from scalerl_tpu.utils.profiling import annotate, maybe_trace, step_marker
+
+    with maybe_trace(str(tmp_path / "prof")):
+        with annotate("host_region"):
+            x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+        with step_marker(0):
+            x = (x * 2).sum()
+    assert float(x) == 1024.0
+    assert any((tmp_path / "prof").rglob("*"))  # trace files written
+    with maybe_trace(None):  # disabled path is a clean no-op
+        pass
