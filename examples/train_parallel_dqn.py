@@ -1,0 +1,51 @@
+"""Parallel DQN entry: actor processes + shm ring + central TPU learner.
+
+The working equivalent of the reference's ``ParallelDQNv2`` architecture
+(``scalerl/algorithms/dqn/parallel_dqn.py``; the reference had no example
+entry for it).  Actors are OS processes doing numpy CPU inference on
+versioned weight snapshots; transitions flow through the lock-free C++
+shared-memory ring; the learner trains double-DQN on device.
+
+Usage:
+    python examples/train_parallel_dqn.py --max-timesteps 20000 --num-actors 4
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import gymnasium as gym
+
+    from scalerl_tpu.agents.dqn import DQNAgent
+    from scalerl_tpu.config import DQNArguments, parse_args
+    from scalerl_tpu.trainer.parallel_dqn import ParallelDQNTrainer
+
+    args = parse_args(DQNArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    probe = gym.make(args.env_id)
+    obs_shape = probe.observation_space.shape
+    action_dim = probe.action_space.n
+    probe.close()
+    agent = DQNAgent(
+        args, obs_shape=obs_shape, action_dim=action_dim, donate_state=False
+    )
+    trainer = ParallelDQNTrainer(
+        args,
+        agent,
+        env_id=args.env_id,
+        obs_shape=obs_shape,
+        num_actors=args.num_actors,
+    )
+    result = trainer.train()
+    print("final:", {k: round(v, 2) for k, v in result.items()})
+
+
+if __name__ == "__main__":
+    main()

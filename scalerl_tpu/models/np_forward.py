@@ -1,0 +1,55 @@
+"""Numpy forward passes for actor-process CPU inference.
+
+Fleet / process actors run eps-greedy rollouts on weight *snapshots*
+(numpy pytrees pulled from the learner) without importing JAX in the actor
+process — forking a JAX-initialized runtime into actors is both heavy and
+deadlock-prone, and a 2×128 MLP forward is microseconds in numpy.
+
+Covers the MLP families of ``models/mlp.py`` (QNet plain + dueling).  Conv
+policies should use SEED-style central inference instead
+(``trainer/actor_learner.py``) — shipping pixel batches to a CPU conv is
+the wrong trade.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+
+def _dense_layers(params: Any) -> List[Any]:
+    inner = params["params"] if "params" in params else params
+    if any(k.startswith("NoisyDense_") for k in inner):
+        raise NotImplementedError(
+            "noisy nets need device inference (factorized noise resampling)"
+        )
+    names = sorted(
+        (k for k in inner.keys() if k.startswith("Dense_")),
+        key=lambda k: int(k.split("_")[-1]),
+    )
+    return [inner[k] for k in names]
+
+
+def mlp_qnet_forward(
+    params: Any, obs: np.ndarray, dueling: bool = False
+) -> np.ndarray:
+    """Q-values ``[B, A]`` from a ``models.mlp.QNet`` param pytree.
+
+    Layer order matches the flax module: hidden Dense stack with relu,
+    then (plain) one head, or (dueling) advantage head + value head.
+    """
+    x = np.asarray(obs, np.float32)
+    if x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    layers = _dense_layers(params)
+    n_head = 2 if dueling else 1
+    hidden, heads = layers[:-n_head], layers[-n_head:]
+    for layer in hidden:
+        x = np.maximum(x @ np.asarray(layer["kernel"]) + np.asarray(layer["bias"]), 0.0)
+    if not dueling:
+        h = heads[0]
+        return x @ np.asarray(h["kernel"]) + np.asarray(h["bias"])
+    adv = x @ np.asarray(heads[0]["kernel"]) + np.asarray(heads[0]["bias"])
+    val = x @ np.asarray(heads[1]["kernel"]) + np.asarray(heads[1]["bias"])
+    return val + adv - adv.mean(axis=-1, keepdims=True)

@@ -2,3 +2,4 @@ from scalerl_tpu.trainer.base import BaseTrainer  # noqa: F401
 from scalerl_tpu.trainer.off_policy import OffPolicyTrainer  # noqa: F401
 from scalerl_tpu.trainer.on_policy import OnPolicyTrainer  # noqa: F401
 from scalerl_tpu.trainer.apex import ApexTrainer  # noqa: F401
+from scalerl_tpu.trainer.parallel_dqn import ParallelDQNTrainer  # noqa: F401

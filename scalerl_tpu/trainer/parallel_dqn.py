@@ -1,0 +1,346 @@
+"""Parallel DQN: actor *processes* + central TPU learner over the shm ring.
+
+Parity target: ``ParallelDQNv2`` (``scalerl/algorithms/dqn/parallel_dqn.py:
+106-443``) — N actor processes running eps-greedy episodes and pushing
+transitions through an ``mp.Queue(maxsize=500)`` to a learner process that
+drains into replay and trains.  TPU-shaped differences:
+
+- Transport is the lock-free C++ shared-memory slot ring
+  (``runtime/shm_ring.py``; Python-queue fallback) instead of a pickling
+  ``mp.Queue``: actors write fixed ``[T, ...]`` rollout slabs into shared
+  memory via zero-copy numpy views; the learner drains with one native
+  memcpy gather per batch and one device transfer per slab.
+- Actors do CPU inference with *numpy* forwards on versioned weight
+  snapshots (``models/np_forward.py``) — no JAX runtime in the children —
+  pulled over a pipe weight service (the ``ParameterServer`` capability,
+  per-actor eps from the Ape-X exploration ladder).
+- The learner owns device replay (uniform or PER) and the jitted
+  double-DQN update; weight publication is versioned so idle actors skip
+  no-op pulls.
+
+Episode stats ride the weight-service pipes (tiny), never the data ring.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from scalerl_tpu.config import DQNArguments
+from scalerl_tpu.fleet.transport import (
+    PipeConnection,
+    send_recv,
+    wait_readable,
+)
+from scalerl_tpu.models.np_forward import mlp_qnet_forward
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _ActorConfig:
+    actor_id: int
+    env_id: str
+    obs_shape: tuple
+    rollout_length: int
+    eps: float
+    seed: int
+    dueling: bool
+    max_episode_steps: int = 500
+
+
+def _actor_main(conn: PipeConnection, cfg: _ActorConfig, ring: ShmRolloutRing) -> None:
+    """Actor process: env + numpy inference + slab writes.
+
+    Pipe protocol: {"kind": "params", "have": v} -> {"version", "weights"}
+    or None; {"kind": "stats", ...} fire-and-forget; recv None = stop.
+    """
+    import gymnasium as gym
+
+    try:
+        env = gym.make(cfg.env_id)
+        rng = np.random.default_rng(cfg.seed)
+        obs, _ = env.reset(seed=cfg.seed)
+        weights: Any = None
+        version = -1
+        T = cfg.rollout_length
+        ep_ret, ep_len = 0.0, 0
+        while not ring.closed:
+            try:
+                reply = send_recv(conn, {"kind": "params", "have": version})
+            except (EOFError, OSError, ConnectionError):
+                break
+            if reply is not None:
+                version = int(reply["version"])
+                weights = reply["weights"]
+            idx = ring.acquire(timeout=1.0)
+            if idx is None:
+                continue
+            slot = ring.slot(idx)
+            returns: List[float] = []
+            for t in range(T):
+                if weights is None or rng.random() < cfg.eps:
+                    a = int(rng.integers(env.action_space.n))
+                else:
+                    q = mlp_qnet_forward(weights, obs[None], cfg.dueling)
+                    a = int(np.argmax(q[0]))
+                nxt, r, term, trunc, _ = env.step(a)
+                slot["obs"][t] = obs
+                slot["action"][t] = a
+                slot["reward"][t] = r
+                slot["next_obs"][t] = nxt
+                slot["done"][t] = term
+                ep_ret += float(r)
+                ep_len += 1
+                if term or trunc or ep_len >= cfg.max_episode_steps:
+                    returns.append(ep_ret)
+                    ep_ret, ep_len = 0.0, 0
+                    obs, _ = env.reset()
+                else:
+                    obs = nxt
+            slot["meta"][0] = cfg.actor_id
+            slot["meta"][1] = version
+            ring.commit(idx)
+            if returns:
+                conn.send({"kind": "stats", "actor_id": cfg.actor_id,
+                           "returns": returns})
+        env.close()
+    except (KeyboardInterrupt, EOFError, OSError, ConnectionError):
+        pass
+    finally:
+        ring.detach()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+class ParallelDQNTrainer(BaseTrainer):
+    """N actor processes -> shm ring -> device replay + jitted learner."""
+
+    def __init__(
+        self,
+        args: DQNArguments,
+        agent,  # DQNAgent
+        env_id: str,
+        obs_shape: tuple,
+        num_actors: int = 4,
+        num_slots: int = 16,
+        eps_base: float = 0.4,
+        eps_alpha: float = 7.0,
+        use_per: Optional[bool] = None,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.num_actors = num_actors
+        self.env_id = env_id
+        T = args.rollout_length
+        spec = SlotSpec({
+            "obs": ((T,) + tuple(obs_shape), np.float32),
+            "action": ((T,), np.int32),
+            "reward": ((T,), np.float32),
+            "next_obs": ((T,) + tuple(obs_shape), np.float32),
+            "done": ((T,), np.bool_),
+            "meta": ((2,), np.int64),  # actor_id, weight version
+        })
+        self.ring = ShmRolloutRing(spec, num_slots=num_slots)
+        self.param_server = ParameterServer()
+        self.param_server.push(agent.get_weights())
+
+        use_per = args.use_per if use_per is None else use_per
+        if use_per:
+            from scalerl_tpu.data.prioritized import PrioritizedReplayBuffer
+
+            self.replay: Any = PrioritizedReplayBuffer(
+                obs_shape=obs_shape,
+                capacity=args.buffer_size,
+                num_envs=1,
+                alpha=args.per_alpha,
+                n_step=args.n_steps,
+                gamma=args.gamma,
+            )
+        else:
+            from scalerl_tpu.data.replay import ReplayBuffer
+
+            self.replay = ReplayBuffer(
+                obs_shape=obs_shape,
+                capacity=args.buffer_size,
+                num_envs=1,
+                n_step=args.n_steps,
+                gamma=args.gamma,
+            )
+        self.use_per = use_per
+        self._stop = threading.Event()
+        self.returns: List[float] = []
+        self.env_steps = 0
+        self.learn_steps = 0
+        self.procs: List[mp.Process] = []
+        self.conns: List[PipeConnection] = []
+        self._eps = [
+            float(eps_base ** (1 + (i / max(num_actors - 1, 1)) * eps_alpha))
+            for i in range(num_actors)
+        ]
+        self._weight_thread = threading.Thread(
+            target=self._weight_service, daemon=True
+        )
+
+    # -- weight + stats service over pipes -----------------------------
+    def _weight_service(self) -> None:
+        while not self._stop.is_set():
+            if not self.conns:
+                self._stop.wait(0.05)
+                continue
+            ready, dead = wait_readable(self.conns, timeout=0.1)
+            for conn in dead:
+                self.conns.remove(conn)
+            for conn in ready:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError, ConnectionError, ValueError):
+                    if conn in self.conns:
+                        self.conns.remove(conn)
+                    continue
+                if msg is None:
+                    continue
+                if msg["kind"] == "params":
+                    weights, version = self.param_server.pull(int(msg["have"]))
+                    try:
+                        if weights is None:
+                            conn.send(None)
+                        else:
+                            conn.send(
+                                {"version": version, "weights": weights}
+                            )
+                    except (BrokenPipeError, OSError):
+                        continue
+                elif msg["kind"] == "stats":
+                    self.returns.extend(float(r) for r in msg["returns"])
+
+    def start_actors(self) -> None:
+        ctx = mp.get_context()
+        for i in range(self.num_actors):
+            parent, child = ctx.Pipe(duplex=True)
+            cfg = _ActorConfig(
+                actor_id=i,
+                env_id=self.env_id,
+                obs_shape=tuple(self.agent.obs_shape),
+                rollout_length=self.args.rollout_length,
+                eps=self._eps[i],
+                seed=self.args.seed + 7919 * i,
+                dueling=self.args.dueling_dqn,
+            )
+            proc = ctx.Process(
+                target=_actor_main,
+                args=(PipeConnection(child), cfg, self.ring),
+                daemon=True,
+            )
+            proc.start()
+            child.close()
+            self.procs.append(proc)
+            self.conns.append(PipeConnection(parent))
+        self._weight_thread.start()
+
+    # -- learner -------------------------------------------------------
+    def _drain(self, max_slabs: int = 8) -> int:
+        drained = 0
+        while drained < max_slabs:
+            idx = self.ring.pop_full(timeout=0.05 if drained else 0.5)
+            if idx is None:
+                break
+            slab = self.ring.gather_batch([idx])
+            self.ring.release(idx)
+            if self.use_per:
+                self._per_insert(slab)
+            else:
+                self.replay.save_chunk(
+                    obs=slab["obs"][0, :, None],
+                    action=slab["action"][0, :, None],
+                    reward=slab["reward"][0, :, None],
+                    next_obs=slab["next_obs"][0, :, None],
+                    done=slab["done"][0, :, None],
+                )
+            self.env_steps += self.args.rollout_length
+            drained += 1
+        return drained
+
+    def _per_insert(self, slab: Dict[str, np.ndarray]) -> None:
+        T = self.args.rollout_length
+        for t in range(T):  # PER insert assigns max-priority rows
+            self.replay.save_to_memory(
+                obs=slab["obs"][0, t][None],
+                next_obs=slab["next_obs"][0, t][None],
+                action=slab["action"][0, t][None],
+                reward=slab["reward"][0, t][None],
+                done=slab["done"][0, t][None],
+            )
+
+    def train(self, total_steps: Optional[int] = None) -> Dict[str, float]:
+        args = self.args
+        total_steps = total_steps or args.max_timesteps
+        self.start_actors()
+        info: Dict[str, float] = {}
+        start = time.time()
+        last_log = 0
+        try:
+            while self.env_steps < total_steps:
+                self._drain()
+                if len(self.replay) >= args.warmup_learn_steps:
+                    if self.use_per:
+                        batch = self.replay.sample(args.batch_size, beta=args.per_beta)
+                        info = self.agent.learn(batch)
+                        self.replay.update_priorities(
+                            batch["indices"], info.pop("td_abs", 1.0) + 1e-6
+                        )
+                    else:
+                        info = self.agent.learn(self.replay.sample(args.batch_size))
+                        info.pop("td_abs", None)
+                    self.learn_steps += 1
+                    if self.learn_steps % 10 == 0:
+                        self.param_server.push(self.agent.get_weights())
+                if self.env_steps - last_log >= args.logger_frequency:
+                    last_log = self.env_steps
+                    sps = self.env_steps / max(time.time() - start, 1e-8)
+                    ret = float(np.mean(self.returns[-20:])) if self.returns else float("nan")
+                    self.logger.log_train_data(
+                        {**info, "sps": sps, "return_mean": ret}, self.env_steps
+                    )
+                    if self.is_main_process:
+                        self.text_logger.info(
+                            f"steps {self.env_steps} | sps {sps:.0f} | "
+                            f"return {ret:.1f} | learn {self.learn_steps} | "
+                            f"weights v{self.param_server.version}"
+                        )
+        finally:
+            self.stop()
+        ret = float(np.mean(self.returns[-20:])) if self.returns else float("nan")
+        return {
+            **info,
+            "env_steps": float(self.env_steps),
+            "learn_steps": float(self.learn_steps),
+            "episodes": float(len(self.returns)),
+            "return_mean": ret,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.ring.close()
+        for p in self.procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+        for c in self.conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.ring.unlink()

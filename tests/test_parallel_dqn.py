@@ -1,0 +1,66 @@
+"""Process-based Parallel DQN trainer tests (actors over the shm ring)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.dqn import DQNAgent
+from scalerl_tpu.config import DQNArguments
+from scalerl_tpu.models.mlp import QNet
+from scalerl_tpu.models.np_forward import mlp_qnet_forward
+from scalerl_tpu.trainer.parallel_dqn import ParallelDQNTrainer
+
+
+@pytest.mark.parametrize("dueling", [False, True])
+def test_np_forward_matches_flax(dueling):
+    import jax
+
+    net = QNet(action_dim=3, hidden_sizes=(16, 16), dueling=dueling)
+    obs = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    params = net.init(jax.random.PRNGKey(0), jnp.asarray(obs))
+    want = np.asarray(net.apply(params, jnp.asarray(obs)))
+    got = mlp_qnet_forward(
+        jax.tree_util.tree_map(np.asarray, params), obs, dueling=dueling
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_np_forward_rejects_noisy():
+    import jax
+
+    net = QNet(action_dim=3, hidden_sizes=(8,), noisy=True)
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    with pytest.raises(NotImplementedError):
+        mlp_qnet_forward(jax.tree_util.tree_map(np.asarray, params), np.zeros((1, 4)))
+
+
+def test_parallel_dqn_trains_cartpole():
+    gym = pytest.importorskip("gymnasium")
+    del gym
+    args = DQNArguments(
+        hidden_sizes=(32, 32),
+        rollout_length=32,
+        buffer_size=4096,
+        batch_size=32,
+        warmup_learn_steps=64,
+        max_timesteps=2000,
+        logger_frequency=1000,
+        learning_rate=1e-3,
+    )
+    agent = DQNAgent(args, obs_shape=(4,), action_dim=2, donate_state=False)
+    trainer = ParallelDQNTrainer(
+        args,
+        agent,
+        env_id="CartPole-v1",
+        obs_shape=(4,),
+        num_actors=2,
+        num_slots=4,
+    )
+    result = trainer.train(total_steps=2000)
+    assert result["env_steps"] >= 2000
+    assert result["learn_steps"] > 0
+    assert result["episodes"] > 0
+    # actors pulled at least one published weight version
+    assert trainer.param_server.version >= 1
+    # processes torn down
+    assert all(not p.is_alive() for p in trainer.procs)
