@@ -84,7 +84,14 @@ class FleetConfig:
 
 
 def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None:
-    """Task loop: parity with ``Worker.run`` (``hpc/worker.py:96-120``)."""
+    """Task loop: parity with ``Worker.run`` (``hpc/worker.py:96-120``).
+
+    Runner exceptions are *reported upstream* before the worker exits —
+    the reference's fleet simply forgot dead workers (SURVEY.md §5
+    failure-detection notes); here the server surfaces them.
+    """
+    import traceback
+
     weights: Any = None
     version = -1
     try:
@@ -100,7 +107,21 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
                 if reply is not None:
                     version = int(reply["version"])
                     weights = reply["weights"]
-            result = runner(task, weights, worker_id)
+            try:
+                result = runner(task, weights, worker_id)
+            except Exception as exc:  # noqa: BLE001 - funneled upstream
+                conn.send(
+                    {
+                        "kind": "worker_error",
+                        "v": {
+                            "worker_id": worker_id,
+                            "task": task,
+                            "error": repr(exc),
+                            "traceback": traceback.format_exc(),
+                        },
+                    }
+                )
+                break
             result["worker_id"] = worker_id
             result["param_version"] = version
             conn.send({"kind": "result", "v": result})
@@ -192,6 +213,10 @@ class Gather:
             self.results.append(msg["v"])
             if len(self.results) >= self.config.upload_batch:
                 self._flush_results()
+        elif kind == "worker_error":
+            # forward immediately (ahead of batched results) so the server
+            # learns about the dead worker without waiting for a batch
+            self.server.send({"kind": "worker_error", "v": msg["v"]})
         else:
             logger.warning("gather: unknown message kind %r", kind)
 
@@ -242,6 +267,7 @@ class WorkerServer:
         self.params = ParameterServer()
         self.hub = QueueHub()
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
+        self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.total_results = 0
         self.dropped_results = 0
         self._next_worker_id = 0
@@ -369,6 +395,15 @@ class WorkerServer:
                         self.results.put_nowait(r)
                     except queue.Full:
                         self.dropped_results += 1
+        elif kind == "worker_error":
+            err = msg["v"]
+            logger.error(
+                "fleet worker %s failed on task %r:\n%s",
+                err.get("worker_id"),
+                err.get("task"),
+                err.get("traceback", err.get("error")),
+            )
+            self.worker_errors.put(err)
         else:
             logger.warning("server: unknown message kind %r", kind)
 
