@@ -9,25 +9,35 @@ Baseline: the driver target (BASELINE.json north star) of >=100k
 env-frames/sec aggregate on a v5e-16, i.e. 6,250 frames/sec/chip;
 ``vs_baseline`` is measured frames/sec/chip over that number.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line, **always** — the orchestrator in ``main()``
+runs the measurement in a subprocess so a hanging or crashing TPU backend
+init (round 1 failure mode: the axon tunnel either raised UNAVAILABLE or
+hung past the driver timeout) can neither kill nor stall this process.
+On persistent TPU failure it falls back to a CPU-pinned run and reports
+the TPU error in an ``"error"`` field alongside the CPU number.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-import jax
-
 BASELINE_FPS_PER_CHIP = 100_000 / 16  # v5e-16 north star, per chip
 
+TPU_ATTEMPT_TIMEOUT_S = 420
+CPU_ATTEMPT_TIMEOUT_S = 420
 
-def main() -> None:
-    import jax.numpy as jnp
+
+def _run_measurement() -> None:
+    """Child mode: do the actual measurement and print the JSON line."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401
 
     from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
     from scalerl_tpu.config import ImpalaArguments
@@ -40,9 +50,9 @@ def main() -> None:
     # by ~21% — bigger batches keep the MXU busy between infeed boundaries);
     # CPU fallback shrinks to stay quick
     on_accel = platform in ("tpu", "gpu")
-    B = 512 if on_accel else 16
+    B = 512 if on_accel else 8
     T = 20
-    iters_per_call = 5 if on_accel else 2
+    iters_per_call = 5 if on_accel else 1
 
     args = ImpalaArguments(
         use_lstm=False,
@@ -74,7 +84,7 @@ def main() -> None:
     state, carry, m = loop._train_many(state, carry, jax.random.PRNGKey(1))
     float(m["total_loss"])
 
-    target_s = 20.0 if on_accel else 8.0
+    target_s = 20.0 if on_accel else 4.0
     frames = 0
     t0 = time.perf_counter()
     i = 0
@@ -101,5 +111,93 @@ def main() -> None:
     )
 
 
+def _attempt(cpu: bool, timeout_s: float):
+    """Run the measurement in a subprocess; return (json_line | None, err)."""
+    env = dict(os.environ)
+    cmd = [sys.executable, str(Path(__file__).resolve()), "--run"]
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=1").strip()
+        cmd.append("--cpu")
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout_s, capture_output=True, text=True
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_s:.0f}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            return line, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)[-400:]
+
+
+def main() -> None:
+    errors = []
+    # TPU/default-backend attempts: two tries (round-1's failure was a
+    # transient UNAVAILABLE from the tunnel), but don't retry a hang —
+    # a second hang would burn the driver's whole budget.
+    for i in range(2):
+        line, err = _attempt(cpu=False, timeout_s=TPU_ATTEMPT_TIMEOUT_S)
+        if line is not None:
+            print(line)
+            return
+        errors.append(f"attempt{i + 1}: {err}")
+        if "timeout" in err:
+            break
+    # CPU fallback: still a real number, annotated with the TPU error.
+    line, err = _attempt(cpu=True, timeout_s=CPU_ATTEMPT_TIMEOUT_S)
+    if line is not None:
+        obj = json.loads(line)
+        obj["error"] = "default backend failed, CPU fallback: " + "; ".join(errors)
+        print(json.dumps(obj))
+        return
+    errors.append(f"cpu: {err}")
+    print(
+        json.dumps(
+            {
+                "metric": "impala_atari_env_frames_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "frames/sec/chip (unavailable)",
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors)[-800:],
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv[1:]:
+        if "--cpu" in sys.argv[1:]:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            _run_measurement()
+        except Exception:  # noqa: BLE001 — parent needs the traceback on stderr
+            import traceback
+
+            traceback.print_exc()
+            sys.exit(1)
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — must always print one JSON line
+            print(
+                json.dumps(
+                    {
+                        "metric": "impala_atari_env_frames_per_sec_per_chip",
+                        "value": 0.0,
+                        "unit": "frames/sec/chip (unavailable)",
+                        "vs_baseline": 0.0,
+                        "error": f"orchestrator: {type(e).__name__}: {e}"[:800],
+                    }
+                )
+            )
