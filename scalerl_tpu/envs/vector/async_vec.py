@@ -96,6 +96,9 @@ class AsyncMultiAgentVecEnv:
         self.error_queue: mp.Queue = ctx.Queue()
         self._state = AsyncState.DEFAULT
         self._closed = False
+        # replies still owed per worker after a _collect timeout; discarded
+        # before the next fresh recv (replies are FIFO per worker)
+        self._stale = [0] * self.num_envs
         self.parent_pipes = []
         self.processes = []
         for index, env_fn in enumerate(env_fns):
@@ -220,18 +223,40 @@ class AsyncMultiAgentVecEnv:
 
         On timeout the state machine resets to DEFAULT before raising
         (gymnasium ``AsyncVectorEnv`` semantics) so the env is not wedged in
-        a WAITING state forever — though replies already consumed from
-        faster workers are lost for that step.
+        a WAITING state forever.  Every worker that had not delivered its
+        reply by the deadline is marked as owing one stale reply, which the
+        next ``_collect`` discards before reading a fresh one — replies are
+        FIFO per worker, so results can never desynchronize across steps.
         """
         deadline = None if timeout is None else time.monotonic() + timeout
         results, successes = [], []
         for i, pipe in enumerate(self.parent_pipes):
-            remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and (
-                remaining <= 0 or not pipe.poll(remaining)
-            ):
+            try:
+                # discard replies left over from a previous timed-out round
+                while self._stale[i]:
+                    remaining = (
+                        None if deadline is None else deadline - time.monotonic()
+                    )
+                    if remaining is not None and (
+                        remaining <= 0 or not pipe.poll(remaining)
+                    ):
+                        raise TimeoutError(
+                            f"worker {i} did not respond in {timeout}s"
+                        )
+                    pipe.recv()
+                    self._stale[i] -= 1
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and (
+                    remaining <= 0 or not pipe.poll(remaining)
+                ):
+                    raise TimeoutError(f"worker {i} did not respond in {timeout}s")
+            except TimeoutError:
                 self._state = AsyncState.DEFAULT
-                raise TimeoutError(f"worker {i} did not respond in {timeout}s")
+                for j in range(i, self.num_envs):
+                    self._stale[j] += 1
+                raise
             result, ok = pipe.recv()
             results.append(result)
             successes.append(ok)

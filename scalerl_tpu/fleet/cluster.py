@@ -426,24 +426,33 @@ class LocalCluster:
     ``hpc/worker.py:241-258``) — doubles as the multi-node simulator."""
 
     def __init__(
-        self, server: WorkerServer, config: FleetConfig, runner: EpisodeRunner
+        self,
+        server: WorkerServer,
+        config: FleetConfig,
+        runner: EpisodeRunner,
+        mp_context: Optional[str] = None,
     ) -> None:
         self.server = server
         self.config = config
         self.runner = runner
+        # fork-after-JAX can deadlock in XLA's thread pools; a parent that
+        # holds a JAX runtime should pass mp_context='spawn' (runner must
+        # then be picklable, e.g. GenerationRunner over module-level fns)
+        self.mp_context = mp_context
         self.procs: List[mp.Process] = []
 
     def start(self) -> None:
         per = self.config.workers_per_gather
         remaining = self.config.num_workers
+        ctx = mp.get_context(self.mp_context)
         for _g in range(self.config.num_gathers):
             n = min(per, remaining)
             remaining -= n
             base = self.server.assign_worker_ids(n)
-            parent, child = mp.get_context().Pipe(duplex=True)
+            parent, child = ctx.Pipe(duplex=True)
             # gathers spawn worker children, so they cannot be daemonic;
             # join() terminates stragglers and their daemonic workers
-            proc = mp.get_context().Process(
+            proc = ctx.Process(
                 target=gather_main,
                 args=(PipeConnection(child), self.config, self.runner, base, n),
             )
@@ -469,10 +478,12 @@ class RemoteCluster:
         config: FleetConfig,
         runner: EpisodeRunner,
         num_workers: Optional[int] = None,
+        mp_context: Optional[str] = None,
     ) -> None:
         self.config = config
         self.runner = runner
         self.num_workers = num_workers or config.num_workers
+        self.mp_context = mp_context  # see LocalCluster: 'spawn' if JAX in parent
         self.procs: List[mp.Process] = []
 
     def entry(self) -> Tuple[int, Dict[str, Any]]:
@@ -506,9 +517,10 @@ class RemoteCluster:
         per = config.workers_per_gather
         remaining = self.num_workers
         offset = 0
+        ctx = mp.get_context(self.mp_context)
         while remaining > 0:
             n = min(per, remaining)
-            proc = mp.get_context().Process(
+            proc = ctx.Process(
                 target=_remote_gather_main,
                 args=(
                     self.config.server_host,

@@ -145,27 +145,59 @@ class EpisodeGenerator:
         return chunks
 
 
+class GenerationRunner:
+    """Fleet ``EpisodeRunner`` running turn-based generation
+    (``role='rollout'``) or greedy evaluation (``role='eval'``), mirroring
+    the reference's ``role=='g'``/``'e'`` split (``hpc/worker.py:108-116``).
+
+    A class (not a closure) so it pickles across ``spawn`` process
+    boundaries when ``env_fn``/``policy_fn`` are module-level callables;
+    the lazily-built :class:`EpisodeGenerator` is excluded from the pickle.
+    """
+
+    def __init__(
+        self,
+        env_fn: Callable[[], TurnBasedEnv],
+        policy_fn: PolicyFn,
+        num_actions: int,
+        gamma: float = 1.0,
+        chunk_len: int = 64,
+    ) -> None:
+        self.env_fn = env_fn
+        self.policy_fn = policy_fn
+        self.num_actions = num_actions
+        self.gamma = gamma
+        self.chunk_len = chunk_len
+        self._gen: Any = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_gen"] = None
+        return state
+
+    def __call__(
+        self, task: Dict[str, Any], weights: Any, worker_id: int
+    ) -> Dict[str, Any]:
+        if self._gen is None:
+            self._gen = EpisodeGenerator(
+                self.env_fn(),
+                self.policy_fn,
+                self.num_actions,
+                gamma=self.gamma,
+                chunk_len=self.chunk_len,
+            )
+        greedy = task.get("role") == "eval"
+        out = self._gen.generate(weights, seed=task.get("seed"), greedy=greedy)
+        out["role"] = task.get("role", "rollout")
+        return out
+
+
 def make_generation_runner(
     env_fn: Callable[[], TurnBasedEnv],
     policy_fn: PolicyFn,
     num_actions: int,
     gamma: float = 1.0,
     chunk_len: int = 64,
-):
-    """Build a fleet ``EpisodeRunner`` that runs turn-based generation
-    (``role='rollout'``) or greedy evaluation (``role='eval'``), mirroring
-    the reference's ``role=='g'``/``'e'`` split (``hpc/worker.py:108-116``)."""
-    state: Dict[str, Any] = {}
-
-    def runner(task: Dict[str, Any], weights: Any, worker_id: int) -> Dict[str, Any]:
-        if "gen" not in state:
-            state["gen"] = EpisodeGenerator(
-                env_fn(), policy_fn, num_actions, gamma=gamma, chunk_len=chunk_len
-            )
-        gen: EpisodeGenerator = state["gen"]
-        greedy = task.get("role") == "eval"
-        out = gen.generate(weights, seed=task.get("seed"), greedy=greedy)
-        out["role"] = task.get("role", "rollout")
-        return out
-
-    return runner
+) -> GenerationRunner:
+    """Factory kept for API stability; see :class:`GenerationRunner`."""
+    return GenerationRunner(env_fn, policy_fn, num_actions, gamma, chunk_len)

@@ -79,13 +79,32 @@ def make_pipeline_apply(
         )
         return outputs.reshape(x.shape)
 
-    return shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),
         check_rep=False,
     )
+    pp = mesh.shape[axis_name]
+
+    def apply(stacked_params, x):
+        # One stage per pp device: the body takes p[0] of each device's
+        # param block, so S > pp would silently drop the extra stages and
+        # S < pp would crash inside shard_map with a shape error.
+        for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+            if leaf.shape[0] != pp:
+                raise ValueError(
+                    f"stacked stage axis {leaf.shape[0]} != pp={pp} at "
+                    f"{jax.tree_util.keystr(path)}; one stage per pp device"
+                )
+        if x.shape[0] % M != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by num_microbatches={M}"
+            )
+        return sharded(stacked_params, x)
+
+    return apply
 
 
 def sequential_apply(stage_fn: StageFn, stacked_params: Any, x: jnp.ndarray):

@@ -44,10 +44,30 @@ def make_sequence_parallel_apply(
         return sp_model.apply(params, obs, positions=positions)
 
     seq = P(None, axis_name)
-    return shard_map(
+    sharded = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(None, axis_name, None)),
         out_specs=TransformerOutput(P(None, axis_name, None), seq),
         check_rep=False,
     )
+    sp = mesh.shape[axis_name]
+
+    def apply(params, obs):
+        # Validate against the *global* sequence length here, outside the
+        # shard_map body: inside, the model only sees T/sp local steps, so
+        # its own max_len guard cannot catch a too-long global sequence —
+        # out-of-range positions would silently clamp onto the last
+        # positional-embedding row.
+        T = obs.shape[1]
+        if T > model.max_len:
+            raise ValueError(
+                f"global sequence length {T} exceeds max_len={model.max_len}"
+            )
+        if T % sp != 0:
+            raise ValueError(
+                f"global sequence length {T} not divisible by sp={sp}"
+            )
+        return sharded(params, obs)
+
+    return apply

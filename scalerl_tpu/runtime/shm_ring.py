@@ -83,13 +83,19 @@ class ShmRolloutRing:
         total = self._ctrl_bytes + num_slots * spec.slot_bytes
         self.shm = shared_memory.SharedMemory(create=True, size=total)
         self._owner = True
+        self._base_obj = None  # cached ctypes buffer export (see _base_ptr)
+        self._base_addr: Optional[int] = None
         if self.native:
             self.shm.buf[:self._ctrl_bytes] = b"\x00" * self._ctrl_bytes
             rc = lib.srl_ring_init(self._base_ptr(), num_slots)
             assert rc == 0
             self._free = self._full = None
         else:
-            ctx = mp.get_context()
+            # spawn context: its SemLocks may be shared with BOTH spawn
+            # children (pickled) and fork children (inherited), whereas
+            # fork-context SemLocks raise when pickled into a spawn child —
+            # and the consumers (trainer/parallel_dqn.py) spawn
+            ctx = mp.get_context("spawn")
             self._free = ctx.Queue()
             self._full = ctx.Queue()
             for i in range(num_slots):
@@ -102,6 +108,8 @@ class ShmRolloutRing:
         state["shm"] = None
         state["_shm_name"] = self.shm.name
         state["_owner"] = False
+        state["_base_obj"] = None
+        state["_base_addr"] = None
         return state
 
     def __setstate__(self, state):
@@ -110,7 +118,14 @@ class ShmRolloutRing:
         self.shm = shared_memory.SharedMemory(name=name)
 
     def _base_ptr(self) -> int:
-        return ctypes.addressof(ctypes.c_char.from_buffer(self.shm.buf))
+        # One cached buffer export per process: creating a fresh
+        # ``from_buffer`` view on every call leaks exports that keep the
+        # mapping pinned ("cannot close exported pointers exist" during
+        # unlink).  detach() drops the cached object before shm.close().
+        if self._base_addr is None:
+            self._base_obj = ctypes.c_char.from_buffer(self.shm.buf)
+            self._base_addr = ctypes.addressof(self._base_obj)
+        return self._base_addr
 
     def _lib(self):
         lib = load_ring_lib()
@@ -226,12 +241,20 @@ class ShmRolloutRing:
         else:
             self._closed.set()
 
+    def __del__(self):
+        # drop the cached buffer export before SharedMemory.__del__ runs —
+        # GC dict-clear order is unspecified, and if the mmap closes second
+        # it raises "cannot close exported pointers exist"
+        self._base_obj = None
+
     def detach(self) -> None:
         """Drop this process's mapping.  Callers must release every
         ``slot()`` view first — live views keep the buffer exported and the
         mapping cannot close (warned, not silently leaked)."""
         import gc
 
+        self._base_obj = None  # release the cached ctypes buffer export
+        self._base_addr = None
         try:
             self.shm.close()
         except BufferError:

@@ -141,6 +141,12 @@ class ParallelDQNTrainer(BaseTrainer):
         run_name: Optional[str] = None,
     ) -> None:
         super().__init__(args, run_name=run_name)
+        if getattr(args, "categorical_dqn", False):
+            raise ValueError(
+                "categorical_dqn (C51) is not supported by ParallelDQNTrainer: "
+                "actor processes run scalar-Q numpy inference "
+                "(models/np_forward.py); use DQNAgent with OffPolicyTrainer"
+            )
         self.agent = agent
         self.num_actors = num_actors
         self.env_id = env_id
@@ -227,7 +233,12 @@ class ParallelDQNTrainer(BaseTrainer):
                     self.returns.extend(float(r) for r in msg["returns"])
 
     def start_actors(self) -> None:
-        ctx = mp.get_context()
+        # spawn, not fork: the learner process has JAX initialized, and
+        # forking after that can deadlock in XLA's thread pools (the same
+        # hazard envs/vector/async_vec.py documents).  Everything crossing
+        # the boundary (_ActorConfig, PipeConnection, ShmRolloutRing) is
+        # picklable by design.
+        ctx = mp.get_context("spawn")
         for i in range(self.num_actors):
             parent, child = ctx.Pipe(duplex=True)
             cfg = _ActorConfig(

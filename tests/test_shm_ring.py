@@ -34,6 +34,7 @@ def test_ring_basic_cycle(use_native):
         views = ring.slot(idx)
         views["obs"][:] = 2.5
         views["action"][:] = np.arange(4)
+        views = None  # zero-copy views pin the mapping; drop before unlink
         ring.commit(idx)
         got = ring.pop_full(timeout=1.0)
         assert got == idx
@@ -79,6 +80,7 @@ def _actor_proc(ring, actor_id, episodes):
         views = ring.slot(idx)
         views["obs"][:] = actor_id * 100 + e
         views["action"][:] = actor_id
+        views = None  # drop zero-copy views so detach() can close the mapping
         ring.commit(idx)
     ring.detach()
 
@@ -102,6 +104,7 @@ def test_ring_multiprocess_producers(use_native):
                 continue
             views = ring.slot(idx)
             seen.append((int(views["action"][0]), float(views["obs"][0, 0])))
+            views = None
             ring.release(idx)
         for p in procs:
             p.join(timeout=10.0)
