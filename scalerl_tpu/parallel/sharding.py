@@ -69,6 +69,7 @@ def infer_param_spec(
     x: Any,
     mesh: Mesh,
     axes: Tuple[str, ...] = ("fsdp", "tp"),
+    min_shard: int = 8,
 ) -> P:
     """Pick a PartitionSpec for one param leaf.
 
@@ -78,6 +79,14 @@ def infer_param_spec(
     non-divisible leaves replicate.  This yields real fsdp/tp layouts for
     the conv/fc stacks of AtariNet-class models; bespoke models can pass
     explicit specs instead.
+
+    ``min_shard``: a dim is only sharded if every shard keeps at least
+    this many elements.  Tiny dims (e.g. a ``[hidden, num_actions]`` policy
+    head's action dim) otherwise get 2-3-element shards, and the *gradient*
+    of the head's activation then carries conflicting shardings from its
+    two uses — GSPMD resolves that with an involuntary full
+    rematerialization (replicate-then-repartition) of the whole ``[T, B,
+    A]`` logits gradient, a multi-chip perf cliff on real models.
     """
     if not hasattr(x, "ndim") or x.ndim < 2:
         return P()
@@ -92,7 +101,11 @@ def infer_param_spec(
         if n <= 1:
             continue
         for d in order:
-            if spec[d] is None and x.shape[d] % n == 0 and x.shape[d] >= 2 * n:
+            if (
+                spec[d] is None
+                and x.shape[d] % n == 0
+                and x.shape[d] >= max(2, min_shard) * n
+            ):
                 spec[d] = axis_name
                 break
     return P(*spec)
