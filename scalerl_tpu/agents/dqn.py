@@ -28,8 +28,15 @@ from flax import struct
 
 from scalerl_tpu.agents.base import BaseAgent
 from scalerl_tpu.config import DQNArguments
-from scalerl_tpu.models.mlp import QNet
-from scalerl_tpu.ops.losses import double_dqn_targets, dqn_loss
+from scalerl_tpu.models.mlp import C51QNet, QNet
+from scalerl_tpu.ops.losses import (
+    c51_loss,
+    categorical_projection,
+    categorical_q_values,
+    double_dqn_targets,
+    dqn_loss,
+    make_support,
+)
 from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 from scalerl_tpu.utils.schedulers import LinearDecayScheduler
 from scalerl_tpu.utils.tree import soft_target_update
@@ -43,17 +50,24 @@ class DQNTrainState:
     step: jnp.ndarray  # int32
 
 
-def make_dqn_learn_fn(
-    network: QNet,
+def _make_learn_core(
     optimizer: optax.GradientTransformation,
     gamma: float,
     n_step: int,
-    double_dqn: bool,
     use_soft_update: bool,
     soft_update_tau: float,
     target_update_frequency: int,
+    make_loss_fn,
 ):
-    """Build the pure (state, batch) -> (state, metrics) update function."""
+    """Shared (state, batch) -> (state, metrics, per_sample) update plumbing.
+
+    ``make_loss_fn(state, obs, next_obs, actions, rewards, discounts,
+    weights)`` returns the variant's ``loss_fn(params) -> (loss,
+    (per_sample, q))`` — scalar-Q TD loss or C51 cross-entropy; everything
+    else (batch unpack, n-step discounts, grad/optimizer step, soft/hard
+    target update, metrics) is identical between the variants and lives here
+    once.
+    """
 
     def learn(state: DQNTrainState, batch: Mapping[str, jnp.ndarray]):
         obs = batch["obs"]
@@ -69,20 +83,12 @@ def make_dqn_learn_fn(
         else:
             discounts = (1.0 - dones) * (gamma ** n_steps.astype(jnp.float32))
 
-        q_next_online = network.apply(state.params, next_obs)
-        q_next_target = network.apply(state.target_params, next_obs)
-        targets = double_dqn_targets(
-            q_next_online, q_next_target, rewards, discounts, double_dqn=double_dqn
+        loss_fn = make_loss_fn(
+            state, obs, next_obs, actions, rewards, discounts, weights
         )
-
-        def loss_fn(params):
-            q = network.apply(params, obs)
-            loss, td_abs = dqn_loss(q, actions, targets, weights=weights)
-            return loss, (td_abs, q)
-
-        (loss, (td_abs, q)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        (loss, (per_sample, q)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
 
@@ -105,12 +111,102 @@ def make_dqn_learn_fn(
         )
         metrics = {
             "loss": loss,
-            "td_error_mean": jnp.mean(td_abs),
+            "td_error_mean": jnp.mean(per_sample),
             "q_mean": jnp.mean(q),
         }
-        return new_state, metrics, td_abs
+        return new_state, metrics, per_sample
 
     return learn
+
+
+def make_dqn_learn_fn(
+    network: QNet,
+    optimizer: optax.GradientTransformation,
+    gamma: float,
+    n_step: int,
+    double_dqn: bool,
+    use_soft_update: bool,
+    soft_update_tau: float,
+    target_update_frequency: int,
+):
+    """Build the pure (state, batch) -> (state, metrics) update function."""
+
+    def make_loss_fn(state, obs, next_obs, actions, rewards, discounts, weights):
+        q_next_online = network.apply(state.params, next_obs)
+        q_next_target = network.apply(state.target_params, next_obs)
+        targets = double_dqn_targets(
+            q_next_online, q_next_target, rewards, discounts, double_dqn=double_dqn
+        )
+
+        def loss_fn(params):
+            q = network.apply(params, obs)
+            loss, td_abs = dqn_loss(q, actions, targets, weights=weights)
+            return loss, (td_abs, q)
+
+        return loss_fn
+
+    return _make_learn_core(
+        optimizer,
+        gamma,
+        n_step,
+        use_soft_update,
+        soft_update_tau,
+        target_update_frequency,
+        make_loss_fn,
+    )
+
+
+def make_c51_learn_fn(
+    network: C51QNet,
+    optimizer: optax.GradientTransformation,
+    support: jnp.ndarray,
+    gamma: float,
+    n_step: int,
+    double_dqn: bool,
+    use_soft_update: bool,
+    soft_update_tau: float,
+    target_update_frequency: int,
+):
+    """Categorical (C51) variant of :func:`make_dqn_learn_fn`.
+
+    Same train-state plumbing (``_make_learn_core``); the TD target becomes
+    the projected Bellman distribution (``ops/losses.categorical_projection``)
+    and the loss the cross-entropy to it.  Per-sample CE doubles as the PER
+    priority signal.
+    """
+
+    def make_loss_fn(state, obs, next_obs, actions, rewards, discounts, weights):
+        logits_next_t = network.apply(state.target_params, next_obs)  # [B,A,N]
+        if double_dqn:
+            logits_next_o = network.apply(state.params, next_obs)
+            next_q = categorical_q_values(logits_next_o, support)
+        else:
+            next_q = categorical_q_values(logits_next_t, support)
+        next_actions = jnp.argmax(next_q, axis=-1)  # [B]
+        next_probs = jax.nn.softmax(
+            jnp.take_along_axis(
+                logits_next_t, next_actions[:, None, None], axis=1
+            )[:, 0],
+            axis=-1,
+        )  # [B, N]
+        target_probs = categorical_projection(next_probs, rewards, discounts, support)
+
+        def loss_fn(params):
+            logits = network.apply(params, obs)
+            loss, ce = c51_loss(logits, actions, target_probs, weights=weights)
+            return loss, (ce, categorical_q_values(logits, support))
+
+        return loss_fn
+
+    return _make_learn_core(
+        optimizer,
+        gamma,
+        n_step,
+        use_soft_update,
+        soft_update_tau,
+        target_update_frequency,
+        make_loss_fn,
+    )
 
 
 def make_dqn_priority_fn(network: QNet, gamma: float, double_dqn: bool):
@@ -154,12 +250,29 @@ class DQNAgent(BaseAgent):
         key = key if key is not None else jax.random.PRNGKey(args.seed)
         self._key = key
 
-        self.network = QNet(
-            action_dim=action_dim,
-            hidden_sizes=args.hidden_sizes,
-            dueling=args.dueling_dqn,
-            noisy=args.noisy_dqn,
+        self.categorical = bool(getattr(args, "categorical_dqn", False))
+        self.support = (
+            make_support(args.v_min, args.v_max, args.num_atoms)
+            if self.categorical
+            else None
         )
+        if self.categorical:
+            self.network = C51QNet(
+                action_dim=action_dim,
+                num_atoms=args.num_atoms,
+                hidden_sizes=args.hidden_sizes,
+                dueling=args.dueling_dqn,
+                noisy=args.noisy_dqn,
+                noisy_std=args.noisy_std,
+            )
+        else:
+            self.network = QNet(
+                action_dim=action_dim,
+                hidden_sizes=args.hidden_sizes,
+                dueling=args.dueling_dqn,
+                noisy=args.noisy_dqn,
+                noisy_std=args.noisy_std,
+            )
         dummy = jnp.zeros((1,) + self.obs_shape, jnp.float32)
         params = self.network.init(key, dummy)
 
@@ -189,8 +302,20 @@ class DQNAgent(BaseAgent):
         )
         self.eps = args.eps_greedy_start
 
-        self._learn = jax.jit(
-            make_dqn_learn_fn(
+        if self.categorical:
+            learn_fn = make_c51_learn_fn(
+                self.network,
+                self.optimizer,
+                support=self.support,
+                gamma=args.gamma,
+                n_step=args.n_steps,
+                double_dqn=args.double_dqn,
+                use_soft_update=args.use_soft_update,
+                soft_update_tau=args.soft_update_tau,
+                target_update_frequency=args.target_update_frequency,
+            )
+        else:
+            learn_fn = make_dqn_learn_fn(
                 self.network,
                 self.optimizer,
                 gamma=args.gamma,
@@ -199,13 +324,19 @@ class DQNAgent(BaseAgent):
                 use_soft_update=args.use_soft_update,
                 soft_update_tau=args.soft_update_tau,
                 target_update_frequency=args.target_update_frequency,
-            ),
-            donate_argnums=(0,) if donate_state else (),
+            )
+        self._learn = jax.jit(
+            learn_fn, donate_argnums=(0,) if donate_state else ()
         )
 
+        def q_of(params, obs):
+            out = self.network.apply(params, obs)
+            if self.categorical:
+                return categorical_q_values(out, self.support)
+            return out
+
         def act(params, obs, eps, key):
-            q = self.network.apply(params, obs)
-            greedy = jnp.argmax(q, axis=-1)
+            greedy = jnp.argmax(q_of(params, obs), axis=-1)
             k1, k2 = jax.random.split(key)
             random_actions = jax.random.randint(k1, greedy.shape, 0, action_dim)
             explore = jax.random.uniform(k2, greedy.shape) < eps
@@ -213,7 +344,7 @@ class DQNAgent(BaseAgent):
 
         self._act = jax.jit(act)
         self._predict = jax.jit(
-            lambda params, obs: jnp.argmax(self.network.apply(params, obs), axis=-1)
+            lambda params, obs: jnp.argmax(q_of(params, obs), axis=-1)
         )
 
     def _next_key(self) -> jax.Array:

@@ -6,6 +6,7 @@ from scalerl_tpu.models.transformer import (  # noqa: F401
 from scalerl_tpu.models.mlp import (  # noqa: F401
     ActorCriticNet,
     ActorNet,
+    C51QNet,
     CriticNet,
     NoisyDense,
     QNet,

@@ -54,6 +54,23 @@ class NoisyDense(nn.Module):
         return x @ w + b
 
 
+def normalized_columns_init(std: float = 1.0):
+    """Normalized-columns initializer (A3C-classic).
+
+    Parity: ``normalized_columns_initializer``
+    (``scalerl/algorithms/a3c/utils/atari_model.py:9-24``): gaussian noise
+    rescaled so every output unit's weight vector has L2 norm ``std``.
+    Flax kernels are ``[in, out]``, so the normalization runs over axis 0.
+    """
+
+    def init(key, shape, dtype=jnp.float32):
+        out = jax.random.normal(key, shape, dtype)
+        norm = jnp.sqrt(jnp.sum(jnp.square(out), axis=0, keepdims=True))
+        return std * out / (norm + 1e-12)
+
+    return init
+
+
 def _parse_hidden(hidden_sizes) -> Tuple[int, ...]:
     if isinstance(hidden_sizes, str):
         return tuple(int(h) for h in hidden_sizes.split(",") if h)
@@ -70,13 +87,17 @@ class QNet(nn.Module):
     hidden_sizes: Sequence[int] = (128, 128)
     dueling: bool = False
     noisy: bool = False
+    noisy_std: float = 0.5
 
     @nn.compact
     def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
         x = obs.astype(jnp.float32)
         if x.ndim > 2:
             x = x.reshape(x.shape[0], -1)  # flatten everything but batch
-        dense = NoisyDense if self.noisy else nn.Dense
+        if self.noisy:
+            dense = lambda f: NoisyDense(f, sigma0=self.noisy_std)  # noqa: E731
+        else:
+            dense = nn.Dense
         for h in _parse_hidden(self.hidden_sizes):
             x = nn.relu(dense(h)(x))
         if self.dueling:
@@ -84,6 +105,47 @@ class QNet(nn.Module):
             val = dense(1)(x)
             return val + adv - adv.mean(axis=-1, keepdims=True)
         return dense(self.action_dim)(x)
+
+
+class C51QNet(nn.Module):
+    """Categorical (C51) distributional Q-network.
+
+    Parity: the reference declares ``categorical_dqn``/``num_atoms``/
+    ``v_min``/``v_max`` (``scalerl/algorithms/rl_args.py:201-226``) but never
+    implements the head; this is the capability, with the same dueling/noisy
+    composition as :class:`QNet`.  Returns per-action atom *logits*
+    ``[B, A, N]``; expectations against the support live in the loss/actor
+    (``scalerl_tpu.ops.losses.categorical_q_values``).
+    """
+
+    action_dim: int
+    num_atoms: int = 51
+    hidden_sizes: Sequence[int] = (128, 128)
+    dueling: bool = False
+    noisy: bool = False
+    noisy_std: float = 0.5
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(jnp.float32)
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        if self.noisy:
+            dense = lambda f: NoisyDense(f, sigma0=self.noisy_std)  # noqa: E731
+        else:
+            dense = nn.Dense
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(dense(h)(x))
+        B = x.shape[0]
+        if self.dueling:
+            adv = dense(self.action_dim * self.num_atoms)(x).reshape(
+                B, self.action_dim, self.num_atoms
+            )
+            val = dense(self.num_atoms)(x).reshape(B, 1, self.num_atoms)
+            return val + adv - adv.mean(axis=1, keepdims=True)
+        return dense(self.action_dim * self.num_atoms)(x).reshape(
+            B, self.action_dim, self.num_atoms
+        )
 
 
 class ActorNet(nn.Module):
@@ -115,16 +177,28 @@ class CriticNet(nn.Module):
 
 class ActorCriticNet(nn.Module):
     """Shared-torso actor-critic (``network.py:70-95``,
-    ``a3c/parallel_a3c.py:27-68``). Returns (logits, value)."""
+    ``a3c/parallel_a3c.py:27-68``). Returns (logits, value).
+
+    ``normalized_init``: initialize the heads with normalized columns (std
+    0.01 policy / 1.0 value), the reference A3C's scheme
+    (``atari_model.py:126-131``).
+    """
 
     action_dim: int
     hidden_sizes: Sequence[int] = (128, 128)
+    normalized_init: bool = False
 
     @nn.compact
     def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         x = obs.astype(jnp.float32)
         for h in _parse_hidden(self.hidden_sizes):
             x = nn.relu(nn.Dense(h)(x))
-        logits = nn.Dense(self.action_dim)(x)
-        value = nn.Dense(1)(x).squeeze(-1)
-        return logits, value
+        if self.normalized_init:
+            logits = nn.Dense(
+                self.action_dim, kernel_init=normalized_columns_init(0.01)
+            )(x)
+            value = nn.Dense(1, kernel_init=normalized_columns_init(1.0))(x)
+        else:
+            logits = nn.Dense(self.action_dim)(x)
+            value = nn.Dense(1)(x)
+        return logits, value.squeeze(-1)

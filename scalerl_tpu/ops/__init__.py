@@ -1,8 +1,12 @@
 from scalerl_tpu.ops.losses import (  # noqa: F401
     baseline_loss,
+    c51_loss,
+    categorical_projection,
+    categorical_q_values,
     double_dqn_targets,
     dqn_loss,
     entropy_loss,
+    make_support,
     policy_gradient_loss,
 )
 from scalerl_tpu.ops.ring_attention import (  # noqa: F401

@@ -61,6 +61,77 @@ def double_dqn_targets(
     return jax.lax.stop_gradient(rewards + discounts * q_next)
 
 
+def make_support(v_min: float, v_max: float, num_atoms: int) -> jnp.ndarray:
+    """The fixed C51 atom grid ``z_i = v_min + i * dz``."""
+    return jnp.linspace(v_min, v_max, num_atoms)
+
+
+def categorical_q_values(logits: jnp.ndarray, support: jnp.ndarray) -> jnp.ndarray:
+    """Expected Q per action from atom logits: ``[B, A, N] -> [B, A]``."""
+    return jnp.sum(jax.nn.softmax(logits, axis=-1) * support, axis=-1)
+
+
+def categorical_projection(
+    next_probs: jnp.ndarray,
+    rewards: jnp.ndarray,
+    discounts: jnp.ndarray,
+    support: jnp.ndarray,
+) -> jnp.ndarray:
+    """C51 projected Bellman target (Bellemare et al. 2017, Alg. 1).
+
+    Shifts the next-state atom distribution by ``r + discount * z``, clips to
+    the support range, and splits each shifted atom's mass linearly between
+    its two neighboring grid points.  The reference declares the C51 flags
+    (``rl_args.py:201-226``) but never implements this; TPU-shaped here as a
+    dense one-hot matmul — ``[B, N, N]`` interpolation weights contracted on
+    the MXU — instead of scatter-adds, which lower to serial HLO scatter.
+
+    Shapes: next_probs ``[B, N]``, rewards/discounts ``[B]``, support ``[N]``;
+    returns ``[B, N]``.
+    """
+    num_atoms = support.shape[0]
+    v_min, v_max = support[0], support[-1]
+    dz = (v_max - v_min) / (num_atoms - 1)
+    # shifted sample positions for every source atom: [B, N]
+    tz = jnp.clip(
+        rewards[:, None] + discounts[:, None] * support[None, :], v_min, v_max
+    )
+    b = (tz - v_min) / dz  # fractional grid coordinates
+    low = jnp.floor(b)
+    up = jnp.ceil(b)
+    # when b lands exactly on a grid point (low == up), all mass goes to it
+    w_low = jnp.where(low == up, 1.0, up - b)  # [B, N]
+    w_up = b - low
+    grid = jnp.arange(num_atoms, dtype=b.dtype)  # [N]
+    # dense interpolation tensor W[b, src, dst]: mass of source atom src
+    # landing on destination atom dst
+    w = w_low[..., None] * (low[..., None] == grid) + w_up[..., None] * (
+        up[..., None] == grid
+    )
+    return jax.lax.stop_gradient(jnp.einsum("bs,bsd->bd", next_probs, w))
+
+
+def c51_loss(
+    logits: jnp.ndarray,
+    actions: jnp.ndarray,
+    target_probs: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Cross-entropy between projected target and predicted distribution.
+
+    Shapes: logits ``[B, A, N]``, actions ``[B]``, target_probs ``[B, N]``.
+    Returns (scalar loss, per-sample CE) — the per-sample cross-entropy is
+    the standard C51 PER priority signal.
+    """
+    log_p = jax.nn.log_softmax(logits, axis=-1)  # [B, A, N]
+    log_p_a = jnp.take_along_axis(
+        log_p, actions[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [B, N]
+    ce = -jnp.sum(target_probs * log_p_a, axis=-1)  # [B]
+    per_elem = ce if weights is None else ce * weights
+    return jnp.mean(per_elem), jax.lax.stop_gradient(ce)
+
+
 def dqn_loss(
     q_values: jnp.ndarray,
     actions: jnp.ndarray,

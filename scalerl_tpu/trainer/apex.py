@@ -217,6 +217,13 @@ class ApexTrainer(BaseTrainer):
     ) -> None:
         super().__init__(args, run_name=run_name)
         args.validate()
+        if getattr(args, "categorical_dqn", False):
+            raise ValueError(
+                "categorical_dqn (C51) is not supported by ApexTrainer: its "
+                "priority/learn paths are scalar-Q "
+                "(make_dqn_priority_fn/make_dqn_learn_fn); use DQNAgent with "
+                "OffPolicyTrainer for C51"
+            )
         self.agent = agent
         self.eval_envs = eval_envs
         self._actor_envs = [make_envs(i) for i in range(args.num_actors)]

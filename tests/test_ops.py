@@ -12,11 +12,15 @@ import pytest
 
 from scalerl_tpu.ops import (
     baseline_loss,
+    c51_loss,
+    categorical_projection,
+    categorical_q_values,
     double_dqn_targets,
     dqn_loss,
     entropy_loss,
     discounted_returns,
     gae_advantages,
+    make_support,
     n_step_returns,
     policy_gradient_loss,
     vtrace_from_importance_weights,
@@ -244,3 +248,82 @@ def test_vtrace_jit_and_grad():
 
     g = jax.jit(jax.grad(loss_fn))(params)
     assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_categorical_projection_hand_computed():
+    """C51 projected Bellman update vs hand-worked cases (support 0..4)."""
+    support = make_support(0.0, 4.0, 5)
+    probs = jnp.array(
+        [
+            [0.0, 0.0, 1.0, 0.0, 0.0],  # mass on z=2
+            [0.2, 0.2, 0.2, 0.2, 0.2],  # terminal: dist irrelevant
+            [0.5, 0.0, 0.0, 0.0, 0.5],  # clipped above
+            [1.0, 0.0, 0.0, 0.0, 0.0],  # lands exactly on a grid point
+        ]
+    )
+    rewards = jnp.array([0.5, 3.3, 10.0, 1.0])
+    discounts = jnp.array([1.0, 0.0, 1.0, 1.0])
+    out = np.asarray(categorical_projection(probs, rewards, discounts, support))
+    # Tz = 2.5: split between atoms 2 and 3
+    np.testing.assert_allclose(out[0], [0, 0, 0.5, 0.5, 0], atol=1e-6)
+    # terminal: everything lands at 3.3 -> 0.7 on atom 3, 0.3 on atom 4
+    np.testing.assert_allclose(out[1], [0, 0, 0, 0.7, 0.3], atol=1e-6)
+    # clip to v_max: all mass on the last atom (l == u == 4 edge case)
+    np.testing.assert_allclose(out[2], [0, 0, 0, 0, 1.0], atol=1e-6)
+    # exact grid point: no mass split
+    np.testing.assert_allclose(out[3], [0, 1.0, 0, 0, 0], atol=1e-6)
+
+
+def test_categorical_projection_matches_numpy_oracle(rng):
+    """Random distributions vs an explicit-loop Bellemare Alg. 1 oracle."""
+    N, batch = 11, 16
+    v_min, v_max = -2.0, 3.0
+    dz = (v_max - v_min) / (N - 1)
+    z = np.linspace(v_min, v_max, N)
+    p = rng.dirichlet(np.ones(N), size=batch).astype(np.float32)
+    r = rng.normal(size=batch).astype(np.float32)
+    d = (rng.random(batch) > 0.3).astype(np.float32) * 0.97
+
+    expected = np.zeros((batch, N), np.float64)
+    for i in range(batch):
+        for j in range(N):
+            tz = np.clip(r[i] + d[i] * z[j], v_min, v_max)
+            b = (tz - v_min) / dz
+            low, up = int(np.floor(b)), int(np.ceil(b))
+            if low == up:
+                expected[i, low] += p[i, j]
+            else:
+                expected[i, low] += p[i, j] * (up - b)
+                expected[i, up] += p[i, j] * (b - low)
+
+    out = np.asarray(
+        categorical_projection(
+            jnp.array(p), jnp.array(r), jnp.array(d), make_support(v_min, v_max, N)
+        )
+    )
+    np.testing.assert_allclose(out, expected, atol=1e-5)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_c51_loss_and_q_values(rng):
+    N = 5
+    support = make_support(0.0, 4.0, N)
+    logits = jnp.array(rng.normal(size=(3, A, N)).astype(np.float32))
+    actions = jnp.array([0, 2, 1])
+    target = jnp.array(rng.dirichlet(np.ones(N), size=3).astype(np.float32))
+    loss, ce = c51_loss(logits, actions, target)
+    # manual cross-entropy
+    logp = np.log(np_softmax(np.asarray(logits)))
+    expected = [
+        -(np.asarray(target)[i] * logp[i, int(actions[i])]).sum() for i in range(3)
+    ]
+    np.testing.assert_allclose(np.asarray(ce), expected, rtol=1e-5)
+    np.testing.assert_allclose(float(loss), np.mean(expected), rtol=1e-5)
+    # weights scale per-sample terms of the scalar loss
+    w = jnp.array([1.0, 0.0, 0.0])
+    loss_w, _ = c51_loss(logits, actions, target, weights=w)
+    np.testing.assert_allclose(float(loss_w), expected[0] / 3, rtol=1e-5)
+    # expected Q
+    q = categorical_q_values(logits, support)
+    probs = np_softmax(np.asarray(logits))
+    np.testing.assert_allclose(np.asarray(q), (probs * np.asarray(support)).sum(-1), rtol=1e-5)
