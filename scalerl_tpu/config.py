@@ -81,6 +81,11 @@ class RLArguments:
     save_model: bool = True
     save_frequency: int = 10_000
     disable_checkpoint: bool = False
+    # Path to a previous run directory (the one holding model_dir/tb_log) to
+    # resume from: restores train state, replay cursors, and logger counters
+    # (parity: tensorboard.py:65-82 / wandb.py:104-160 restore_data, which
+    # the reference had but its trainers never surfaced as a flag).
+    resume: str = ""
 
     def validate(self) -> None:
         if self.batch_size <= 0:
@@ -103,6 +108,13 @@ class DQNArguments(RLArguments):
     double_dqn: bool = True
     dueling_dqn: bool = False
     noisy_dqn: bool = False
+    noisy_std: float = 0.5
+    # Categorical (C51) distributional head (parity: rl_args.py:201-226 —
+    # declared there, implemented here)
+    categorical_dqn: bool = False
+    num_atoms: int = 51
+    v_min: float = 0.0
+    v_max: float = 200.0
     hidden_sizes: str = "128,128"
     # Exploration schedule
     eps_greedy_start: float = 1.0
@@ -129,6 +141,13 @@ class DQNArguments(RLArguments):
             raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
         if not (0.0 <= self.per_alpha <= 1.0):
             raise ValueError(f"per_alpha must be in [0, 1], got {self.per_alpha}")
+        if self.categorical_dqn:
+            if self.num_atoms < 2:
+                raise ValueError(f"num_atoms must be >= 2, got {self.num_atoms}")
+            if not self.v_max > self.v_min:
+                raise ValueError(
+                    f"v_max ({self.v_max}) must exceed v_min ({self.v_min})"
+                )
 
 
 @dataclass
@@ -151,6 +170,10 @@ class A3CArguments(RLArguments):
     hidden_size: int = 256  # pixel obs: LSTM width (reference LSTMCell(256))
     max_episode_steps: int = 500
     max_grad_norm: float = 50.0  # reference clip(50), parallel_a3c.py:368
+    # running mean/std obs normalization (atari_env.py:87-122) and
+    # normalized-columns head init (atari_model.py:9-24)
+    normalize_obs: bool = False
+    normalized_init: bool = False
 
 
 @dataclass
@@ -277,7 +300,15 @@ def build_parser(cls: Type[T], parser: Optional[argparse.ArgumentParser] = None)
                 "bool": bool,
             }.get(tname, str if "str" in tname else type(default) if default is not None else str)
         if ftype is bool:
-            parser.add_argument(name, type=_str2bool, default=default, help=help_text)
+            # accept both bare `--flag` (== true) and `--flag false`
+            parser.add_argument(
+                name,
+                type=_str2bool,
+                nargs="?",
+                const=True,
+                default=default,
+                help=help_text,
+            )
         else:
             parser.add_argument(name, type=ftype, default=default, help=help_text)
     return parser

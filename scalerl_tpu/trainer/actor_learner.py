@@ -148,9 +148,38 @@ class HostActorLearnerTrainer(BaseTrainer):
         self.learn_timings = Timings()
 
     # ------------------------------------------------------------------
+    def _resume_pytree(self) -> Dict:
+        return {
+            "agent": self.agent.state,
+            "env_frames": np.asarray(self.env_frames, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.env_frames, int(self.agent.state.step)
+        )
+
+    def try_resume(self) -> bool:
+        """Restore learner state + frame counter (parity: the reference's
+        IMPALA 10-min checkpoints, ``impala_atari.py:460-469,496-515`` —
+        which it saved but never wired a restore for)."""
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.env_frames = int(state["env_frames"])
+        self.param_server.push(self.agent.get_weights())
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: frames {self.env_frames}"
+            )
+        return True
+
     def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
         args = self.args
         total_frames = total_frames or args.total_steps
+        if self.resuming:
+            self.try_resume()
         actors = []
         for i, fn in enumerate(self.env_fns):
             envs = self._probe_env if i == 0 else fn()
@@ -159,7 +188,9 @@ class HostActorLearnerTrainer(BaseTrainer):
             a.start()
 
         start = time.time()
-        last_log_frames = 0
+        start_frames = self.env_frames  # nonzero after resume
+        last_log_frames = start_frames
+        last_save_frames = start_frames
         metrics: Dict[str, float] = {}
         try:
             while self.env_frames < total_frames and not self.stop_event.is_set():
@@ -175,9 +206,19 @@ class HostActorLearnerTrainer(BaseTrainer):
                 self.learn_timings.time("learn")
                 self.param_server.push(self.agent.get_weights())
 
+                if (
+                    args.save_model
+                    and not args.disable_checkpoint
+                    and self.env_frames - last_save_frames >= args.save_frequency
+                ):
+                    last_save_frames = self.env_frames
+                    self.save_resume()
+
                 if self.env_frames - last_log_frames >= args.logger_frequency:
                     last_log_frames = self.env_frames
-                    sps = self.env_frames / max(time.time() - start, 1e-8)
+                    sps = (self.env_frames - start_frames) / max(
+                        time.time() - start, 1e-8
+                    )
                     rets = [
                         r
                         for m in self.episode_metrics
@@ -201,7 +242,9 @@ class HostActorLearnerTrainer(BaseTrainer):
                     a.envs.close()
                 except Exception:
                     pass
-        sps = self.env_frames / max(time.time() - start, 1e-8)
+        if args.save_model and not args.disable_checkpoint:
+            self.save_resume()
+        sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
         rets = [r for m in self.episode_metrics for r in m.episode_returns]
         return {
             **metrics,
@@ -235,20 +278,44 @@ class DeviceActorLearnerTrainer(BaseTrainer):
             iters_per_call=iters_per_call,
         )
 
+    def _resume_pytree(self) -> Dict:
+        return {"agent": self.agent.state, "env_frames": np.asarray(0, np.int64)}
+
     def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
         args = self.args
         total_frames = total_frames or args.total_steps
         frames_per_call = (
             args.rollout_length * self.loop.venv.num_envs * self.loop.iters_per_call
         )
-        num_calls = max(total_frames // frames_per_call, 1)
-        key = jax.random.PRNGKey(args.seed)
+        done_frames = 0
+        if self.resuming:
+            prev = self.load_resume_checkpoint(self._resume_pytree())
+            if prev is not None:
+                self.agent.state = prev["agent"]
+                done_frames = int(prev["env_frames"])
+                if self.is_main_process:
+                    self.text_logger.info(
+                        f"resumed from {self.resume_ckpt_path}: frames {done_frames}"
+                    )
+        remaining = total_frames - done_frames
+        if remaining <= 0:
+            # resumed a finished run: nothing to do, don't over-train
+            if self.is_main_process:
+                self.text_logger.info(
+                    f"resume frames {done_frames} >= budget {total_frames}; no-op"
+                )
+            return {"env_frames": float(done_frames), "sps": 0.0}
+        num_calls = max(remaining // frames_per_call, 1)
+        key = jax.random.PRNGKey(args.seed + done_frames % 65537)
         carry = self.loop.init_carry(key)
         start = time.time()
 
         def on_metrics(i: int, m: Dict[str, float]) -> None:
-            frames = (i + 1) * frames_per_call
-            sps = frames / max(time.time() - start, 1e-8)
+            # offset by done_frames so resumed runs keep logging (the logger
+            # gate was restored to the old run's last step) and the tb
+            # timeline continues instead of rewinding over the old events
+            frames = done_frames + (i + 1) * frames_per_call
+            sps = (frames - done_frames) / max(time.time() - start, 1e-8)
             self.logger.log_train_data({**m, "sps": sps}, frames)
             if self.is_main_process and (i % 10 == 0 or i == num_calls - 1):
                 self.text_logger.info(
@@ -259,7 +326,13 @@ class DeviceActorLearnerTrainer(BaseTrainer):
             self.agent.state, carry, key, num_calls, on_metrics=on_metrics
         )
         self.agent.state = state
-        frames = num_calls * frames_per_call
+        frames = done_frames + num_calls * frames_per_call
+        if args.save_model and not args.disable_checkpoint:
+            self.save_resume_checkpoint(
+                {"agent": state, "env_frames": np.asarray(frames, np.int64)},
+                frames,
+                int(state.step),
+            )
         metrics["env_frames"] = float(frames)
-        metrics["sps"] = frames / max(time.time() - start, 1e-8)
+        metrics["sps"] = (frames - done_frames) / max(time.time() - start, 1e-8)
         return metrics

@@ -21,9 +21,18 @@ class BaseTrainer:
     def __init__(self, args: RLArguments, run_name: Optional[str] = None) -> None:
         self.args = args
         self.is_main_process = process_index() == 0
-        stamp = time.strftime("%Y%m%d_%H%M%S")
-        run_name = run_name or f"{args.algo_name}_{args.seed}_{stamp}"
-        root = os.path.join(args.work_dir, args.project, args.env_id, args.algo_name, run_name)
+        self.resuming = bool(getattr(args, "resume", ""))
+        if self.resuming:
+            # resume into the old run dir so tb events append and the resume
+            # checkpoint under model_dir is found
+            root = args.resume.rstrip("/")
+            run_name = os.path.basename(root)
+        else:
+            stamp = time.strftime("%Y%m%d_%H%M%S")
+            run_name = run_name or f"{args.algo_name}_{args.seed}_{stamp}"
+            root = os.path.join(
+                args.work_dir, args.project, args.env_id, args.algo_name, run_name
+            )
         self.work_dir = root
         self.tb_log_dir = os.path.join(root, "tb_log")
         self.text_log_dir = os.path.join(root, "text_log")
@@ -51,6 +60,48 @@ class BaseTrainer:
             )
         else:
             self.logger = make_logger("none", self.tb_log_dir)
+
+    # -- resume checkpointing ------------------------------------------
+    @property
+    def resume_ckpt_path(self) -> str:
+        return os.path.join(self.model_save_dir, "resume")
+
+    def save_resume_checkpoint(self, state: dict, env_step: int, grad_step: int) -> None:
+        """Write the full-trainer resume state + logger save markers.
+
+        ``state``: pytree of everything needed to continue (train state,
+        replay state, counters).  Logger markers mirror the reference's
+        ``save_data`` (``tensorboard.py:41-63``) so ``restore_data`` can
+        recover the interval-gating counters from the event files alone.
+        """
+        if not self.is_main_process:
+            return
+        from scalerl_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(self.resume_ckpt_path, state)
+        self.logger.save_data(0, env_step, grad_step)
+
+    def load_resume_checkpoint(self, target: dict) -> Optional[dict]:
+        """Restore the resume pytree + logger counters.
+
+        When the user explicitly asked for ``--resume`` but no checkpoint
+        exists at the target, raise instead of returning None — silently
+        retraining from step 0 into the old run dir would corrupt the tb
+        event stream the user believes is a continuation.
+        """
+        if not os.path.exists(self.resume_ckpt_path):
+            if self.resuming:
+                raise FileNotFoundError(
+                    f"--resume={self.args.resume}: no resume checkpoint at "
+                    f"{self.resume_ckpt_path} (pass the run directory that "
+                    "holds model_dir/resume, written at save_frequency)"
+                )
+            return None
+        from scalerl_tpu.utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(self.resume_ckpt_path, target)
+        self.logger.restore_data()
+        return state
 
     def close(self) -> None:
         self.logger.close()

@@ -110,13 +110,50 @@ class OffPolicyTrainer(BaseTrainer):
         }
 
     # ------------------------------------------------------------------
+    def _resume_pytree(self) -> Dict:
+        # counters as host numpy (int64 survives regardless of jax_enable_x64)
+        return {
+            "agent": self.agent.state,
+            "replay": self.sampler.buffer.state,
+            "global_step": np.asarray(self.global_step, np.int64),
+            "learn_steps": np.asarray(self.learn_steps, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.global_step, self.learn_steps
+        )
+
+    def try_resume(self) -> bool:
+        """Restore train state, replay cursors, counters, and exploration
+        schedule position from ``args.resume``; True when restored."""
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.sampler.buffer.state = state["replay"]
+        self.global_step = int(state["global_step"])
+        self.learn_steps = int(state["learn_steps"])
+        # fast-forward the exploration schedule to the restored step
+        self.agent.eps_scheduler.cur_step = self.global_step
+        self.agent.eps = self.agent.eps_scheduler.value(self.global_step)
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: step {self.global_step}, "
+                f"learn_steps {self.learn_steps}"
+            )
+        return True
+
     def run(self) -> Dict[str, float]:
         args = self.args
+        if self.resuming:
+            self.try_resume()
         obs, _ = self.train_envs.reset(seed=args.seed)
         start = time.time()
-        last_log = 0
-        last_eval = 0
-        last_save = 0
+        start_step = self.global_step
+        last_log = self.global_step
+        last_eval = self.global_step
+        last_save = self.global_step
         train_info: Dict[str, float] = {}
 
         while self.global_step < args.max_timesteps:
@@ -136,7 +173,9 @@ class OffPolicyTrainer(BaseTrainer):
 
             if self.global_step - last_log >= args.logger_frequency:
                 last_log = self.global_step
-                fps = int(self.global_step / max(time.time() - start, 1e-8))
+                fps = int(
+                    (self.global_step - start_step) / max(time.time() - start, 1e-8)
+                )
                 summary = self.metrics.summary()
                 info = {
                     **{k: v for k, v in train_info.items()},
@@ -171,7 +210,9 @@ class OffPolicyTrainer(BaseTrainer):
                 last_save = self.global_step
                 if self.is_main_process:
                     self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_{self.global_step}")
+                    self.save_resume()
 
         if args.save_model and not args.disable_checkpoint and self.is_main_process:
             self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_final")
+            self.save_resume()
         return self.metrics.summary()

@@ -121,8 +121,37 @@ class OnPolicyTrainer(BaseTrainer):
         }
 
     # ------------------------------------------------------------------
+    def _resume_pytree(self) -> Dict:
+        return {
+            "agent": self.agent.state,
+            "global_step": np.asarray(self.global_step, np.int64),
+            "learn_steps": np.asarray(self.learn_steps, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.global_step, self.learn_steps
+        )
+
+    def try_resume(self) -> bool:
+        """Restore train state + counters; on-policy has no replay to carry
+        (the next rollout chunk is regenerated from the restored policy)."""
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.global_step = int(state["global_step"])
+        self.learn_steps = int(state["learn_steps"])
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: step {self.global_step}"
+            )
+        return True
+
     def run(self) -> Dict[str, float]:
         args = self.args
+        if self.resuming:
+            self.try_resume()
         B = self.num_envs
         obs, _ = self.train_envs.reset(seed=args.seed)
         carry = (
@@ -133,9 +162,10 @@ class OnPolicyTrainer(BaseTrainer):
             self.agent.initial_state(B),
         )
         start = time.time()
-        last_log = 0
-        last_eval = 0
-        last_save = 0
+        start_step = self.global_step
+        last_log = self.global_step
+        last_eval = self.global_step
+        last_save = self.global_step
         train_info: Dict[str, float] = {}
 
         while self.global_step < args.max_timesteps:
@@ -145,7 +175,9 @@ class OnPolicyTrainer(BaseTrainer):
 
             if self.global_step - last_log >= args.logger_frequency:
                 last_log = self.global_step
-                fps = int(self.global_step / max(time.time() - start, 1e-8))
+                fps = int(
+                    (self.global_step - start_step) / max(time.time() - start, 1e-8)
+                )
                 summary = self.metrics.summary()
                 info = {**train_info, "fps": fps, "learn_steps": self.learn_steps, **summary}
                 self.logger.log_train_data(info, self.global_step)
@@ -174,7 +206,9 @@ class OnPolicyTrainer(BaseTrainer):
                 last_save = self.global_step
                 if self.is_main_process:
                     self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_{self.global_step}")
+                    self.save_resume()
 
         if args.save_model and not args.disable_checkpoint and self.is_main_process:
             self.agent.save_checkpoint(f"{self.model_save_dir}/ckpt_final")
+            self.save_resume()
         return self.metrics.summary()
