@@ -26,8 +26,19 @@ def main() -> None:
     from scalerl_tpu.utils.platform import setup_platform
 
     print("backend:", setup_platform(args.platform))
-    train_envs = make_vect_envs(args.env_id, num_envs=args.num_workers, seed=args.seed)
-    eval_envs = make_vect_envs(args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False)
+    train_envs = make_vect_envs(
+        args.env_id,
+        num_envs=args.num_workers,
+        seed=args.seed,
+        normalize_obs=args.normalize_obs,
+    )
+    eval_envs = make_vect_envs(
+        args.env_id,
+        num_envs=2,
+        seed=args.seed + 1,
+        async_envs=False,
+        normalize_obs=args.normalize_obs,
+    )
     agent = A3CAgent(
         args,
         obs_shape=train_envs.single_observation_space.shape,

@@ -142,10 +142,18 @@ def build_model(args: A3CArguments, obs_shape: Tuple[int, ...], num_actions: int
     """Pixel obs -> conv+LSTM AtariNet (the reference's A3C Atari model,
     ``a3c/utils/atari_model.py:57-144``: convs + LSTMCell(256));
     flat obs -> MLPPolicyNet (``parallel_a3c.py:27-68``)."""
+    norm_init = bool(getattr(args, "normalized_init", False))
     if len(obs_shape) == 3:
-        return AtariNet(num_actions=num_actions, use_lstm=args.use_lstm, hidden_size=args.hidden_size)
+        return AtariNet(
+            num_actions=num_actions,
+            use_lstm=args.use_lstm,
+            hidden_size=args.hidden_size,
+            normalized_init=norm_init,
+        )
     hidden = tuple(int(h) for h in str(args.hidden_sizes).split(",") if h)
-    return MLPPolicyNet(num_actions=num_actions, hidden_sizes=hidden)
+    return MLPPolicyNet(
+        num_actions=num_actions, hidden_sizes=hidden, normalized_init=norm_init
+    )
 
 
 class A3CAgent(PolicyValueAgent):

@@ -1,3 +1,9 @@
+from scalerl_tpu.envs.atari import (  # noqa: F401
+    NormalizedEnv,
+    create_atari_env,
+    make_atari_env,
+    wrap_deepmind,
+)
 from scalerl_tpu.envs.gym_env import (  # noqa: F401
     make_gym_env,
     make_multi_agent_vect_envs,

@@ -189,6 +189,59 @@ class FrameStack(gym.Wrapper):
         return np.concatenate(list(self.frames), axis=-1)
 
 
+class NormalizedEnv(gym.ObservationWrapper):
+    """Running mean/std observation normalization with EMA bias correction.
+
+    Parity: the A3C Atari variant's ``NormalizedEnv``
+    (``scalerl/algorithms/a3c/utils/atari_env.py:87-122``): scalar running
+    mean and std over whole observations, decay ``alpha``, divided by
+    ``1 - alpha^t`` to unbias early steps.
+    """
+
+    def __init__(self, env: gym.Env, alpha: float = 0.9999) -> None:
+        super().__init__(env)
+        self.alpha = alpha
+        self.state_mean = 0.0
+        self.state_std = 0.0
+        self.num_steps = 0
+        self.observation_space = gym.spaces.Box(
+            low=-np.inf, high=np.inf, shape=env.observation_space.shape,
+            dtype=np.float32,
+        )
+
+    def observation(self, observation):
+        obs = np.asarray(observation, np.float32)
+        self.num_steps += 1
+        self.state_mean = self.alpha * self.state_mean + (1 - self.alpha) * obs.mean()
+        self.state_std = self.alpha * self.state_std + (1 - self.alpha) * obs.std()
+        correction = 1 - self.alpha**self.num_steps
+        unbiased_mean = self.state_mean / correction
+        unbiased_std = self.state_std / correction
+        return (obs - unbiased_mean) / (unbiased_std + 1e-8)
+
+
+def create_atari_env(
+    env_id: str,
+    seed: int = 42,
+    warp_size: int = 42,
+    normalize: bool = True,
+) -> gym.Env:
+    """The A3C 42x42 Atari variant: rescale + running-norm (parity:
+    ``create_atari_env``, ``a3c/utils/atari_env.py:9-30``)."""
+    env = gym.make(env_id)
+    env = wrap_deepmind(
+        env,
+        episode_life=False,
+        clip_rewards=False,
+        frame_stack=1,
+        warp_size=warp_size,
+    )
+    if normalize:
+        env = NormalizedEnv(env)
+    env.action_space.seed(seed)
+    return env
+
+
 def wrap_deepmind(
     env: gym.Env,
     episode_life: bool = True,

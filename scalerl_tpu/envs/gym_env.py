@@ -23,6 +23,7 @@ def make_gym_env(
     capture_video: bool = False,
     video_dir: Optional[str] = None,
     atari: bool = False,
+    normalize_obs: bool = False,
     **env_kwargs,
 ) -> Callable[[], gym.Env]:
     """Return a thunk building one env (thunks are what vector ctors want)."""
@@ -37,6 +38,10 @@ def make_gym_env(
             from scalerl_tpu.envs.atari import wrap_deepmind
 
             env = wrap_deepmind(env)
+        if normalize_obs:
+            from scalerl_tpu.envs.atari import NormalizedEnv
+
+            env = NormalizedEnv(env)
         env.action_space.seed(seed + idx)
         return env
 

@@ -64,6 +64,9 @@ class AtariNet(nn.Module):
     conv_kernels: Sequence[int] = (8, 4, 3)
     conv_strides: Sequence[int] = (4, 2, 1)
     dtype: jnp.dtype = jnp.float32  # set bfloat16 for MXU-heavy runs
+    # normalized-columns head init (std 0.01 policy / 1.0 value), the
+    # reference A3C's scheme (a3c/utils/atari_model.py:9-24,126-131)
+    normalized_init: bool = False
 
     @property
     def core_size(self) -> int:
@@ -125,8 +128,20 @@ class AtariNet(nn.Module):
             core_output = core_input
 
         core_output = core_output.astype(jnp.float32)
-        policy_logits = nn.Dense(self.num_actions, name="policy")(core_output)
-        baseline = nn.Dense(1, name="baseline")(core_output)
+        if self.normalized_init:
+            from scalerl_tpu.models.mlp import normalized_columns_init
+
+            policy_logits = nn.Dense(
+                self.num_actions,
+                name="policy",
+                kernel_init=normalized_columns_init(0.01),
+            )(core_output)
+            baseline = nn.Dense(
+                1, name="baseline", kernel_init=normalized_columns_init(1.0)
+            )(core_output)
+        else:
+            policy_logits = nn.Dense(self.num_actions, name="policy")(core_output)
+            baseline = nn.Dense(1, name="baseline")(core_output)
         return (
             AtariNetOutput(
                 policy_logits=policy_logits.reshape(T, B, self.num_actions),

@@ -28,6 +28,7 @@ class MLPPolicyNet(nn.Module):
 
     num_actions: int
     hidden_sizes: Sequence[int] = (256, 256)
+    normalized_init: bool = False  # A3C head init (atari_model.py:9-24)
 
     def initial_state(self, batch_size: int) -> LSTMState:
         return ()
@@ -45,6 +46,18 @@ class MLPPolicyNet(nn.Module):
         x = obs.astype(jnp.float32)
         for h in self.hidden_sizes:
             x = nn.relu(nn.Dense(h)(x))
-        logits = nn.Dense(self.num_actions, name="policy")(x)
-        baseline = nn.Dense(1, name="baseline")(x).squeeze(-1)
+        if self.normalized_init:
+            from scalerl_tpu.models.mlp import normalized_columns_init
+
+            logits = nn.Dense(
+                self.num_actions,
+                name="policy",
+                kernel_init=normalized_columns_init(0.01),
+            )(x)
+            baseline = nn.Dense(
+                1, name="baseline", kernel_init=normalized_columns_init(1.0)
+            )(x).squeeze(-1)
+        else:
+            logits = nn.Dense(self.num_actions, name="policy")(x)
+            baseline = nn.Dense(1, name="baseline")(x).squeeze(-1)
         return AtariNetOutput(policy_logits=logits, baseline=baseline), core_state

@@ -159,3 +159,60 @@ def test_atari_wrappers_on_fake_env():
     obs, reward, term, trunc, _ = env.step(0)
     assert reward == -1.0  # -2.5 * 4 skip-summed, clipped to sign
     assert obs.shape == (84, 84, 4)
+
+
+def test_normalized_env_running_stats():
+    """NormalizedEnv (atari_env.py:87-122 parity): EMA mean/std with bias
+    correction; a constant-obs stream normalizes toward zero."""
+    from scalerl_tpu.envs.atari import NormalizedEnv
+
+    class ConstEnv(gym.Env):
+        observation_space = gym.spaces.Box(-np.inf, np.inf, (4,), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def reset(self, **kw):
+            return np.full(4, 5.0, np.float32), {}
+
+        def step(self, action):
+            return np.full(4, 5.0, np.float32), 0.0, False, False, {}
+
+    env = NormalizedEnv(ConstEnv(), alpha=0.9)
+    obs, _ = env.reset()
+    # first obs: unbiased mean == obs.mean() == 5, std == 0 -> ~zero output
+    np.testing.assert_allclose(obs, 0.0, atol=1e-4)
+    # hand-check the EMA bias correction on step 2: the unbiased mean of a
+    # constant stream is the constant itself, so the output stays ~zero
+    # (tiny float error is amplified by the 1e-8 std floor; bound loosely)
+    obs2, *_ = env.step(0)
+    state_mean = 0.9 * (0.1 * 5.0) + 0.1 * 5.0
+    assert abs(state_mean / (1 - 0.9**2) - 5.0) < 1e-12
+    np.testing.assert_allclose(obs2, 0.0, atol=1e-4)
+    assert env.num_steps == 2
+
+    # varying observations drive the output toward unit scale
+    class RampEnv(ConstEnv):
+        def __init__(self):
+            self.t = 0
+
+        def step(self, action):
+            self.t += 1
+            return np.arange(4, dtype=np.float32) * self.t, 0.0, False, False, {}
+
+    env2 = NormalizedEnv(RampEnv(), alpha=0.99)
+    env2.reset()
+    for _ in range(50):
+        obs, *_ = env2.step(0)
+    assert np.all(np.isfinite(obs))
+    assert np.abs(obs).max() < 50  # scaled down from raw ~200
+
+
+def test_make_gym_env_normalize_obs_flag():
+    env = __import__("scalerl_tpu.envs", fromlist=["make_gym_env"]).make_gym_env(
+        "CartPole-v1", normalize_obs=True
+    )()
+    from scalerl_tpu.envs.atari import NormalizedEnv
+
+    assert isinstance(env, NormalizedEnv)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (4,) and np.all(np.isfinite(obs))
+    env.close()

@@ -113,3 +113,18 @@ def test_atari_net_jit_grad():
     g = jax.grad(loss)(params)
     flat = jax.tree_util.tree_leaves(g)
     assert all(np.all(np.isfinite(np.asarray(x))) for x in flat)
+
+
+def test_normalized_columns_init():
+    """normalized_columns_init (atari_model.py:9-24 parity): every output
+    unit's weight vector has L2 norm == std (columns of the [in, out] kernel)."""
+    from scalerl_tpu.models.mlp import normalized_columns_init
+
+    w = normalized_columns_init(0.01)(jax.random.PRNGKey(0), (64, 6))
+    norms = np.sqrt(np.sum(np.square(np.asarray(w)), axis=0))
+    np.testing.assert_allclose(norms, 0.01, rtol=1e-5)
+
+    net = ActorCriticNet(action_dim=4, normalized_init=True)
+    params = net.init(jax.random.PRNGKey(1), jnp.zeros((2, 8)))
+    logits, value = net.apply(params, jnp.zeros((2, 8)))
+    assert logits.shape == (2, 4) and value.shape == (2,)
