@@ -5,9 +5,22 @@ pipeline — conv net, LSTM, V-trace — at real frame shapes for throughput
 benchmarking and pipeline tests.  Dynamics: a hidden integer state walks a
 ring of ``num_states`` cells; each cell renders a deterministic [84, 84, 4]
 uint8 pattern; one distinguished action advances the walk (reward 1), the
-rest regress it (reward 0); episodes end after ``episode_length`` steps.
-A policy can therefore *learn* here (the optimal action is obs-dependent),
-which makes it useful as a learning smoke test, not just a data pump.
+rest teleport it to a uniformly random cell (reward 0); episodes end after
+``episode_length`` steps.  A policy can therefore *learn* here (the optimal
+action is obs-dependent), which makes it useful as a learning smoke test,
+not just a data pump.
+
+Design notes for learnability: the correct-action map ``cell % num_actions``
+hits *every* action whenever ``num_states >= num_actions`` (an earlier
+``(2*cell + 1)`` map only ever used odd actions, and ``(3*cell + 1)`` missed
+actions whenever ``gcd(3, num_actions) > 1`` — e.g. the default 6 actions),
+and a wrong action *teleports* rather than stepping back
+— a step-back rule lets any constant-action policy oscillate between a
+correct cell and its neighbour, collecting reward every other step, i.e. a
+50%-of-optimal attractor no gradient signal needs to escape.  With teleport,
+a constant policy earns ~1/num_actions of optimal and every extra
+distinguished state strictly increases return, so "return_mean ->
+episode_length" is real evidence the conv torso learned the obs->action map.
 """
 
 from __future__ import annotations
@@ -34,6 +47,13 @@ class SyntheticPixelEnv(JaxEnv):
         num_states: int = 16,
         episode_length: int = 128,
     ) -> None:
+        if num_states > size:
+            # each cell needs a distinct stripe column block; more states
+            # than columns would alias cells >= size into identical frames
+            raise ValueError(
+                f"num_states ({num_states}) must be <= size ({size}) so every "
+                "cell renders a distinct observation"
+            )
         self.size = size
         self.stack = stack
         self._num_actions = num_actions
@@ -53,15 +73,27 @@ class SyntheticPixelEnv(JaxEnv):
         return self._num_actions
 
     def _render(self, cell: jnp.ndarray) -> jnp.ndarray:
-        """Deterministic per-cell pattern: banded gradient keyed by the cell."""
+        """Deterministic per-cell pattern: a bright vertical stripe at a
+        cell-indexed column over a fixed dim texture.
+
+        The stripe makes the state *spatially* encoded — the conv torso must
+        localize it, which is a real (but quickly learnable) vision task.  An
+        earlier render varied only the row-gradient slope per cell; after the
+        stride-4 conv that discrimination was so aliased that IMPALA sat at
+        the random-policy return for hundreds of thousands of frames, which
+        made the env useless as a learning smoke test.
+        """
         rows = jnp.arange(self.size)[:, None, None]
         cols = jnp.arange(self.size)[None, :, None]
         chans = jnp.arange(self.stack)[None, None, :]
-        pattern = (rows * (cell + 1) + cols * 3 + chans * 17) % 256
+        texture = (rows * 2 + cols * 5 + chans * 17) % 128
+        stripe_w = max(self.size // self.num_states, 1)
+        in_stripe = (cols // stripe_w) == cell
+        pattern = jnp.where(in_stripe, 255, texture)
         return pattern.astype(jnp.uint8)
 
     def _correct_action(self, cell: jnp.ndarray) -> jnp.ndarray:
-        return (cell * 2 + 1) % self._num_actions
+        return cell % self._num_actions
 
     def reset(self, key: jax.Array):
         cell = jax.random.randint(key, (), 0, self.num_states)
@@ -71,11 +103,13 @@ class SyntheticPixelEnv(JaxEnv):
     def step(self, state: SyntheticState, action: jnp.ndarray, key: jax.Array):
         correct = action == self._correct_action(state.cell)
         reward = correct.astype(jnp.float32)
-        cell = jnp.where(correct, (state.cell + 1) % self.num_states, (state.cell - 1) % self.num_states)
+        k_teleport, k_reset = jax.random.split(key)
+        teleport = jax.random.randint(k_teleport, (), 0, self.num_states)
+        cell = jnp.where(correct, (state.cell + 1) % self.num_states, teleport)
         t = state.t + 1
         done = t >= self.episode_length
 
-        reset_cell = jax.random.randint(key, (), 0, self.num_states)
+        reset_cell = jax.random.randint(k_reset, (), 0, self.num_states)
         new_cell = jnp.where(done, reset_cell, cell)
         new_state = SyntheticState(new_cell, jnp.where(done, 0, t))
         return new_state, self._render(new_cell), reward, done

@@ -143,6 +143,57 @@ class DeviceActorLearnerLoop:
         return state, carry, mean_metrics
 
     # ------------------------------------------------------------------
+    def train_chunk(
+        self, state: ImpalaTrainState, carry: ActorCarry, key: jax.Array
+    ) -> Tuple[ImpalaTrainState, ActorCarry, Dict]:
+        """One fused dispatch (``iters_per_call`` env-unroll+update iterations).
+
+        The public single-dispatch entry point; ``run``/``run_until`` are
+        loops over this.  Inputs are donated — do not reuse ``state``/``carry``
+        after the call.
+        """
+        return self._train_many(state, carry, key)
+
+    def run_until(
+        self,
+        state: ImpalaTrainState,
+        carry: ActorCarry,
+        key: jax.Array,
+        threshold: float,
+        max_calls: int,
+        on_metrics: Optional[Callable[[int, float, Dict[str, float]], None]] = None,
+    ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
+        """Drive fused chunks until the *windowed* mean episode return (over
+        episodes completed since the previous chunk) reaches ``threshold``,
+        or ``max_calls`` chunks elapse.
+
+        ``on_metrics(frames, windowed_return, device_metrics)`` fires after
+        every chunk.  Returns ``(state, carry, summary)`` with summary keys
+        ``windowed_return`` / ``frames`` / ``hit``.
+        """
+        frames_per_call = self.unroll_length * self.venv.num_envs * self.iters_per_call
+        prev_sum = float(carry.return_sum)
+        prev_cnt = float(carry.episode_count)
+        windowed = float("nan")
+        frames = 0
+        hit = False
+        for _ in range(max_calls):
+            key, sub = jax.random.split(key)
+            state, carry, m = self.train_chunk(state, carry, sub)
+            frames += frames_per_call
+            s, c = float(carry.return_sum), float(carry.episode_count)
+            if c > prev_cnt:
+                windowed = (s - prev_sum) / (c - prev_cnt)
+                prev_sum, prev_cnt = s, c
+            if on_metrics is not None:
+                on_metrics(frames, windowed, {k: float(v) for k, v in m.items()})
+            if windowed >= threshold:
+                hit = True
+                break
+        summary = {"windowed_return": windowed, "frames": float(frames), "hit": hit}
+        return state, carry, summary
+
+    # ------------------------------------------------------------------
     def run(
         self,
         state: ImpalaTrainState,
@@ -155,7 +206,7 @@ class DeviceActorLearnerLoop:
         metrics: Dict[str, float] = {}
         for i in range(num_calls):
             key, sub = jax.random.split(key)
-            state, carry, dev_metrics = self._train_many(state, carry, sub)
+            state, carry, dev_metrics = self.train_chunk(state, carry, sub)
             if on_metrics is not None:
                 metrics = {k: float(v) for k, v in dev_metrics.items()}
                 metrics["episodes"] = float(carry.episode_count)
