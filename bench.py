@@ -30,6 +30,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 BASELINE_FPS_PER_CHIP = 100_000 / 16  # v5e-16 north star, per chip
 
+PROBE_TIMEOUT_S = 90
 TPU_ATTEMPT_TIMEOUT_S = 420
 CPU_ATTEMPT_TIMEOUT_S = 420
 
@@ -111,6 +112,26 @@ def _run_measurement() -> None:
     )
 
 
+def _probe_backend(timeout_s: float):
+    """Cheap liveness check of the default backend in a subprocess.
+
+    Returns ``(backend_name, None)`` or ``(None, err)``.  Round-1/2 failure
+    mode: the axon TPU tunnel hangs ``jax.devices()`` indefinitely — without
+    this probe each full attempt burns its whole ``TPU_ATTEMPT_TIMEOUT_S``
+    before the CPU fallback runs, flirting with the driver's overall budget.
+    """
+    cmd = [sys.executable, str(Path(__file__).resolve()), "--probe"]
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"probe timeout after {timeout_s:.0f}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("backend:"):
+            return line.split(":", 1)[1].strip(), None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-2:]
+    return None, f"probe rc={proc.returncode}: " + " | ".join(tail)[-200:]
+
+
 def _attempt(cpu: bool, timeout_s: float):
     """Run the measurement in a subprocess; return (json_line | None, err)."""
     env = dict(os.environ)
@@ -141,17 +162,32 @@ def _attempt(cpu: bool, timeout_s: float):
 
 def main() -> None:
     errors = []
-    # TPU/default-backend attempts: two tries (round-1's failure was a
-    # transient UNAVAILABLE from the tunnel), but don't retry a hang —
-    # a second hang would burn the driver's whole budget.
-    for i in range(2):
-        line, err = _attempt(cpu=False, timeout_s=TPU_ATTEMPT_TIMEOUT_S)
+    backend, probe_err = _probe_backend(PROBE_TIMEOUT_S)
+    if backend == "cpu":
+        # healthy CPU-only host: the default backend IS cpu — measure it and
+        # report clean (no "error" field; nothing failed)
+        line, err = _attempt(cpu=True, timeout_s=CPU_ATTEMPT_TIMEOUT_S)
         if line is not None:
             print(line)
             return
-        errors.append(f"attempt{i + 1}: {err}")
-        if "timeout" in err:
-            break
+        errors.append(f"cpu-default: {err}")
+    elif backend is None and "probe timeout" in (probe_err or ""):
+        # a hung tunnel: skip the full attempts — they would hang just the
+        # same and burn TPU_ATTEMPT_TIMEOUT_S each before the CPU fallback
+        errors.append(probe_err)
+    else:
+        # healthy accelerator, or a fast probe failure (e.g. transient
+        # UNAVAILABLE, the round-1 mode): full attempts with one retry
+        if probe_err:
+            errors.append(probe_err)
+        for i in range(2):
+            line, err = _attempt(cpu=False, timeout_s=TPU_ATTEMPT_TIMEOUT_S)
+            if line is not None:
+                print(line)
+                return
+            errors.append(f"attempt{i + 1}: {err}")
+            if "timeout" in err:
+                break
     # CPU fallback: still a real number, annotated with the TPU error.
     line, err = _attempt(cpu=True, timeout_s=CPU_ATTEMPT_TIMEOUT_S)
     if line is not None:
@@ -174,7 +210,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if "--run" in sys.argv[1:]:
+    if "--probe" in sys.argv[1:]:
+        import jax
+
+        print("backend:", jax.default_backend(), flush=True)
+    elif "--run" in sys.argv[1:]:
         if "--cpu" in sys.argv[1:]:
             import jax
 

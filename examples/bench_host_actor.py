@@ -1,0 +1,213 @@
+"""Host actor-plane throughput: env-frames/sec of ``HostActorLearnerTrainer``.
+
+The SEED-style host path — CPU vector envs, central batched inference on the
+device, free/full rollout slots, V-trace learner — is what real Gym/Atari
+training uses, so its frames/sec is measured here end to end (actors + learner
+together, not env stepping alone — ``examples/bench_env_throughput.py`` covers
+that).  Parity: the reference measured env stacks in
+``examples/test_env_throughput.py:16-606`` but never its own IMPALA trainer;
+its self-reported SPS (``impala_atari.py:470-471``) was never recorded.
+
+Two configs:
+
+  cartpole   [4]-float obs, MLP torso — control-dominated, measures pipeline
+             overhead (queue, inference dispatch, learner)
+  pixels     [84,84,4]-uint8 obs, AtariNet conv torso — bandwidth/compute
+             shaped like real Atari (frames pre-rendered per cell so env
+             stepping is an array lookup, not the bottleneck)
+
+Prints one JSON line per config.
+
+Usage: python examples/bench_host_actor.py [cartpole pixels] [--frames 40000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    # pin before any backend init: under the axon tunnel JAX_PLATFORMS is
+    # ignored; the config knob is what actually pins (and a wedged tunnel
+    # hangs jax.devices() indefinitely)
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+class PixelRingEnv:
+    """Gym-API synthetic pixel env (numpy twin of ``SyntheticPixelEnv``):
+    pre-rendered [84,84,4] uint8 frames per ring cell, so ``step`` costs an
+    index lookup and the measurement isolates the training pipeline."""
+
+    metadata: dict = {}
+    render_mode = None
+    spec = None
+
+    def __init__(self, size: int = 84, stack: int = 4, num_actions: int = 6,
+                 num_states: int = 16, episode_length: int = 128) -> None:
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(0, 255, (size, size, stack), np.uint8)
+        self.action_space = gym.spaces.Discrete(num_actions)
+        self.num_states = num_states
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        # pre-render through the real jax env's renderer so the two stay in
+        # lockstep (this class only re-implements the *dynamics* in numpy)
+        import jax.numpy as jnp
+
+        from scalerl_tpu.envs import SyntheticPixelEnv
+
+        ref = SyntheticPixelEnv(
+            size=size, stack=stack, num_actions=num_actions,
+            num_states=num_states, episode_length=episode_length,
+        )
+        self._frames = np.stack(
+            [np.asarray(ref._render(jnp.asarray(c))) for c in range(num_states)]
+        )
+        self._rng = np.random.default_rng(0)
+        self._cell = 0
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cell = int(self._rng.integers(self.num_states))
+        self._t = 0
+        return self._frames[self._cell], {}
+
+    def step(self, action):
+        correct = int(action) == (self._cell % self.num_actions)
+        reward = float(correct)
+        if correct:
+            self._cell = (self._cell + 1) % self.num_states
+        else:
+            self._cell = int(self._rng.integers(self.num_states))
+        self._t += 1
+        done = self._t >= self.episode_length
+        if done:
+            self._cell = int(self._rng.integers(self.num_states))
+            self._t = 0
+        return self._frames[self._cell], reward, done, False, {}
+
+    def close(self):
+        pass
+
+
+def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int) -> dict:
+    import gymnasium as gym
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    pixels = kind == "pixels"
+    args = ImpalaArguments(
+        env_id="PixelRing" if pixels else "CartPole-v1",
+        rollout_length=20 if pixels else 16,
+        batch_size=2 * envs_per_actor,
+        num_actors=num_actors,
+        num_buffers=max(4 * envs_per_actor, 2 * num_actors + 2, 32),
+        use_lstm=False,
+        hidden_size=512 if pixels else 64,
+        logger_backend="none",
+        logger_frequency=10**9,
+        save_model=False,
+        max_timesteps=frames,
+    )
+    if pixels:
+        env_fns = [
+            (
+                lambda: gym.vector.SyncVectorEnv(
+                    [PixelRingEnv for _ in range(envs_per_actor)]
+                )
+            )
+            for _ in range(num_actors)
+        ]
+        obs_shape, num_actions = (84, 84, 4), 6
+        obs_dtype = np.uint8
+    else:
+        env_fns = [
+            (
+                lambda i=i: make_vect_envs(
+                    "CartPole-v1", num_envs=envs_per_actor, seed=i, async_envs=False
+                )
+            )
+            for i in range(num_actors)
+        ]
+        obs_shape, num_actions = (4,), 2
+        obs_dtype = np.float32
+    agent = ImpalaAgent(args, obs_shape=obs_shape, num_actions=num_actions, obs_dtype=obs_dtype)
+
+    # Warm the jitted act/learn paths before the timed window: the first
+    # learn call compiles for tens of seconds on CPU, during which actors
+    # free-run and the measured fps reflects the compile window, not the
+    # steady-state pipeline (observed: learn_steps == 1 for a whole budget).
+    import jax.numpy as jnp
+
+    from scalerl_tpu.data.trajectory import Trajectory
+
+    T, Bl, Ba = args.rollout_length, args.batch_size, envs_per_actor
+    warm = Trajectory(
+        obs=jnp.zeros((T + 1, Bl) + obs_shape, obs_dtype),
+        action=jnp.zeros((T + 1, Bl), jnp.int32),
+        reward=jnp.zeros((T + 1, Bl), jnp.float32),
+        done=jnp.zeros((T + 1, Bl), bool),
+        logits=jnp.zeros((T + 1, Bl, num_actions), jnp.float32),
+        core_state=agent.initial_state(Bl),
+    )
+    agent.learn(warm)
+    agent.act(
+        np.zeros((Ba,) + obs_shape, obs_dtype),
+        np.zeros(Ba, np.int32),
+        np.zeros(Ba, np.float32),
+        np.ones(Ba, bool),
+        agent.initial_state(Ba),
+    )
+
+    trainer = HostActorLearnerTrainer(args, agent, env_fns)
+    warm_steps = int(agent.state.step)
+    t0 = time.time()
+    result = trainer.train(total_frames=frames)
+    wall = time.time() - t0
+    trainer.close()
+    return {
+        "metric": f"host_actor_plane_fps_{kind}",
+        "value": round(result["sps"], 1),
+        "unit": "env-frames/sec (actors+learner, end to end)",
+        "frames": int(result["env_frames"]),
+        "wall_s": round(wall, 1),
+        "learn_steps": int(agent.state.step) - warm_steps,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("kinds", nargs="*", default=["cartpole", "pixels"])
+    ap.add_argument("--num-actors", type=int, default=2)
+    ap.add_argument("--envs-per-actor", type=int, default=8)
+    ap.add_argument("--frames", type=int, default=40_000)
+    ap.add_argument("--pixel-frames", type=int, default=0,
+                    help="frame budget for the pixels config (default frames/4)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin the CPU backend (handled at import; kept for --help)")
+    args = ap.parse_args()
+    for kind in args.kinds or ["cartpole", "pixels"]:
+        frames = args.frames if kind == "cartpole" else (
+            args.pixel_frames or args.frames // 4
+        )
+        print(json.dumps(bench_host(kind, args.num_actors, args.envs_per_actor, frames)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

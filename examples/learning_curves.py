@@ -384,7 +384,8 @@ def _write_markdown(results) -> None:
         "# Learning curves",
         "",
         "Recorded to-threshold training runs (VERDICT r1 #3). Curves: TensorBoard",
-        "event files under `work_dirs/learning_curves/<experiment>/`; summary JSON in",
+        "event files under `work_dirs/learning_curves/` — `impala_synthetic/` directly,",
+        "trainer-based runs at `CartPole-v1/<algo>/<experiment>/tb_log/`; summary JSON in",
         "`work_dirs/learning_curves/summary.json`. All runs CPU-only (the TPU-tunnel",
         "backend was unreachable; the identical code paths serve the TPU) via",
         "`python examples/learning_curves.py`.",
