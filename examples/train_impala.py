@@ -5,9 +5,11 @@ the reference, SURVEY.md §2.4 — this one runs).  Two backends:
 
 - ``--env-backend jax``  : fused on-device actor-learner loop (flagship
   throughput path; CartPole-v1 or SyntheticPixel-v0).
-- ``--env-backend gym``  : host actor threads + central batched device
-  inference (SEED-RL topology; any gymnasium env id, Atari if ale_py
-  is installed).
+- ``--env-backend gym``  : host actors + device learner.  ``--actor-mode
+  threads`` (default) runs SEED-RL topology (central batched inference);
+  ``--actor-mode process`` runs monobeast topology (spawned actor processes
+  with local CPU inference over the C++ shm ring — the reference's
+  ``impala_atari.py`` architecture, GIL-free across host cores).
 
 Usage::
 
@@ -87,7 +89,14 @@ def main() -> None:
             # here (unlike the fused jax backend), so this is the path that
             # exercises dp/fsdp/tp sharding with real envs
             agent.enable_mesh(args.mesh_shape)
-        trainer = HostActorLearnerTrainer(args, agent, env_fns)
+        if args.actor_mode == "process":
+            from scalerl_tpu.trainer.process_actor_learner import (
+                ProcessActorLearnerTrainer,
+            )
+
+            trainer = ProcessActorLearnerTrainer(args, agent)
+        else:
+            trainer = HostActorLearnerTrainer(args, agent, env_fns)
 
     try:
         result = trainer.train(total_frames=args.total_steps)

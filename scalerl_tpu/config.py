@@ -195,6 +195,11 @@ class ImpalaArguments(RLArguments):
     # Rollout pipeline
     rollout_length: int = 80
     num_actors: int = 8
+    # host actor topology: "threads" = SEED-style central inference
+    # (HostActorLearnerTrainer); "process" = monobeast-style actor processes
+    # with local CPU inference over the shm ring (the reference's topology,
+    # impala_atari.py:153-220)
+    actor_mode: str = "threads"
     num_buffers: int = 32  # free/full queue depth (impala_atari.py:72)
     num_learner_threads: int = 1
     batch_size: int = 8

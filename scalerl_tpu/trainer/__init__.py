@@ -3,3 +3,6 @@ from scalerl_tpu.trainer.off_policy import OffPolicyTrainer  # noqa: F401
 from scalerl_tpu.trainer.on_policy import OnPolicyTrainer  # noqa: F401
 from scalerl_tpu.trainer.apex import ApexTrainer  # noqa: F401
 from scalerl_tpu.trainer.parallel_dqn import ParallelDQNTrainer  # noqa: F401
+from scalerl_tpu.trainer.process_actor_learner import (  # noqa: F401
+    ProcessActorLearnerTrainer,
+)

@@ -40,6 +40,60 @@ from scalerl_tpu.utils.metrics import EpisodeMetrics
 from scalerl_tpu.utils.timers import Timings
 
 
+def fill_rollout_slot(
+    slot,
+    agent,
+    envs,
+    obs,
+    last_action,
+    reward,
+    done,
+    core_state,
+    unroll_length: int,
+    on_step=None,
+    timings: Optional[Timings] = None,
+):
+    """Write one ``[T+1, B]`` trajectory slot — the protocol shared by the
+    thread (SEED) and process (monobeast) actor planes.
+
+    Row convention matches ``data/trajectory.py``: each row holds the model
+    *inputs* at that step; row T is model-input-only — the learner reads
+    ``logits[:-1]`` and the boundary obs is consumed by the next chunk's
+    row 0, so running inference there would advance the LSTM core over
+    ``obs_T`` twice (slots are recycled, so its stale logits row is cleared).
+
+    Returns the carried ``(obs, last_action, reward, done, core_state)``.
+    ``on_step(reward, done)`` fires after every env step (episode metrics);
+    ``timings`` (optional) records the ``model``/``step`` phase split.
+    """
+    for i, (c, h) in enumerate(core_state):
+        slot[f"core_{i}_c"][:] = np.asarray(c)
+        slot[f"core_{i}_h"][:] = np.asarray(h)
+    for t in range(unroll_length + 1):
+        slot["obs"][t] = obs
+        slot["action"][t] = last_action
+        slot["reward"][t] = reward
+        slot["done"][t] = done
+        if t == unroll_length:
+            slot["logits"][t] = 0.0
+            break
+        action, logits, core_state = agent.act(
+            obs, last_action, reward, done, core_state
+        )
+        slot["logits"][t] = np.asarray(logits)
+        if timings is not None:
+            timings.time("model")
+        obs, reward, term, trunc, _ = envs.step(np.asarray(action))
+        done = np.logical_or(term, trunc)
+        reward = np.asarray(reward, np.float32)
+        last_action = np.asarray(action, np.int32)
+        if on_step is not None:
+            on_step(reward, done)
+        if timings is not None:
+            timings.time("step")
+    return obs, last_action, reward, done, core_state
+
+
 class _ActorThread(threading.Thread):
     """One actor: owns a vector-env slab, fills trajectory slots."""
 
@@ -67,41 +121,25 @@ class _ActorThread(threading.Thread):
             reward = np.zeros(B, np.float32)
             done = np.ones(B, bool)
             core_state = agent.initial_state(B)
+            metrics = tr.episode_metrics[self.actor_id]
             while not tr.stop_event.is_set():
                 idx = q.acquire(timeout=1.0)
                 if idx is None:
                     continue
-                slot = q.slots[idx]
-                # snapshot the recurrent state entering row 0
-                for i, (c, h) in enumerate(core_state):
-                    slot[f"core_{i}_c"][:] = np.asarray(c)
-                    slot[f"core_{i}_h"][:] = np.asarray(h)
                 self.timings.reset()
-                for t in range(T + 1):
-                    slot["obs"][t] = obs
-                    slot["action"][t] = last_action
-                    slot["reward"][t] = reward
-                    slot["done"][t] = done
-                    if t == T:
-                        # row T is model-input-only: the learner reads
-                        # logits[:-1], and the boundary obs is consumed by the
-                        # next chunk's row 0 — running inference here would
-                        # advance the LSTM core over obs_T twice (slots are
-                        # recycled, so clear the stale logits row).
-                        slot["logits"][t] = 0.0
-                        break
-                    # central batched inference on device
-                    action, logits, core_state = agent.act(
-                        obs, last_action, reward, done, core_state
-                    )
-                    slot["logits"][t] = np.asarray(logits)
-                    self.timings.time("model")
-                    obs, reward, term, trunc, _ = self.envs.step(np.asarray(action))
-                    done = np.logical_or(term, trunc)
-                    reward = np.asarray(reward, np.float32)
-                    last_action = np.asarray(action, np.int32)
-                    tr.episode_metrics[self.actor_id].step(reward, done)
-                    self.timings.time("step")
+                obs, last_action, reward, done, core_state = fill_rollout_slot(
+                    q.slots[idx],
+                    agent,  # central batched inference on device
+                    self.envs,
+                    obs,
+                    last_action,
+                    reward,
+                    done,
+                    core_state,
+                    T,
+                    on_step=metrics.step,
+                    timings=self.timings,
+                )
                 q.commit(idx)
                 self.timings.time("write")
                 with tr.frame_lock:

@@ -1,0 +1,427 @@
+"""Process-actor IMPALA: monobeast-topology actors over the C++ shm ring.
+
+The reference's IMPALA runs each actor as a *process* with its own CPU model
+copy (``scalerl/algorithms/impala/impala_atari.py:153-220,420-434``) — the
+torchbeast/monobeast topology, where V-trace exists precisely to correct the
+actor-side policy lag.  The thread-based ``HostActorLearnerTrainer``
+(SEED-style central inference) covers the other topology; this trainer covers
+the reference's, with two upgrades the reference lacked:
+
+- rollout hand-off is the lock-free C++ shared-memory slot ring
+  (``runtime/shm_ring.py`` / ``csrc/shm_ring.cpp``), not pickled
+  ``SimpleQueue`` tensors — actors write trajectory slots through zero-copy
+  numpy views;
+- actors are **spawned**, not forked (fork-after-JAX deadlocks in XLA's
+  thread pools), and each pins its own single-process CPU JAX backend for
+  local inference, so actors scale GIL-free across host cores while the
+  learner keeps the accelerator.
+
+Weight sync mirrors the reference's ``actor_model.load_state_dict`` pub
+(``impala_atari.py:348``) as a versioned pull over a pipe: actors request
+``{"kind": "params", "have": v}`` between chunks and the learner's weight
+service replies with the newest numpy pytree (or ``None`` if current).
+Failure handling: actor exceptions funnel back as ``{"kind": "error"}``
+messages and re-raise in the learner; teardown closes the ring (the shared
+stop flag), then joins with timeouts (``impala_atari.py:473-494`` ladder).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scalerl_tpu.config import ImpalaArguments
+from scalerl_tpu.fleet.transport import PipeConnection, send_recv, wait_readable
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class _ProcActorConfig:
+    actor_id: int
+    args: ImpalaArguments
+    obs_shape: Tuple[int, ...]
+    num_actions: int
+    obs_dtype_name: str
+    envs_per_actor: int
+    seed: int
+    atari: bool = False
+
+
+def _proc_actor_main(conn: PipeConnection, cfg: _ProcActorConfig, ring: ShmRolloutRing) -> None:
+    """Actor process: vector env + local CPU policy + shm slot writes."""
+    import os
+
+    # Pin a single-device CPU backend before any JAX device use: this is a
+    # fresh spawned interpreter, but under the axon tunnel JAX_PLATFORMS is
+    # ignored, so the config knob is the reliable pin (tests/conftest.py).
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized (embedded test caller): keep it
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer.actor_learner import fill_rollout_slot
+
+    try:
+        obs_dtype = np.dtype(cfg.obs_dtype_name)
+        agent = ImpalaAgent(
+            cfg.args,
+            obs_shape=cfg.obs_shape,
+            num_actions=cfg.num_actions,
+            obs_dtype=obs_dtype,
+            key=jax.random.PRNGKey(cfg.seed),
+        )
+        # the project factory, not raw gym.make: same DeepMind Atari wrapper
+        # stack and SAME_STEP autoreset semantics as the thread actor plane —
+        # the learner must see identical trajectory boundary conventions
+        # whichever --actor-mode produced the slots
+        envs = make_vect_envs(
+            cfg.args.env_id,
+            num_envs=cfg.envs_per_actor,
+            seed=cfg.seed,
+            async_envs=False,  # one env pool per actor process already
+            atari=cfg.atari,
+        )
+        B = cfg.envs_per_actor
+        T = cfg.args.rollout_length
+        obs, _ = envs.reset(seed=cfg.seed)
+        last_action = np.zeros(B, np.int32)
+        reward = np.zeros(B, np.float32)
+        done = np.ones(B, bool)
+        core_state = agent.initial_state(B)
+        version = -1
+        ep_ret = np.zeros(B, np.float64)
+        returns: List[float] = []
+
+        def on_step(rew: np.ndarray, dn: np.ndarray) -> None:
+            nonlocal ep_ret
+            ep_ret += rew
+            for b in np.nonzero(dn)[0]:
+                returns.append(float(ep_ret[b]))
+                ep_ret[b] = 0.0
+
+        while not ring.closed:
+            # pull newest weights (None reply = already current)
+            try:
+                reply = send_recv(conn, {"kind": "params", "have": version})
+            except (EOFError, OSError, ConnectionError):
+                break
+            if reply is not None:
+                version = int(reply["version"])
+                agent.set_weights(reply["weights"])
+            idx = ring.acquire(timeout=1.0)
+            if idx is None:
+                continue
+            slot = ring.slot(idx)
+            returns.clear()
+            obs, last_action, reward, done, core_state = fill_rollout_slot(
+                slot, agent, envs, obs, last_action, reward, done,
+                core_state, T, on_step=on_step,
+            )
+            slot["meta"][0] = cfg.actor_id
+            slot["meta"][1] = version
+            ring.commit(idx)
+            slot = None  # release shm views now: a live view at loop exit
+            # keeps the mapping exported and detach() cannot close it
+            if returns:
+                try:
+                    conn.send({"kind": "stats", "actor_id": cfg.actor_id,
+                               "returns": list(returns)})
+                except (BrokenPipeError, OSError):
+                    break
+        envs.close()
+    except (KeyboardInterrupt, EOFError, OSError, ConnectionError):
+        pass
+    except Exception:  # noqa: BLE001 - funneled to the learner
+        import traceback
+
+        try:
+            conn.send({"kind": "error", "actor_id": cfg.actor_id,
+                       "traceback": traceback.format_exc()})
+        except Exception:
+            pass
+    finally:
+        ring.detach()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+class ProcessActorLearnerTrainer(BaseTrainer):
+    """IMPALA with GIL-free actor processes (reference topology, shm ring)."""
+
+    def __init__(
+        self,
+        args: ImpalaArguments,
+        agent,
+        envs_per_actor: Optional[int] = None,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        # args.num_envs is the TOTAL env-lane count (CLI semantics shared
+        # with the thread backend); each actor process drives its share
+        self.envs_per_actor = envs_per_actor or max(
+            args.num_envs // args.num_actors, 1
+        )
+        self.param_server = ParameterServer()
+        self.returns: List[float] = []
+        self.env_frames = 0
+        self._stop = threading.Event()
+        self._actor_error: List[str] = []
+        self.procs: List[mp.process.BaseProcess] = []
+        self.conns: List[PipeConnection] = []
+
+        T1 = args.rollout_length + 1
+        B = self.envs_per_actor
+        core = agent.initial_state(B)
+        fields: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {
+            "obs": ((T1, B) + tuple(agent.obs_shape), np.dtype(self._obs_dtype_name())),
+            "action": ((T1, B), np.dtype(np.int32)),
+            "reward": ((T1, B), np.dtype(np.float32)),
+            "done": ((T1, B), np.dtype(bool)),
+            "logits": ((T1, B, agent.num_actions), np.dtype(np.float32)),
+            "meta": ((2,), np.dtype(np.float64)),
+        }
+        for i, (c, h) in enumerate(core):
+            fields[f"core_{i}_c"] = (tuple(c.shape), np.dtype(np.float32))
+            fields[f"core_{i}_h"] = (tuple(h.shape), np.dtype(np.float32))
+        self._core_leaves = len(core)
+        self.ring = ShmRolloutRing(SlotSpec(fields), num_slots=args.num_buffers)
+        self._weight_thread = threading.Thread(
+            target=self._weight_service, daemon=True
+        )
+
+    def _obs_dtype_name(self) -> str:
+        return "uint8" if len(self.agent.obs_shape) == 3 else "float32"
+
+    # -- weight / stats / error service --------------------------------
+    def _weight_service(self) -> None:
+        while not self._stop.is_set():
+            if not self.conns:
+                self._stop.wait(0.05)
+                continue
+            ready, dead = wait_readable(self.conns, timeout=0.1)
+            for conn in dead:
+                self.conns.remove(conn)
+            for conn in ready:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError, ConnectionError, ValueError):
+                    if conn in self.conns:
+                        self.conns.remove(conn)
+                    continue
+                if msg is None:
+                    continue
+                if msg["kind"] == "params":
+                    weights, version = self.param_server.pull(int(msg["have"]))
+                    try:
+                        conn.send(
+                            None
+                            if weights is None
+                            else {"version": version, "weights": weights}
+                        )
+                    except (BrokenPipeError, OSError):
+                        continue
+                elif msg["kind"] == "stats":
+                    self.returns.extend(float(r) for r in msg["returns"])
+                elif msg["kind"] == "error":
+                    self._actor_error.append(
+                        f"actor {msg['actor_id']}:\n{msg['traceback']}"
+                    )
+
+    def start_actors(self) -> None:
+        # spawn, not fork: the learner has JAX initialized (ADVICE r1 /
+        # envs/vector/async_vec.py hazard note)
+        ctx = mp.get_context("spawn")
+        env_id = self.args.env_id
+        atari = env_id.startswith("ALE/") or "NoFrameskip" in env_id
+        for i in range(self.args.num_actors):
+            parent, child = ctx.Pipe(duplex=True)
+            cfg = _ProcActorConfig(
+                actor_id=i,
+                args=self.args,
+                obs_shape=tuple(self.agent.obs_shape),
+                num_actions=self.agent.num_actions,
+                obs_dtype_name=self._obs_dtype_name(),
+                envs_per_actor=self.envs_per_actor,
+                seed=self.args.seed + 7919 * i,
+                atari=atari,
+            )
+            proc = ctx.Process(
+                target=_proc_actor_main,
+                args=(PipeConnection(child), cfg, self.ring),
+                daemon=True,
+            )
+            proc.start()
+            child.close()
+            self.procs.append(proc)
+            self.conns.append(PipeConnection(parent))
+        self._weight_thread.start()
+
+    # -- resume (parity with HostActorLearnerTrainer) ------------------
+    def _resume_pytree(self) -> Dict:
+        return {
+            "agent": self.agent.state,
+            "env_frames": np.asarray(self.env_frames, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.env_frames, int(self.agent.state.step)
+        )
+
+    def try_resume(self) -> bool:
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.env_frames = int(state["env_frames"])
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: frames {self.env_frames}"
+            )
+        return True
+
+    # -- learner -------------------------------------------------------
+    def _pop_batch(self, n_slots: int) -> Optional[List[int]]:
+        idxs: List[int] = []
+        while len(idxs) < n_slots:
+            if self._actor_error:
+                for i in idxs:
+                    self.ring.release(i)
+                raise RuntimeError(
+                    "actor process failed:\n" + "\n".join(self._actor_error)
+                )
+            idx = self.ring.pop_full(timeout=1.0)
+            if idx is None:
+                if self.ring.closed or self._stop.is_set():
+                    for i in idxs:
+                        self.ring.release(i)
+                    return None
+                continue
+            idxs.append(idx)
+        return idxs
+
+    def _batch_to_host(self, idxs: List[int]) -> Dict[str, np.ndarray]:
+        views = [self.ring.slot(i) for i in idxs]
+        batch: Dict[str, np.ndarray] = {}
+        for name in views[0]:
+            if name == "meta":
+                continue
+            axis = 0 if name.startswith("core_") else 1
+            batch[name] = np.concatenate([v[name] for v in views], axis=axis)
+        self._lag = float(
+            np.mean([self.param_server.version - v["meta"][1] for v in views])
+        )
+        return batch
+
+    def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
+        from scalerl_tpu.data.trajectory import batch_to_trajectory
+
+        args = self.args
+        total_frames = total_frames or args.total_steps
+        frames_per_slot = args.rollout_length * self.envs_per_actor
+        n_slots = max(args.batch_size // self.envs_per_actor, 1)
+        if self.resuming:
+            self.try_resume()
+        self.param_server.push(self.agent.get_weights())
+        if not self.procs:
+            self.start_actors()
+        start = time.time()
+        start_frames = self.env_frames  # nonzero after resume
+        last_log = start_frames
+        last_save = start_frames
+        metrics: Dict[str, float] = {}
+        self._lag = float("nan")
+        try:
+            while self.env_frames < total_frames:
+                idxs = self._pop_batch(n_slots)
+                if idxs is None:
+                    break
+                batch = self._batch_to_host(idxs)  # copies out of the slots
+                for i in idxs:
+                    self.ring.release(i)
+                traj = batch_to_trajectory(batch)
+                metrics = self.agent.learn(traj)
+                self.param_server.push(self.agent.get_weights())
+                self.env_frames += n_slots * frames_per_slot
+
+                if (
+                    args.save_model
+                    and not args.disable_checkpoint
+                    and self.env_frames - last_save >= args.save_frequency
+                ):
+                    last_save = self.env_frames
+                    self.save_resume()
+
+                if self.env_frames - last_log >= args.logger_frequency:
+                    last_log = self.env_frames
+                    sps = (self.env_frames - start_frames) / max(
+                        time.time() - start, 1e-8
+                    )
+                    ret = (
+                        float(np.mean(self.returns[-50:]))
+                        if self.returns
+                        else float("nan")
+                    )
+                    info = {**metrics, "sps": sps, "return_mean": ret,
+                            "weights_lag": self._lag}
+                    self.logger.log_train_data(info, self.env_frames)
+                    if self.is_main_process:
+                        self.text_logger.info(
+                            f"frames {self.env_frames} | sps {sps:.0f} | "
+                            f"return {ret:.1f} | lag {self._lag:.1f}"
+                        )
+        finally:
+            self.stop()
+        if args.save_model and not args.disable_checkpoint:
+            self.save_resume()
+        sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
+        return {
+            **metrics,
+            "env_frames": float(self.env_frames),
+            "sps": float(sps),
+            "return_mean": float(np.mean(self.returns[-100:]))
+            if self.returns
+            else float("nan"),
+            "episodes": float(len(self.returns)),
+        }
+
+    def stop(self) -> None:
+        self.ring.close()
+        self._stop.set()
+        if self._weight_thread.is_alive():
+            self._weight_thread.join(timeout=2.0)
+        # close parent pipe ends BEFORE joining: an actor that entered
+        # send_recv just as the weight service exited is blocked in recv();
+        # EOF unblocks it, otherwise every such actor burns the join timeout
+        # and gets terminate()d mid-teardown
+        for c in self.conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self.conns.clear()
+        for p in self.procs:
+            p.join(timeout=5.0)
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+        self.ring.unlink()
