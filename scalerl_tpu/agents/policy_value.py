@@ -147,11 +147,21 @@ class PolicyValueAgent(BaseAgent):
         self._learn = plearn
         self._shard_batch = plearn.shard_batch
 
-    def learn(self, traj) -> Dict[str, float]:
+    def learn_device(self, traj) -> Dict[str, Any]:
+        """One train step, metrics left as device arrays.
+
+        ``float()``-ing a metric blocks until the step finishes on device;
+        hot learner loops (``trainer/actor_learner.py``) call this and
+        materialize metrics only at logging intervals, so consecutive learn
+        dispatches queue up without a host sync in between.
+        """
         if self._shard_batch is not None:
             traj = self._shard_batch(traj)
         self.state, metrics = self._learn(self.state, traj)
-        return {k: float(v) for k, v in metrics.items()}
+        return metrics
+
+    def learn(self, traj) -> Dict[str, float]:
+        return {k: float(v) for k, v in self.learn_device(traj).items()}
 
     def get_weights(self):
         return self.state.params

@@ -23,6 +23,7 @@ class ParameterServer:
         self._lock = threading.Lock()
         self._version = 0
         self._weights: Any = None
+        self._is_host = True
 
     @property
     def version(self) -> int:
@@ -33,19 +34,31 @@ class ParameterServer:
         """Publish new weights; returns the new version.
 
         With ``to_host=True`` the pytree is fetched to numpy once here, so N
-        actor pulls cost zero device traffic (SEED-style actors that run
-        device inference should push with ``to_host=False``).
+        actor pulls cost zero device traffic.  SEED-style learners whose
+        actors run device inference should push with ``to_host=False``: the
+        per-step publish is then a version bump holding live device arrays,
+        and the numpy snapshot is materialized lazily — once, cached per
+        version — only if some off-host consumer actually pulls.
         """
         if to_host:
             weights = jax.tree_util.tree_map(np.asarray, weights)
         with self._lock:
             self._version += 1
             self._weights = weights
+            self._is_host = to_host
             return self._version
 
     def pull(self, have_version: int = -1) -> Tuple[Optional[Any], int]:
-        """Return (weights, version), or (None, version) if caller is current."""
+        """Return (numpy weights, version), or (None, version) if current.
+
+        Pullers always receive host (numpy) pytrees regardless of how the
+        weights were pushed — a ``to_host=False`` publish is materialized
+        here on first pull and the conversion is cached for the version.
+        """
         with self._lock:
             if self._weights is None or have_version == self._version:
                 return None, self._version
+            if not self._is_host:
+                self._weights = jax.tree_util.tree_map(np.asarray, self._weights)
+                self._is_host = True
             return self._weights, self._version

@@ -23,6 +23,7 @@ with timeouts (reference ladders: ``impala_atari.py:473-494``).
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from typing import Dict, Optional
@@ -229,20 +230,83 @@ class HostActorLearnerTrainer(BaseTrainer):
         start_frames = self.env_frames  # nonzero after resume
         last_log_frames = start_frames
         last_save_frames = start_frames
-        metrics: Dict[str, float] = {}
-        try:
-            while self.env_frames < total_frames and not self.stop_event.is_set():
-                self.learn_timings.reset()
-                batch, idxs = self.queue.get_batch(
-                    max(args.batch_size // self.envs_per_actor, 1)
+        n_slots = max(args.batch_size // self.envs_per_actor, 1)
+        metrics: Dict = {}
+
+        # Optional assembly prefetch (wires the reference's num_learners
+        # knob, ``impala_atari.py:439-456``): num_learner_threads - 1
+        # assembly threads drain slots and build trajectories while the
+        # device runs the previous learn step, so the TPU never waits on
+        # host batch stitching (the learn step itself stays one thread —
+        # it is a single jitted call and parallelizing it adds nothing)
+        prefetch_q: Optional[queue_mod.Queue] = None
+        assemble_threads: list = []
+        if args.num_learner_threads >= 2:
+            prefetch_q = queue_mod.Queue(maxsize=2)
+
+            def _put(item) -> bool:
+                # bounded put that gives up at shutdown: an unconditional
+                # put() would block forever when the main loop exits with
+                # the queue full, leaking the thread and a pinned batch
+                while True:
+                    try:
+                        prefetch_q.put(item, timeout=0.5)
+                        return True
+                    except queue_mod.Full:
+                        if self.stop_event.is_set():
+                            return False
+
+            def _assemble() -> None:
+                try:
+                    while not self.stop_event.is_set():
+                        batch, idxs = self.queue.get_batch(n_slots)
+                        traj = batch_to_trajectory(batch)
+                        self.queue.recycle(idxs)
+                        if not _put(traj):
+                            return
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    _put(e)
+
+            for i in range(args.num_learner_threads - 1):
+                t = threading.Thread(
+                    target=_assemble, name=f"learner-assemble-{i}", daemon=True
                 )
+                t.start()
+                assemble_threads.append(t)
+
+        def next_traj():
+            if prefetch_q is None:
+                self.learn_timings.reset()
+                batch, idxs = self.queue.get_batch(n_slots)
                 self.learn_timings.time("dequeue")
                 traj = batch_to_trajectory(batch)
                 self.queue.recycle(idxs)
                 self.learn_timings.time("device")
-                metrics = self.agent.learn(traj)
+                return traj
+            self.learn_timings.reset()
+            while True:
+                try:
+                    item = prefetch_q.get(timeout=0.5)
+                    break
+                except queue_mod.Empty:
+                    if self.stop_event.is_set():
+                        raise RuntimeError("rollout queue closed")
+            self.learn_timings.time("dequeue")
+            if isinstance(item, BaseException):
+                raise item
+            return item
+
+        try:
+            while self.env_frames < total_frames and not self.stop_event.is_set():
+                traj = next_traj()
+                # device metrics stay un-materialized: float() only at log
+                # time, so the loop dispatches the next step without a sync
+                metrics = self.agent.learn_device(traj)
                 self.learn_timings.time("learn")
-                self.param_server.push(self.agent.get_weights())
+                # version bump only — actors do central inference on the
+                # live device params; a to_host push would force a full
+                # device->host param fetch (a sync) every learn step
+                self.param_server.push(self.agent.get_weights(), to_host=False)
 
                 if (
                     args.save_model
@@ -263,16 +327,26 @@ class HostActorLearnerTrainer(BaseTrainer):
                         for r in m.episode_returns[-20:]
                     ]
                     ret_mean = float(np.mean(rets)) if rets else float("nan")
-                    info = {**metrics, "sps": sps, "return_mean": ret_mean}
+                    host_metrics = {k: float(v) for k, v in metrics.items()}
+                    info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
                     self.logger.log_train_data(info, self.env_frames)
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "
-                            f"return {ret_mean:.1f} | loss {metrics.get('total_loss', float('nan')):.3f}"
+                            f"return {ret_mean:.1f} | loss {host_metrics.get('total_loss', float('nan')):.3f}"
                         )
         finally:
             self.stop_event.set()
             self.queue.close()
+            for t in assemble_threads:
+                t.join(timeout=3.0)
+            if prefetch_q is not None:
+                # release device-resident trajectories still queued
+                while True:
+                    try:
+                        prefetch_q.get_nowait()
+                    except queue_mod.Empty:
+                        break
             for a in actors:
                 a.join(timeout=5.0)
             for a in actors:
@@ -285,7 +359,7 @@ class HostActorLearnerTrainer(BaseTrainer):
         sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
         rets = [r for m in self.episode_metrics for r in m.episode_returns]
         return {
-            **metrics,
+            **{k: float(v) for k, v in metrics.items()},
             "env_frames": float(self.env_frames),
             "sps": float(sps),
             "return_mean": float(np.mean(rets[-100:])) if rets else float("nan"),

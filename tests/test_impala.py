@@ -190,6 +190,43 @@ def test_host_actor_learner_trainer_smoke(tmp_path):
     assert trainer.param_server.version > 0
 
 
+def test_parameter_server_lazy_host_snapshot():
+    """A to_host=False publish (SEED hot loop) still hands pullers numpy:
+    materialization happens lazily on first pull and is cached."""
+    server = ParameterServer()
+    dev = {"w": jnp.ones((3,))}
+    v = server.push(dev, to_host=False)
+    weights, version = server.pull()
+    assert version == v
+    leaf = weights["w"]
+    assert isinstance(leaf, np.ndarray)
+    # cached: a second pull at an older version returns the same host array
+    w2, _ = server.pull(have_version=-1)
+    assert w2["w"] is leaf
+
+
+def test_host_actor_learner_prefetch_thread(tmp_path):
+    """num_learner_threads >= 2 runs the assembly-prefetch learner path
+    (reference num_learners capability, impala_atari.py:439-456)."""
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    args = _args(
+        rollout_length=8, batch_size=4, num_actors=2, num_buffers=8,
+        num_learner_threads=2, logger_frequency=256, work_dir=str(tmp_path),
+        hidden_size=32,
+    )
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    env_fns = [
+        (lambda i=i: make_vect_envs("CartPole-v1", num_envs=2, seed=i, async_envs=False))
+        for i in range(2)
+    ]
+    trainer = HostActorLearnerTrainer(args, agent, env_fns)
+    result = trainer.train(total_frames=512)
+    assert result["env_frames"] >= 512
+    assert np.isfinite(result["total_loss"])
+    assert int(agent.state.step) > 0
+
+
 def test_impala_bfloat16_compute_dtype():
     """bf16 torso trains: finite loss/grads, f32 params preserved."""
     import jax
