@@ -20,6 +20,7 @@ from typing import Callable, NamedTuple, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from scalerl_tpu.ops.pallas_attention import flash_attention
 from scalerl_tpu.ops.ring_attention import full_attention
 
 # (q, k, v) -> attention output, all [B, T, H, D]
@@ -64,6 +65,12 @@ class TransformerPolicy(nn.Module):
     pass a closed-over :func:`ring_attention` (inside ``shard_map``) for
     sequence-parallel execution.  NOTE: a custom ``attn_fn`` must apply its
     own causal masking — the default here is causal.
+
+    ``use_flash=True`` swaps in the Pallas flash kernel
+    (:func:`scalerl_tpu.ops.pallas_attention.flash_attention`): blockwise
+    online-softmax attention that never materializes ``[T, T]`` scores —
+    the right default on TPU once ``T`` is long (ignored when ``attn_fn``
+    is given).
     """
 
     num_actions: int
@@ -73,6 +80,7 @@ class TransformerPolicy(nn.Module):
     mlp_ratio: int = 4
     max_len: int = 4096
     attn_fn: Optional[AttentionFn] = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(
@@ -87,7 +95,8 @@ class TransformerPolicy(nn.Module):
             )
         attn = self.attn_fn
         if attn is None:
-            attn = lambda q, k, v: full_attention(q, k, v, causal=True)  # noqa: E731
+            base = flash_attention if self.use_flash else full_attention
+            attn = lambda q, k, v: base(q, k, v, causal=True)  # noqa: E731
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         x = nn.Dense(self.d_model, name="obs_embed")(

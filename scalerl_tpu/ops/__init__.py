@@ -9,6 +9,7 @@ from scalerl_tpu.ops.losses import (  # noqa: F401
     make_support,
     policy_gradient_loss,
 )
+from scalerl_tpu.ops.pallas_attention import flash_attention  # noqa: F401
 from scalerl_tpu.ops.ring_attention import (  # noqa: F401
     full_attention,
     make_ring_attention_fn,

@@ -1,0 +1,337 @@
+"""Pallas TPU flash attention (forward + flash-style backward).
+
+The hot op of the long-context path (``models/transformer.py`` /
+``parallel/sequence.py``).  No counterpart exists in the reference — it has
+no attention at all (SURVEY.md §5) — this kernel is part of the TPU build's
+beyond-parity long-context stack: blockwise online-softmax attention that
+never materializes the ``[T, T]`` score matrix, so HBM traffic stays
+O(T·D) and VMEM holds one ``[block_q, block_k]`` tile at a time.
+
+Layout matches :func:`scalerl_tpu.ops.ring_attention.full_attention`:
+``q/k/v`` are ``[B, T, H, D]`` and the result is ``[B, Tq, H, D]``, so the
+kernel drops into ``TransformerPolicy``'s pluggable ``attn_fn`` seam — and
+into ring attention's *local* block step, composing kernel-level tiling
+(this file) with device-level sequence sharding (``ring_attention``).
+
+Differentiable: a ``jax.custom_vjp`` implements the flash backward — the
+probability tiles are recomputed from the saved log-sum-exp rather than
+stored, one kernel gridded over q blocks for ``dq`` and one gridded over
+k blocks for ``dk``/``dv`` (the FlashAttention-2 split, so neither kernel
+needs cross-grid accumulation).
+
+On CPU hosts (tests, this image) the kernels run in Pallas interpret mode;
+on TPU they compile to Mosaic.  Scores/accumulators are float32 regardless
+of input dtype (bf16 inputs feed the MXU directly).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float("-inf")
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mask_block(
+    i: int, j, q_len: int, k_len: int, block_q: int, block_k: int, causal: bool
+):
+    """Validity mask for score tile (q block ``i``, k block ``j``)."""
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (k_pos < k_len) & (q_pos < q_len)
+    if causal:
+        mask = mask & (k_pos <= q_pos)
+    return mask
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, scale, causal, q_len, k_len, block_q, block_k, nk,
+):
+    i = pl.program_id(2)
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, D]
+    D = q.shape[-1]
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+
+    if causal:
+        hi = jnp.minimum(nk, pl.cdiv((i + 1) * block_q, block_k))
+    else:
+        hi = nk
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m) - safe_m)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # log-sum-exp of the scaled scores per q row (fully-masked rows get -inf)
+    lse = jnp.where(
+        l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), _NEG_INF
+    )
+    lse_ref[0, 0, :] = lse
+
+
+def _pad_t(x: jnp.ndarray, t_pad: int) -> jnp.ndarray:
+    T = x.shape[1]
+    if T == t_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, t_pad - T), (0, 0), (0, 0)))
+
+
+def _fwd(
+    q, k, v, causal, scale, block_q, block_k, interpret
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = min(block_q, _round_up(Tq, 8))
+    bk = min(block_k, _round_up(Tk, 8))
+    Tq_p, Tk_p = _round_up(Tq, bq), _round_up(Tk, bk)
+    nq, nk = Tq_p // bq, Tk_p // bk
+    qp, kp, vp = _pad_t(q, Tq_p), _pad_t(k, Tk_p), _pad_t(v, Tk_p)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, q_len=Tq, k_len=Tk,
+        block_q=bq, block_k=bk, nk=nk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Tq_p, H, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :Tq], lse
+
+
+# ----------------------------------------------------------------------
+# backward (FlashAttention-2 split: dq over q blocks, dk/dv over k blocks)
+# ----------------------------------------------------------------------
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal, q_len, k_len, block_q, block_k, nk,
+):
+    i = pl.program_id(2)
+    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+    do = do_ref[0, :, 0, :].astype(jnp.float32)  # [bq, D]
+    lse = lse_ref[0, 0, :][:, None]  # [bq, 1]
+    delta = delta_ref[0, 0, :][:, None]  # [bq, 1]
+    safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    dq0 = jnp.zeros_like(q)
+
+    if causal:
+        hi = jnp.minimum(nk, pl.cdiv((i + 1) * block_q, block_k))
+    else:
+        hi = nk
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
+        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)  # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    dq_ref[0, :, 0, :] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, q_len, k_len, block_q, block_k, nq,
+):
+    j = pl.program_id(2)
+    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, D]
+    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+    dk0 = jnp.zeros_like(k_blk)
+    dv0 = jnp.zeros_like(v_blk)
+
+    lo = (j * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
+        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    nq_total = nq
+    dk, dv = jax.lax.fori_loop(lo, nq_total, body, (dk0, dv0))
+    # q was pre-scaled, so ds@q carries one factor of `scale` already — the
+    # remaining factor belongs to dk only
+    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(
+    causal, scale, block_q, block_k, interpret, residuals, g
+):
+    q, k, v, o, lse = residuals
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq = min(block_q, _round_up(Tq, 8))
+    bk = min(block_k, _round_up(Tk, 8))
+    Tq_p, Tk_p = _round_up(Tq, bq), _round_up(Tk, bk)
+    nq, nk = Tq_p // bq, Tk_p // bk
+    qp, kp, vp = _pad_t(q, Tq_p), _pad_t(k, Tk_p), _pad_t(v, Tk_p)
+    dop, op = _pad_t(g, Tq_p), _pad_t(o, Tq_p)
+    lse_p = jnp.pad(lse, ((0, 0), (0, 0), (0, Tq_p - Tq)))
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term
+    delta = jnp.einsum("bqhd,bqhd->bhq", dop.astype(jnp.float32), op.astype(jnp.float32))
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, q_len=Tq, k_len=Tk,
+        block_q=bq, block_k=bk, nk=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Tq_p, H, D), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, q_len=Tq, k_len=Tk,
+        block_q=bq, block_k=bk, nq=nq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, nk),
+        in_specs=[
+            pl.BlockSpec((1, Tq_p, 1, D), lambda b, h, j: (b, 0, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, Tq_p, 1, D), lambda b, h, j: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Tk_p, H, D), k.dtype),
+            jax.ShapeDtypeStruct((B, Tk_p, H, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta)
+    return dq[:, :Tq], dk[:, :Tk], dv[:, :Tk]
+
+
+# ----------------------------------------------------------------------
+# public op
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Blockwise exact attention; same contract as ``full_attention``.
+
+    ``q/k/v``: ``[B, T, H, D]`` (Tq may differ from Tk).  ``interpret=None``
+    auto-selects Pallas interpret mode off-TPU.
+    """
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    if scale is None:
+        scale = 1.0 / (residuals[0].shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    return _bwd(causal, scale, block_q, block_k, interpret, residuals, g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -18,6 +18,13 @@ import jax
 import numpy as np
 
 
+def jnp_copy(x):
+    """Async device-side copy (new buffer, survives donation of ``x``)."""
+    import jax.numpy as jnp
+
+    return jnp.copy(x) if isinstance(x, jax.Array) else np.asarray(x)
+
+
 class ParameterServer:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -36,12 +43,17 @@ class ParameterServer:
         With ``to_host=True`` the pytree is fetched to numpy once here, so N
         actor pulls cost zero device traffic.  SEED-style learners whose
         actors run device inference should push with ``to_host=False``: the
-        per-step publish is then a version bump holding live device arrays,
-        and the numpy snapshot is materialized lazily — once, cached per
-        version — only if some off-host consumer actually pulls.
+        per-step publish is then an async *device-side copy* + version bump
+        (no host sync), and the numpy snapshot is materialized lazily —
+        once, cached per version — only if some off-host consumer pulls.
+        The device copy detaches the snapshot from the learner's buffers:
+        mesh learn steps donate their state (``parallel/train_step.py``), so
+        storing the live params would leave pullers holding deleted arrays.
         """
         if to_host:
             weights = jax.tree_util.tree_map(np.asarray, weights)
+        else:
+            weights = jax.tree_util.tree_map(jnp_copy, weights)
         with self._lock:
             self._version += 1
             self._weights = weights
@@ -54,11 +66,18 @@ class ParameterServer:
         Pullers always receive host (numpy) pytrees regardless of how the
         weights were pushed — a ``to_host=False`` publish is materialized
         here on first pull and the conversion is cached for the version.
+        Materialization happens *outside* the lock (it blocks on the device
+        finishing the in-flight step), so a slow pull never stalls the
+        learner's next ``push``.
         """
         with self._lock:
             if self._weights is None or have_version == self._version:
                 return None, self._version
-            if not self._is_host:
-                self._weights = jax.tree_util.tree_map(np.asarray, self._weights)
-                self._is_host = True
-            return self._weights, self._version
+            weights, version, is_host = self._weights, self._version, self._is_host
+        if not is_host:
+            weights = jax.tree_util.tree_map(np.asarray, weights)
+            with self._lock:
+                if self._version == version:
+                    self._weights = weights
+                    self._is_host = True
+        return weights, version

@@ -214,6 +214,19 @@ class HostActorLearnerTrainer(BaseTrainer):
             )
         return True
 
+    def _assemble_batch(self, n_slots: int, timings: Optional[Timings] = None):
+        """Drain ``n_slots`` full slots into one device trajectory — the
+        single assembly path for both the inline learner loop and the
+        prefetch threads."""
+        batch, idxs = self.queue.get_batch(n_slots)
+        if timings is not None:
+            timings.time("dequeue")
+        traj = batch_to_trajectory(batch)
+        self.queue.recycle(idxs)
+        if timings is not None:
+            timings.time("device")
+        return traj
+
     def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
         args = self.args
         total_frames = total_frames or args.total_steps
@@ -259,10 +272,7 @@ class HostActorLearnerTrainer(BaseTrainer):
             def _assemble() -> None:
                 try:
                     while not self.stop_event.is_set():
-                        batch, idxs = self.queue.get_batch(n_slots)
-                        traj = batch_to_trajectory(batch)
-                        self.queue.recycle(idxs)
-                        if not _put(traj):
+                        if not _put(self._assemble_batch(n_slots)):
                             return
                 except BaseException as e:  # noqa: BLE001 — re-raised below
                     _put(e)
@@ -277,12 +287,7 @@ class HostActorLearnerTrainer(BaseTrainer):
         def next_traj():
             if prefetch_q is None:
                 self.learn_timings.reset()
-                batch, idxs = self.queue.get_batch(n_slots)
-                self.learn_timings.time("dequeue")
-                traj = batch_to_trajectory(batch)
-                self.queue.recycle(idxs)
-                self.learn_timings.time("device")
-                return traj
+                return self._assemble_batch(n_slots, timings=self.learn_timings)
             self.learn_timings.reset()
             while True:
                 try:

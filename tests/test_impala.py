@@ -205,6 +205,18 @@ def test_parameter_server_lazy_host_snapshot():
     assert w2["w"] is leaf
 
 
+def test_parameter_server_push_survives_donation():
+    """to_host=False publishes a device-side copy: pullers must still read
+    the snapshot after the learner's next (donating) step deletes the
+    original buffers (parallel/train_step.py donates state)."""
+    server = ParameterServer()
+    x = jnp.ones((4,))
+    server.push({"w": x}, to_host=False)
+    x.delete()  # simulate donation invalidating the learner's buffer
+    weights, _ = server.pull()
+    np.testing.assert_array_equal(np.asarray(weights["w"]), np.ones(4))
+
+
 def test_host_actor_learner_prefetch_thread(tmp_path):
     """num_learner_threads >= 2 runs the assembly-prefetch learner path
     (reference num_learners capability, impala_atari.py:439-456)."""
