@@ -1,0 +1,102 @@
+"""Device-native Catch: the classic falling-ball pixel-control task.
+
+A ball falls one row per step from a random column; the agent slides a
+paddle along the bottom row (left / stay / right) and is rewarded +1 for
+catching the ball, -1 for missing, at the episode's final step (the
+DeepMind bsuite Catch task, re-implemented pure-JAX on the
+``envs/jax_envs/base.py`` protocol).
+
+Why it exists (beyond the reference, which has no device-native envs):
+``SyntheticPixelEnv`` validates obs->action *pattern lookup*; Catch demands
+spatio-temporal *control* — the policy must read two object positions from
+pixels and steer one toward the other over many steps before the single
+delayed reward lands.  That is the smallest task shaped like Pong
+(BASELINE.md's north star needs ALE ROMs this image lacks), so it is the
+flagship learning-evidence env for the fused device loop.
+
+Observations are ``[size, size, stack]`` uint8 frames (bright ball + paddle
+over a black field, duplicated across the channel stack so the standard
+Atari conv torso applies unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.envs.jax_envs.base import JaxEnv
+
+
+class CatchState(NamedTuple):
+    ball_row: jnp.ndarray  # int32, 0 = top
+    ball_col: jnp.ndarray  # int32
+    paddle_col: jnp.ndarray  # int32
+    t: jnp.ndarray  # int32 step counter
+
+
+class JaxCatch(JaxEnv):
+    """rows x cols Catch; episode length == rows (ball reaches the bottom)."""
+
+    def __init__(self, size: int = 24, stack: int = 1, paddle_width: int = 3) -> None:
+        if paddle_width % 2 != 1:
+            raise ValueError("paddle_width must be odd (centered on paddle_col)")
+        self.size = size
+        self.stack = stack
+        self.paddle_width = paddle_width
+
+    @property
+    def observation_shape(self) -> Tuple[int, ...]:
+        return (self.size, self.size, self.stack)
+
+    @property
+    def observation_dtype(self):
+        return jnp.uint8
+
+    @property
+    def num_actions(self) -> int:
+        return 3  # left / stay / right
+
+    def _render(self, state: CatchState) -> jnp.ndarray:
+        rows = jnp.arange(self.size)[:, None]
+        cols = jnp.arange(self.size)[None, :]
+        ball = (rows == state.ball_row) & (cols == state.ball_col)
+        half = self.paddle_width // 2
+        paddle = (rows == self.size - 1) & (
+            jnp.abs(cols - state.paddle_col) <= half
+        )
+        frame = jnp.where(ball | paddle, 255, 0).astype(jnp.uint8)
+        return jnp.broadcast_to(frame[:, :, None], (self.size, self.size, self.stack))
+
+    def _spawn(self, key: jax.Array) -> CatchState:
+        ball_col = jax.random.randint(key, (), 0, self.size)
+        return CatchState(
+            ball_row=jnp.zeros((), jnp.int32),
+            ball_col=ball_col,
+            paddle_col=jnp.asarray(self.size // 2, jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array):
+        state = self._spawn(key)
+        return state, self._render(state)
+
+    def step(self, state: CatchState, action: jnp.ndarray, key: jax.Array):
+        move = action.astype(jnp.int32) - 1  # 0/1/2 -> -1/0/+1
+        paddle = jnp.clip(state.paddle_col + move, 0, self.size - 1)
+        ball_row = state.ball_row + 1
+        t = state.t + 1
+        done = ball_row >= self.size - 1
+        half = self.paddle_width // 2
+        caught = jnp.abs(state.ball_col - paddle) <= half
+        reward = jnp.where(
+            done, jnp.where(caught, 1.0, -1.0), 0.0
+        ).astype(jnp.float32)
+
+        next_state = CatchState(ball_row, state.ball_col, paddle, t)
+        respawn = self._spawn(key)
+        new_state = jax.tree_util.tree_map(
+            lambda r, n: jnp.where(done, r, n), respawn, next_state
+        )
+        return new_state, self._render(new_state), reward, done

@@ -4,14 +4,19 @@ The hot op of the long-context path (``models/transformer.py`` /
 ``parallel/sequence.py``).  No counterpart exists in the reference — it has
 no attention at all (SURVEY.md §5) — this kernel is part of the TPU build's
 beyond-parity long-context stack: blockwise online-softmax attention that
-never materializes the ``[T, T]`` score matrix, so HBM traffic stays
-O(T·D) and VMEM holds one ``[block_q, block_k]`` tile at a time.
+never materializes the ``[T, T]`` score matrix.
+
+Tiling: the kv dimension lives in the *grid* (innermost, sequential on
+TPU), with the online-softmax accumulators in VMEM scratch that persists
+across kv steps — so VMEM holds one ``[block_q, D]`` query tile, one
+``[block_k, D]`` kv tile, and one ``[block_q, block_k]`` score tile at a
+time, and HBM traffic stays O(T·D) per (batch, head).  Long contexts never
+pull a full ``[T, D]`` K or V into VMEM.
 
 Layout matches :func:`scalerl_tpu.ops.ring_attention.full_attention`:
 ``q/k/v`` are ``[B, T, H, D]`` and the result is ``[B, Tq, H, D]``, so the
 kernel drops into ``TransformerPolicy``'s pluggable ``attn_fn`` seam — and
-into ring attention's *local* block step, composing kernel-level tiling
-(this file) with device-level sequence sharding (``ring_attention``).
+composes with ring attention's device-level sequence sharding.
 
 Differentiable: a ``jax.custom_vjp`` implements the flash backward — the
 probability tiles are recomputed from the saved log-sum-exp rather than
@@ -32,6 +37,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
 
@@ -45,7 +51,7 @@ def _interpret_default() -> bool:
 
 
 def _mask_block(
-    i: int, j, q_len: int, k_len: int, block_q: int, block_k: int, causal: bool
+    i, j, q_len: int, k_len: int, block_q: int, block_k: int, causal: bool
 ):
     """Validity mask for score tile (q block ``i``, k block ``j``)."""
     q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -56,51 +62,60 @@ def _mask_block(
     return mask
 
 
+def _causal_live(i, j, block_q: int, block_k: int):
+    """Whether kv tile ``j`` intersects the causal triangle of q tile ``i``."""
+    return j * block_k <= i * block_q + block_q - 1
+
+
 # ----------------------------------------------------------------------
-# forward
+# forward: grid (B, H, nq, nk) — kv innermost, accumulators in scratch
 # ----------------------------------------------------------------------
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
     *, scale, causal, q_len, k_len, block_q, block_k, nk,
 ):
     i = pl.program_id(2)
-    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, D]
-    D = q.shape[-1]
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    j = pl.program_id(3)
 
-    if causal:
-        hi = jnp.minimum(nk, pl.cdiv((i + 1) * block_q, block_k))
-    else:
-        hi = nk
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+    live = _causal_live(i, j, block_q, block_k) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, D]
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, D]
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
         mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
         s = jnp.where(mask, s, _NEG_INF)
+        m = m_sc[:]
+        l = l_sc[:]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
         p = jnp.exp(s - safe_m)
         corr = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m) - safe_m)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
+        l_sc[:] = l * corr + p.sum(axis=-1, keepdims=True)
+        m_sc[:] = m_new
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return acc_new, m_new, l_new
 
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # log-sum-exp of the scaled scores per q row (fully-masked rows get -inf)
-    lse = jnp.where(
-        l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), _NEG_INF
-    )
-    lse_ref[0, 0, :] = lse
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_sc[:]
+        m = m_sc[:]
+        o_ref[0, :, 0, :] = (acc_sc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = jnp.where(
+            l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)), _NEG_INF
+        )
+        lse_ref[0, 0, :] = lse
 
 
 def _pad_t(x: jnp.ndarray, t_pad: int) -> jnp.ndarray:
@@ -110,14 +125,19 @@ def _pad_t(x: jnp.ndarray, t_pad: int) -> jnp.ndarray:
     return jnp.pad(x, ((0, 0), (0, t_pad - T), (0, 0), (0, 0)))
 
 
+def _blocks(Tq: int, Tk: int, block_q: int, block_k: int):
+    bq = min(block_q, _round_up(Tq, 8))
+    bk = min(block_k, _round_up(Tk, 8))
+    Tq_p, Tk_p = _round_up(Tq, bq), _round_up(Tk, bk)
+    return bq, bk, Tq_p, Tk_p
+
+
 def _fwd(
     q, k, v, causal, scale, block_q, block_k, interpret
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    bq = min(block_q, _round_up(Tq, 8))
-    bk = min(block_k, _round_up(Tk, 8))
-    Tq_p, Tk_p = _round_up(Tq, bq), _round_up(Tk, bk)
+    bq, bk, Tq_p, Tk_p = _blocks(Tq, Tk, block_q, block_k)
     nq, nk = Tq_p // bq, Tk_p // bk
     qp, kp, vp = _pad_t(q, Tq_p), _pad_t(k, Tk_p), _pad_t(v, Tk_p)
 
@@ -127,19 +147,24 @@ def _fwd(
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=(B, H, nq),
+        grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
-            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Tq_p, H, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp)
@@ -150,101 +175,106 @@ def _fwd(
 # backward (FlashAttention-2 split: dq over q blocks, dk/dv over k blocks)
 # ----------------------------------------------------------------------
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_sc,
     *, scale, causal, q_len, k_len, block_q, block_k, nk,
 ):
     i = pl.program_id(2)
-    q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
-    do = do_ref[0, :, 0, :].astype(jnp.float32)  # [bq, D]
-    lse = lse_ref[0, 0, :][:, None]  # [bq, 1]
-    delta = delta_ref[0, 0, :][:, None]  # [bq, 1]
-    safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
-    dq0 = jnp.zeros_like(q)
+    j = pl.program_id(3)
 
-    if causal:
-        hi = jnp.minimum(nk, pl.cdiv((i + 1) * block_q, block_k))
-    else:
-        hi = nk
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(jnp.float32)
+    live = _causal_live(i, j, block_q, block_k) if causal else (j >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
-        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)  # [bq, bk]
+        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(0, hi, body, dq0)
-    dq_ref[0, :, 0, :] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, :, 0, :] = (dq_sc[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_sc, dv_sc,
     *, scale, causal, q_len, k_len, block_q, block_k, nq,
 ):
     j = pl.program_id(2)
-    k_blk = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, D]
-    v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
-    dk0 = jnp.zeros_like(k_blk)
-    dv0 = jnp.zeros_like(v_blk)
+    i = pl.program_id(3)
 
-    lo = (j * block_k) // block_q if causal else 0
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * block_q, block_q), 0, :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+    live = _causal_live(i, j, block_q, block_k) if causal else (i >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)  # [bk, D]
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale  # [bq, D]
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
         safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         mask = _mask_block(i, j, q_len, k_len, block_q, block_k, causal)
         p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)  # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        dk_new = dk + jax.lax.dot_general(
+        # q was pre-scaled, so ds@q carries one factor of `scale` already —
+        # the remaining factor belongs to dq only
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk_new, dv_new
 
-    nq_total = nq
-    dk, dv = jax.lax.fori_loop(lo, nq_total, body, (dk0, dv0))
-    # q was pre-scaled, so ds@q carries one factor of `scale` already — the
-    # remaining factor belongs to dk only
-    dk_ref[0, :, 0, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, 0, :] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, :, 0, :] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_sc[:].astype(dv_ref.dtype)
 
 
-def _bwd(
-    causal, scale, block_q, block_k, interpret, residuals, g
-):
+def _bwd(causal, scale, block_q, block_k, interpret, residuals, g):
     q, k, v, o, lse = residuals
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    bq = min(block_q, _round_up(Tq, 8))
-    bk = min(block_k, _round_up(Tk, 8))
-    Tq_p, Tk_p = _round_up(Tq, bq), _round_up(Tk, bk)
+    bq, bk, Tq_p, Tk_p = _blocks(Tq, Tk, block_q, block_k)
     nq, nk = Tq_p // bq, Tk_p // bk
     qp, kp, vp = _pad_t(q, Tq_p), _pad_t(k, Tk_p), _pad_t(v, Tk_p)
     dop, op = _pad_t(g, Tq_p), _pad_t(o, Tq_p)
     lse_p = jnp.pad(lse, ((0, 0), (0, 0), (0, Tq_p - Tq)))
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term
-    delta = jnp.einsum("bqhd,bqhd->bhq", dop.astype(jnp.float32), op.astype(jnp.float32))
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", dop.astype(jnp.float32), op.astype(jnp.float32)
+    )
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, q_len=Tq, k_len=Tk,
@@ -252,17 +282,18 @@ def _bwd(
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(B, H, nq),
+        grid=(B, H, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
-            pl.BlockSpec((1, Tk_p, 1, D), lambda b, h, i: (b, 0, h, 0)),
-            pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i: (b, h, i)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
         ],
-        out_specs=pl.BlockSpec((1, bq, 1, D), lambda b, h, i: (b, i, h, 0)),
+        out_specs=pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Tq_p, H, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta)
 
@@ -272,22 +303,26 @@ def _bwd(
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(B, H, nk),
+        grid=(B, H, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, Tq_p, 1, D), lambda b, h, j: (b, 0, h, 0)),
-            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
-            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
-            pl.BlockSpec((1, Tq_p, 1, D), lambda b, h, j: (b, 0, h, 0)),
-            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
-            pl.BlockSpec((1, 1, Tq_p), lambda b, h, j: (b, h, 0)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, j, i: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, j, i: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
-            pl.BlockSpec((1, bk, 1, D), lambda b, h, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, Tk_p, H, D), k.dtype),
             jax.ShapeDtypeStruct((B, Tk_p, H, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta)

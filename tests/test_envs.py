@@ -130,6 +130,37 @@ def test_synthetic_pixel_env():
     )
 
 
+def test_jax_catch_env():
+    from scalerl_tpu.envs import JaxCatch
+
+    env = JaxCatch(size=12, paddle_width=3)
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    assert obs.shape == (12, 12, 1) and obs.dtype == jnp.uint8
+    assert int(state.ball_row) == 0
+
+    # a perfect tracker always catches (+1 at the final step, 0 before)
+    s, total = state, 0.0
+    for t in range(11):
+        move = jnp.sign(s.ball_col - s.paddle_col) + 1  # chase the ball
+        s, o, r, d = env.step(s, move.astype(jnp.int32), jax.random.PRNGKey(t))
+        total += float(r)
+    assert bool(d) and total == 1.0
+    # auto-reset: post-done state is a fresh drop from the top
+    assert int(s.ball_row) == 0
+
+    # always-left from a right-side ball misses (-1)
+    state2, _ = env.reset(jax.random.PRNGKey(5))
+    state2 = state2._replace(
+        ball_col=jnp.asarray(11, jnp.int32), paddle_col=jnp.asarray(0, jnp.int32)
+    )
+    s, total = state2, 0.0
+    for t in range(11):
+        s, o, r, d = env.step(s, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(t))
+        total += float(r)
+    assert total == -1.0
+
+
 def test_atari_wrappers_on_fake_env():
     """Drive WarpFrame/ClipReward/FrameStack/MaxAndSkip on a synthetic RGB env
     (no ALE in this image, SURVEY.md env notes)."""
