@@ -1,0 +1,286 @@
+"""IMPALA over the DCN actor fleet: remote CPU actors, central V-trace learner.
+
+The end state of SURVEY.md §7 step 9 — the topology the reference's vendored
+``hpc`` fleet was built for but never wired to a learner: a worker fleet
+(local pipes here; ``RemoteCluster`` connects the identical protocol from
+other hosts over TCP, entry handshake + gather fan-in + compressed batched
+uploads) runs environment lanes with *local CPU policy inference* on
+versioned weight snapshots and streams fixed-shape ``[T+1, B]`` trajectory
+chunks back; the central learner applies V-trace — which corrects exactly
+the policy lag this topology creates — and republishes weights.
+
+Differs from ``train_fleet_dqn.py`` (episodic replay transitions) in that
+workers keep *persistent* env lanes across tasks: each task advances the
+lanes ``rollout_length`` steps from wherever they stopped, so chunks are
+continuous trajectories with carried last-action/reward/done rows, matching
+the ``data/trajectory.py`` layout every other IMPALA path uses.
+
+Usage:
+    python examples/train_fleet_impala.py --total-frames 100000 --num-workers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+ENV_ID = "CartPole-v1"
+OBS_DIM, NUM_ACTIONS = 4, 2
+
+
+class ChunkRunner:
+    """Stateful per-worker rollout: persistent env lanes + numpy policy.
+
+    Picklable (config only); envs and carry state materialize lazily in the
+    worker process on first call.
+    """
+
+    def __init__(self, num_lanes: int = 2, rollout_length: int = 16) -> None:
+        self.num_lanes = num_lanes
+        self.rollout_length = rollout_length
+        self._live = None  # (envs, obs, last_action, reward, done, ep_ret, rng)
+
+    def _ensure(self, seed: int):
+        if self._live is None:
+            # the project factory (SAME_STEP autoreset + wrapper stack):
+            # gymnasium's default NEXT_STEP autoreset inserts a fake
+            # terminal-obs -> reset-obs transition that V-trace would train on
+            from scalerl_tpu.envs import make_vect_envs
+
+            envs = make_vect_envs(
+                ENV_ID, num_envs=self.num_lanes, seed=seed, async_envs=False
+            )
+            obs, _ = envs.reset(seed=seed)
+            B = self.num_lanes
+            self._live = [
+                envs,
+                obs,
+                np.zeros(B, np.int32),
+                np.zeros(B, np.float32),
+                np.ones(B, bool),
+                np.zeros(B, np.float64),
+                np.random.default_rng(seed),
+            ]
+        return self._live
+
+    def __call__(self, task, weights, worker_id):
+        if task.get("role") == "noop":
+            # learner is behind its off-policy window: idle briefly
+            time.sleep(0.05)
+            return {"noop": True}
+        live = self._ensure(int(task["seed"]) + 104729 * worker_id)
+        envs, obs, last_action, reward, done, ep_ret, rng = live
+        T, B = self.rollout_length, self.num_lanes
+        chunk = {
+            "obs": np.zeros((T + 1, B, OBS_DIM), np.float32),
+            "action": np.zeros((T + 1, B), np.int32),
+            "reward": np.zeros((T + 1, B), np.float32),
+            "done": np.ones((T + 1, B), bool),
+            "logits": np.zeros((T + 1, B, NUM_ACTIONS), np.float32),
+        }
+        returns = []
+        for t in range(T + 1):
+            chunk["obs"][t] = obs
+            chunk["action"][t] = last_action
+            chunk["reward"][t] = reward
+            chunk["done"][t] = done
+            if t == T:
+                break  # row T is model-input-only (learner reads logits[:-1])
+            if weights is None:
+                logits = np.zeros((B, NUM_ACTIONS), np.float32)
+            else:
+                from scalerl_tpu.models.np_forward import mlp_policy_forward
+
+                logits = mlp_policy_forward(weights, obs)
+            chunk["logits"][t] = logits
+            # softmax sample (behavior policy == current snapshot)
+            z = logits - logits.max(axis=-1, keepdims=True)
+            p = np.exp(z)
+            p /= p.sum(axis=-1, keepdims=True)
+            action = np.array(
+                [rng.choice(NUM_ACTIONS, p=p[b]) for b in range(B)], np.int32
+            )
+            obs, reward, term, trunc, _ = envs.step(action)
+            done = np.logical_or(term, trunc)
+            reward = np.asarray(reward, np.float32)
+            last_action = action
+            ep_ret += reward
+            for b in np.nonzero(done)[0]:
+                returns.append(float(ep_ret[b]))
+                ep_ret[b] = 0.0
+        live[1:6] = [obs, last_action, reward, done, ep_ret]
+        chunk["returns"] = returns
+        return chunk
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--total-frames", type=int, default=100_000)
+    parser.add_argument("--num-workers", type=int, default=4)
+    parser.add_argument("--num-lanes", type=int, default=2, help="env lanes per worker")
+    parser.add_argument("--rollout-length", type=int, default=16)
+    parser.add_argument("--batch-size", type=int, default=8, help="lanes per learn batch")
+    parser.add_argument("--publish-every", type=int, default=1)
+    parser.add_argument("--learning-rate", type=float, default=2e-3)
+    parser.add_argument("--platform", default="cpu")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.data.trajectory import batch_to_trajectory
+    from scalerl_tpu.fleet import FleetConfig, LocalCluster, WorkerServer
+
+    iargs = ImpalaArguments(
+        env_id=ENV_ID,
+        use_lstm=False,
+        hidden_size=64,
+        rollout_length=args.rollout_length,
+        batch_size=args.batch_size,
+        num_buffers=max(2 * args.batch_size, args.num_workers),
+        learning_rate=args.learning_rate,
+        entropy_cost=0.01,
+        max_timesteps=args.total_frames,
+    )
+    agent = ImpalaAgent(
+        iargs, obs_shape=(OBS_DIM,), num_actions=NUM_ACTIONS, obs_dtype=np.float32
+    )
+
+    n_chunks = max(args.batch_size // args.num_lanes, 1)
+    lock = threading.Lock()
+    frames_per_task = args.rollout_length * args.num_lanes
+    # off-policy window: never hand out tasks more than a few batches ahead
+    # of what the learner consumed — otherwise workers race ahead during the
+    # learner's first compile and every queued chunk ages into huge lag
+    window = 4 * n_chunks * frames_per_task
+    frames = {"sent": 0, "consumed": 0}
+    server_box = {}
+
+    def task_source():
+        with lock:
+            if frames["sent"] >= args.total_frames:
+                return None
+            if frames["sent"] - frames["consumed"] >= window:
+                return {"role": "noop"}  # fleet idles briefly, retries
+            frames["sent"] += frames_per_task
+            return {
+                "role": "rollout",
+                "seed": frames["sent"] // frames_per_task,
+                "param_version": server_box["s"].params.version,
+            }
+
+    # compile the learn step BEFORE actors start producing, so the first
+    # batch doesn't age in the queue for the whole compile; snapshot/restore
+    # state so the zero-batch warm-up's gradient step never reaches workers
+    from scalerl_tpu.data.trajectory import TrajectorySpec
+
+    warm_spec = TrajectorySpec(
+        unroll_length=args.rollout_length,
+        batch_size=n_chunks * args.num_lanes,
+        obs_shape=(OBS_DIM,),
+        num_actions=NUM_ACTIONS,
+        obs_dtype=np.float32,
+    )
+    state_before = agent.state
+    agent.learn(warm_spec.zeros())
+    agent.state = state_before
+
+    config = FleetConfig(
+        num_workers=args.num_workers, workers_per_gather=4, upload_batch=2
+    )
+    # queue must outsize the off-policy window plus in-flight noops: at
+    # capacity the server evicts the stalest result, and an evicted rollout
+    # chunk's frames would be "sent" but never consumed
+    server = WorkerServer(
+        config,
+        task_source,
+        result_maxsize=4 * n_chunks + 2 * args.num_workers + 8,
+    )
+    server_box["s"] = server
+    server.publish(jax.tree_util.tree_map(np.asarray, agent.get_weights()))
+    server.start()
+    runner = ChunkRunner(
+        num_lanes=args.num_lanes, rollout_length=args.rollout_length
+    )
+    # spawn, not fork: this process holds a JAX runtime
+    cluster = LocalCluster(server, config, runner, mp_context="spawn")
+    cluster.start()
+    chunks = []
+    returns: list = []
+    learn_steps = 0
+    env_frames = 0
+    metrics = {}
+    t0 = time.time()
+    idle_polls = 0
+    try:
+        while env_frames < args.total_frames:
+            result = server.get_result(timeout=1.0)
+            if result is None:
+                if not server.worker_errors.empty():
+                    err = server.worker_errors.get()
+                    raise RuntimeError(f"fleet worker failed: {err.get('error')}")
+                with lock:
+                    exhausted = frames["sent"] >= args.total_frames
+                idle_polls += 1
+                if exhausted and idle_polls >= 5:
+                    # tasks done and the pipeline has drained (a dropped
+                    # result under backpressure must not hang the loop)
+                    break
+                continue
+            idle_polls = 0
+            if result.get("noop"):
+                continue
+            returns.extend(result.pop("returns", []))
+            lag = server.params.version - int(result.get("param_version", 0))
+            result = {
+                k: v for k, v in result.items() if k not in ("worker_id", "param_version")
+            }
+            chunks.append(result)
+            env_frames += frames_per_task
+            with lock:
+                frames["consumed"] = env_frames
+            if len(chunks) < n_chunks:
+                continue
+            batch = {
+                k: np.concatenate([c[k] for c in chunks], axis=1)
+                for k in ("obs", "action", "reward", "done", "logits")
+            }
+            chunks.clear()
+            metrics = agent.learn(batch_to_trajectory(batch))
+            learn_steps += 1
+            if learn_steps % args.publish_every == 0:
+                server.publish(jax.tree_util.tree_map(np.asarray, agent.get_weights()))
+            if learn_steps % 50 == 0:
+                sps = env_frames / max(time.time() - t0, 1e-8)
+                recent = float(np.mean(returns[-50:])) if returns else float("nan")
+                print(
+                    f"frames {env_frames} | sps {sps:.0f} | return(50) {recent:.1f} "
+                    f"| lag {lag} | loss {metrics.get('total_loss', float('nan')):.2f} "
+                    f"| weights v{server.params.version}",
+                    flush=True,
+                )
+    finally:
+        cluster.join()
+        server.stop()
+    dt = time.time() - t0
+    first = float(np.mean(returns[:50])) if returns else float("nan")
+    last = float(np.mean(returns[-50:])) if returns else float("nan")
+    print(
+        f"done: {env_frames} frames, {learn_steps} learn steps in {dt:.1f}s | "
+        f"return(50) first {first:.1f} -> last {last:.1f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -53,3 +53,19 @@ def mlp_qnet_forward(
     adv = x @ np.asarray(heads[0]["kernel"]) + np.asarray(heads[0]["bias"])
     val = x @ np.asarray(heads[1]["kernel"]) + np.asarray(heads[1]["bias"])
     return val + adv - adv.mean(axis=-1, keepdims=True)
+
+
+def mlp_policy_forward(params: Any, obs: np.ndarray) -> np.ndarray:
+    """Policy logits ``[B, A]`` from a ``models.policy.MLPPolicyNet`` pytree.
+
+    The torso is the ``Dense_i`` relu stack; the actor head is the named
+    ``policy`` Dense (the ``baseline`` head is learner-only and skipped).
+    """
+    inner = params["params"] if "params" in params else params
+    x = np.asarray(obs, np.float32)
+    if x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    for layer in _dense_layers(params):
+        x = np.maximum(x @ np.asarray(layer["kernel"]) + np.asarray(layer["bias"]), 0.0)
+    head = inner["policy"]
+    return x @ np.asarray(head["kernel"]) + np.asarray(head["bias"])

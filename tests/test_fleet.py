@@ -357,3 +357,28 @@ def test_generation_runner_in_local_cluster():
     for r in results:
         assert r["length"] == 3
         assert r["chunks"][0]["obs"].shape == (4, 3)
+
+
+def test_fleet_impala_example_end_to_end():
+    """The IMPALA-over-fleet entry (remote-actor topology + V-trace learner)
+    runs to completion and reports learning progress fields."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parents[1]
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(root / "examples" / "train_fleet_impala.py"),
+            "--total-frames", "4000",
+            "--num-workers", "2",
+            "--publish-every", "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "done: " in proc.stdout and "learn steps" in proc.stdout
