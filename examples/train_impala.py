@@ -38,12 +38,19 @@ def main() -> None:
     if args.env_backend == "jax":
         from scalerl_tpu.trainer.actor_learner import DeviceActorLearnerTrainer
 
+        mesh = None
         if args.mesh_shape:
-            print(
-                "WARNING: --mesh-shape is not wired into the fused jax "
-                "backend yet; use --env-backend gym for a sharded learner",
-                flush=True,
-            )
+            # Anakin: env lanes sharded over dp, grads pmean-ed in the
+            # fused step (the only axis that makes sense for this path)
+            from scalerl_tpu.parallel import make_mesh
+
+            mesh = make_mesh(args.mesh_shape)
+            non_dp = [a for a in mesh.axis_names if a != "dp" and mesh.shape[a] > 1]
+            if non_dp:
+                raise SystemExit(
+                    "the fused jax backend shards data-parallel only: use "
+                    f'--mesh-shape "dp=N" (got {args.mesh_shape!r})'
+                )
         venv = make_jax_vec_env(args.env_id, num_envs=args.num_envs)
         agent = ImpalaAgent(
             args,
@@ -51,7 +58,7 @@ def main() -> None:
             num_actions=venv.num_actions,
             obs_dtype=venv.env.observation_dtype,
         )
-        trainer = DeviceActorLearnerTrainer(args, agent, venv)
+        trainer = DeviceActorLearnerTrainer(args, agent, venv, mesh=mesh)
     else:
         from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
 

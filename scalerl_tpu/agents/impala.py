@@ -97,9 +97,23 @@ def impala_loss(
 
 
 def make_impala_learn_fn(
-    model, optimizer: optax.GradientTransformation, args: ImpalaArguments
+    model,
+    optimizer: optax.GradientTransformation,
+    args: ImpalaArguments,
+    grad_axis: Optional[str] = None,
 ) -> Callable:
-    """Build the pure (state, traj) -> (state, metrics) learner update."""
+    """Build the pure (state, traj) -> (state, metrics) learner update.
+
+    ``grad_axis``: when the learn step runs *inside* ``shard_map`` with the
+    batch sharded over a mesh axis (the fused multi-device loop,
+    ``runtime/device_loop.py``), gradients are ``psum``-ed over that axis
+    before the optimizer update — the data-parallel all-reduce the
+    reference delegated to NCCL (``dqn_agent.py:173-174`` capability).
+    ``psum``, not ``pmean``: the loss sums over the batch (reference
+    convention), so summing shard gradients makes dp=N at global batch B
+    numerically identical to a single device at batch B.  Metrics are
+    ``pmean``-ed (they are per-shard aggregates for logging).
+    """
 
     def learn(state: ImpalaTrainState, traj: Trajectory):
         (loss, metrics), grads = jax.value_and_grad(impala_loss, has_aux=True)(
@@ -113,6 +127,11 @@ def make_impala_learn_fn(
             rho_clip=args.vtrace_rho_clip,
             c_clip=args.vtrace_c_clip,
         )
+        n_shards = 1
+        if grad_axis is not None:
+            grads = jax.lax.psum(grads, grad_axis)
+            metrics = jax.lax.pmean(metrics, grad_axis)
+            n_shards = jax.lax.psum(1, grad_axis)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         T, B = traj.reward.shape[0] - 1, traj.reward.shape[1]
@@ -120,7 +139,7 @@ def make_impala_learn_fn(
             params=params,
             opt_state=opt_state,
             step=state.step + 1,
-            env_frames=state.env_frames + T * B,
+            env_frames=state.env_frames + T * B * n_shards,
         )
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
@@ -188,4 +207,12 @@ class ImpalaAgent(PolicyValueAgent):
             obs_dtype=obs_dtype,
             seed=args.seed,
             key=key,
+        )
+
+    def make_learn_fn(self, grad_axis: Optional[str] = None):
+        """Learn fn from *this agent's* model/optimizer/args — callers (the
+        mesh trainers) must not re-derive loss hyperparameters from a
+        possibly-different args object."""
+        return make_impala_learn_fn(
+            self.model, self.optimizer, self.args, grad_axis=grad_axis
         )

@@ -73,7 +73,10 @@ class JaxVecEnv:
         return self._reset(keys)
 
     def step(self, state, action: jnp.ndarray, key: jax.Array):
-        keys = jax.random.split(key, self.num_envs)
+        # split by the *actual* batch of this call, not self.num_envs: under
+        # shard_map (multi-device fused loop) each shard steps its local
+        # slice of the lanes
+        keys = jax.random.split(key, action.shape[0])
         return self._step(state, action, keys)
 
 

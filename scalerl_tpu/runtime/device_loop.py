@@ -31,7 +31,12 @@ from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
 
 
 class ActorCarry(NamedTuple):
-    """Per-env actor state threaded across rollout chunks."""
+    """Per-env actor state threaded across rollout chunks.
+
+    Every leaf keeps the env/batch axis leading (the accumulators are
+    per-env vectors, not scalars), so the whole carry shards uniformly
+    over a ``dp`` mesh axis in the multi-device fused loop.
+    """
 
     env_state: Any
     obs: jnp.ndarray  # [B, ...]
@@ -40,8 +45,8 @@ class ActorCarry(NamedTuple):
     done: jnp.ndarray  # [B]
     core_state: Any  # model recurrent state
     episode_return: jnp.ndarray  # [B] running return accumulator
-    return_sum: jnp.ndarray  # scalar: sum of completed-episode returns
-    episode_count: jnp.ndarray  # scalar: completed episodes
+    return_sum: jnp.ndarray  # [B] per-env sum of completed-episode returns
+    episode_count: jnp.ndarray  # [B] per-env completed-episode count
 
 
 class DeviceActorLearnerLoop:
@@ -52,15 +57,119 @@ class DeviceActorLearnerLoop:
         learn_fn: Callable[[ImpalaTrainState, Trajectory], Tuple[ImpalaTrainState, Dict]],
         unroll_length: int,
         iters_per_call: int = 10,
+        mesh=None,
+        axis_name: str = "dp",
     ) -> None:
+        """``mesh``: shard the fused loop data-parallel over a mesh — env
+        lanes and actor carry split along ``axis_name``, params replicated,
+        gradients ``psum``-ed inside the learn step (pass a ``learn_fn``
+        built with ``grad_axis=axis_name``).  This is the Podracer "Anakin"
+        architecture; ``venv.num_envs`` must divide by the axis size."""
         self.model = model
         self.venv = venv
         self.learn_fn = learn_fn
         self.unroll_length = unroll_length
         self.iters_per_call = iters_per_call
-        self._train_many = jax.jit(
-            partial(self._train_many_impl), donate_argnums=(0, 1)
-        )
+        self.mesh = mesh
+        self.axis_name = axis_name
+        if mesh is None:
+            self._train_many = jax.jit(
+                partial(self._train_many_impl), donate_argnums=(0, 1)
+            )
+        else:
+            n = mesh.shape[axis_name]
+            if venv.num_envs % n != 0:
+                raise ValueError(
+                    f"num_envs ({venv.num_envs}) must divide by mesh axis "
+                    f"{axis_name!r} size ({n})"
+                )
+            self._sharded_fn = None  # built on first call (needs pytree structure)
+            self._train_many = self._sharded_train_many
+
+    # ------------------------------------------------------------------
+    def _sharded_train_many(self, state, carry, key):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        if self._sharded_fn is None:
+            axis = self.axis_name
+
+            def leaf_spec(x):
+                if getattr(x, "ndim", 0) >= 1:
+                    return P(axis, *([None] * (x.ndim - 1)))
+                return P()
+
+            state_spec = jax.tree_util.tree_map(lambda x: P(), state)
+            carry_spec = jax.tree_util.tree_map(leaf_spec, carry)
+
+            def inner(state, carry, key):
+                # distinct randomness per shard: fold the device's ring index
+                key = jax.random.fold_in(
+                    key, jax.lax.axis_index(self.axis_name)
+                )
+                return self._train_many_impl(state, carry, key)
+
+            def inner_synced(state, carry, key):
+                state, carry, metrics = inner(state, carry, key)
+                # monitoring sums fused into the step (a host-side jnp.sum
+                # per chunk would cost an extra dispatch each)
+                metrics["episode_return_sum"] = jax.lax.psum(
+                    jnp.sum(carry.return_sum), axis
+                )
+                metrics["episode_count_sum"] = jax.lax.psum(
+                    jnp.sum(carry.episode_count), axis
+                )
+                return state, carry, metrics
+
+            fn = shard_map(
+                inner_synced,
+                mesh=self.mesh,
+                in_specs=(state_spec, carry_spec, P()),
+                # metrics were pmean-ed inside the learn step -> replicated
+                out_specs=(state_spec, carry_spec, P()),
+                check_rep=False,
+            )
+            self._sharded_fn = jax.jit(fn, donate_argnums=(0, 1))
+            # check_rep=False disables the replication check, so a learn_fn
+            # built WITHOUT grad_axis would silently train each shard on its
+            # own grads; verify the traced program psums over our axis
+            self._assert_grad_synced(fn, state, carry, key)
+        return self._sharded_fn(state, carry, key)
+
+    def _assert_grad_synced(self, fn, state, carry, key) -> None:
+        """Fail fast if the sharded step contains no psum over ``axis_name``
+        beyond the two monitoring sums (i.e. the learn_fn does not sync
+        gradients).  Introspection best-effort: jax-internals changes skip
+        the check rather than break the loop."""
+        try:
+            jaxpr = jax.make_jaxpr(fn)(state, carry, key)
+
+            def count_psums(jxp) -> int:
+                n = 0
+                for eqn in jxp.eqns:
+                    if eqn.primitive.name == "psum" and self.axis_name in (
+                        eqn.params.get("axes") or ()
+                    ):
+                        n += 1
+                    for v in eqn.params.values():
+                        inner_jaxpr = getattr(v, "jaxpr", v)
+                        if hasattr(inner_jaxpr, "eqns"):
+                            n += count_psums(inner_jaxpr)
+                return n
+
+            n_psums = count_psums(jaxpr.jaxpr)
+        except Exception:  # noqa: BLE001 — introspection only
+            return
+        # monitoring contributes exactly 2; the learn step must add more
+        # (grad pmean lowers to psum, plus the shard-count psum)
+        if n_psums <= 2:
+            raise ValueError(
+                "mesh mode needs a gradient-synchronized learn_fn: build it "
+                f"with grad_axis={self.axis_name!r} (e.g. "
+                "agent.make_learn_fn(grad_axis=...)); the traced step "
+                "contains no gradient psum over the mesh axis, so each "
+                "device would train on its own shard only"
+            )
 
     # ------------------------------------------------------------------
     def init_carry(self, key: jax.Array) -> ActorCarry:
@@ -74,8 +183,8 @@ class DeviceActorLearnerLoop:
             done=jnp.ones(B, jnp.bool_),
             core_state=self.model.initial_state(B),
             episode_return=jnp.zeros(B, jnp.float32),
-            return_sum=jnp.zeros((), jnp.float32),
-            episode_count=jnp.zeros((), jnp.float32),
+            return_sum=jnp.zeros(B, jnp.float32),
+            episode_count=jnp.zeros(B, jnp.float32),
         )
 
     # ------------------------------------------------------------------
@@ -105,8 +214,8 @@ class DeviceActorLearnerLoop:
                 done=done,
                 core_state=new_core,
                 episode_return=jnp.where(done, 0.0, ep_ret),
-                return_sum=c.return_sum + jnp.sum(jnp.where(done, ep_ret, 0.0)),
-                episode_count=c.episode_count + jnp.sum(done),
+                return_sum=c.return_sum + jnp.where(done, ep_ret, 0.0),
+                episode_count=c.episode_count + done.astype(jnp.float32),
             )
             return new_c, row
 
@@ -140,6 +249,10 @@ class DeviceActorLearnerLoop:
             one_iter, (state, carry), jax.random.split(key, self.iters_per_call)
         )
         mean_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
+        # monitoring sums ride the fused program (shard-local here; the mesh
+        # wrapper overwrites them with the psum-ed globals)
+        mean_metrics["episode_return_sum"] = jnp.sum(carry.return_sum)
+        mean_metrics["episode_count_sum"] = jnp.sum(carry.episode_count)
         return state, carry, mean_metrics
 
     # ------------------------------------------------------------------
@@ -172,8 +285,8 @@ class DeviceActorLearnerLoop:
         ``windowed_return`` / ``frames`` / ``hit``.
         """
         frames_per_call = self.unroll_length * self.venv.num_envs * self.iters_per_call
-        prev_sum = float(carry.return_sum)
-        prev_cnt = float(carry.episode_count)
+        prev_sum = float(jnp.sum(carry.return_sum))
+        prev_cnt = float(jnp.sum(carry.episode_count))
         windowed = float("nan")
         frames = 0
         hit = False
@@ -181,7 +294,9 @@ class DeviceActorLearnerLoop:
             key, sub = jax.random.split(key)
             state, carry, m = self.train_chunk(state, carry, sub)
             frames += frames_per_call
-            s, c = float(carry.return_sum), float(carry.episode_count)
+            # the sums ride the fused metrics — no extra host dispatches
+            s = float(m["episode_return_sum"])
+            c = float(m["episode_count_sum"])
             if c > prev_cnt:
                 windowed = (s - prev_sum) / (c - prev_cnt)
                 prev_sum, prev_cnt = s, c
@@ -209,15 +324,18 @@ class DeviceActorLearnerLoop:
             state, carry, dev_metrics = self.train_chunk(state, carry, sub)
             if on_metrics is not None:
                 metrics = {k: float(v) for k, v in dev_metrics.items()}
-                metrics["episodes"] = float(carry.episode_count)
-                metrics["return_mean"] = float(
-                    carry.return_sum / jnp.maximum(carry.episode_count, 1.0)
+                metrics["episodes"] = metrics.pop("episode_count_sum")
+                metrics["return_mean"] = metrics.pop("episode_return_sum") / max(
+                    metrics["episodes"], 1.0
                 )
                 on_metrics(i, metrics)
         jax.block_until_ready(state.params)
         if not metrics:
             metrics = {
-                "episodes": float(carry.episode_count),
-                "return_mean": float(carry.return_sum / max(float(carry.episode_count), 1.0)),
+                "episodes": float(jnp.sum(carry.episode_count)),
+                "return_mean": float(
+                    jnp.sum(carry.return_sum)
+                    / max(float(jnp.sum(carry.episode_count)), 1.0)
+                ),
             }
         return state, carry, metrics

@@ -381,18 +381,26 @@ class DeviceActorLearnerTrainer(BaseTrainer):
         agent: ImpalaAgent,
         venv,
         iters_per_call: int = 10,
+        mesh=None,
         run_name: Optional[str] = None,
     ) -> None:
+        """``mesh``: run the fused loop data-parallel (Anakin) — env lanes
+        sharded over the mesh's ``dp`` axis, params replicated, gradients
+        psum-ed inside the fused step."""
         super().__init__(args, run_name=run_name)
         from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
 
         self.agent = agent
+        # the agent owns the loss hyperparameters — never rebuild from the
+        # trainer's args (which may be a different object)
+        learn_fn = agent.make_learn_fn(grad_axis="dp" if mesh is not None else None)
         self.loop = DeviceActorLearnerLoop(
             model=agent.model,
             venv=venv,
-            learn_fn=agent._learn.__wrapped__ if hasattr(agent._learn, "__wrapped__") else agent._learn,
+            learn_fn=learn_fn,
             unroll_length=args.rollout_length,
             iters_per_call=iters_per_call,
+            mesh=mesh,
         )
 
     def _resume_pytree(self) -> Dict:

@@ -120,12 +120,14 @@ def test_device_loop_cartpole_learns():
     carry = loop.init_carry(key)
     state = agent.state
     state, carry, _ = loop.run(state, carry, key, num_calls=1)
-    early_return = float(carry.return_sum / jnp.maximum(carry.episode_count, 1))
+    early_return = float(
+        jnp.sum(carry.return_sum) / jnp.maximum(jnp.sum(carry.episode_count), 1)
+    )
     # train more
     state, carry, _ = loop.run(state, carry, jax.random.PRNGKey(1), num_calls=8)
     late = carry
     late_return = float(
-        (late.return_sum) / jnp.maximum(late.episode_count, 1)
+        jnp.sum(late.return_sum) / jnp.maximum(jnp.sum(late.episode_count), 1)
     )
     assert int(state.step) == 9 * 20
     assert np.isfinite(late_return)
