@@ -40,7 +40,7 @@ def _run_measurement() -> None:
     import jax
     import jax.numpy as jnp  # noqa: F401
 
-    from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
+    from scalerl_tpu.agents.impala import ImpalaAgent
     from scalerl_tpu.config import ImpalaArguments
     from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
     from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
@@ -61,11 +61,16 @@ def _run_measurement() -> None:
         rollout_length=T,
         batch_size=B,
         max_timesteps=0,
+        # mixed precision on accelerators: conv/dense torso in bfloat16 feeds
+        # the MXU at full rate; params, V-trace, and the optimizer stay f32
+        # (standard IMPALA mixed-precision recipe, tested in
+        # tests/test_impala.py::test_impala_bfloat16_compute_dtype)
+        compute_dtype="bfloat16" if on_accel else "float32",
     )
     env = SyntheticPixelEnv()
     venv = JaxVecEnv(env, num_envs=B)
     agent = ImpalaAgent(args, obs_shape=env.observation_shape, num_actions=env.num_actions)
-    learn = make_impala_learn_fn(agent.model, agent.optimizer, args)
+    learn = agent.make_learn_fn()
     loop = DeviceActorLearnerLoop(
         model=agent.model,
         venv=venv,

@@ -96,7 +96,7 @@ def _run_fused_to_threshold(
     """Shared scaffold: fused device-loop IMPALA on a device-native env,
     trained until the windowed return crosses ``threshold``, curve logged
     to TensorBoard, summary row returned."""
-    from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
+    from scalerl_tpu.agents.impala import ImpalaAgent
     from scalerl_tpu.config import ImpalaArguments
     from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
     from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
@@ -114,7 +114,7 @@ def _run_fused_to_threshold(
     agent = ImpalaAgent(
         args, obs_shape=env.observation_shape, num_actions=env.num_actions
     )
-    learn = make_impala_learn_fn(agent.model, agent.optimizer, args)
+    learn = agent.make_learn_fn()
     loop = DeviceActorLearnerLoop(
         agent.model, venv, learn, unroll, iters_per_call=iters_per_call
     )

@@ -40,7 +40,7 @@ def main() -> None:
 
         mesh = None
         if args.mesh_shape:
-            # Anakin: env lanes sharded over dp, grads pmean-ed in the
+            # Anakin: env lanes sharded over dp, grads psum-ed in the
             # fused step (the only axis that makes sense for this path)
             from scalerl_tpu.parallel import make_mesh
 

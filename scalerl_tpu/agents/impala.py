@@ -54,7 +54,14 @@ def impala_loss(
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """The IMPALA objective over one [T+1, B] trajectory chunk."""
+    """The IMPALA objective over one [T+1, B] trajectory chunk.
+
+    Metric-name contract: keys prefixed ``mean_`` are true means over the
+    chunk; every other key sums over the batch (the reference's loss
+    convention).  ``make_impala_learn_fn`` relies on the prefix to pick the
+    cross-shard collective (pmean vs psum) under a dp mesh — name new
+    metrics accordingly.
+    """
     out, _ = model.apply(
         params, traj.obs, traj.action, traj.reward, traj.done, traj.core_state
     )
@@ -111,8 +118,10 @@ def make_impala_learn_fn(
     reference delegated to NCCL (``dqn_agent.py:173-174`` capability).
     ``psum``, not ``pmean``: the loss sums over the batch (reference
     convention), so summing shard gradients makes dp=N at global batch B
-    numerically identical to a single device at batch B.  Metrics are
-    ``pmean``-ed (they are per-shard aggregates for logging).
+    numerically identical to a single device at batch B.  Metrics follow
+    their own conventions: sum-over-batch losses are ``psum``-ed (so logged
+    curves match the single-device value at the same global batch), true
+    means are ``pmean``-ed.
     """
 
     def learn(state: ImpalaTrainState, traj: Trajectory):
@@ -130,7 +139,16 @@ def make_impala_learn_fn(
         n_shards = 1
         if grad_axis is not None:
             grads = jax.lax.psum(grads, grad_axis)
-            metrics = jax.lax.pmean(metrics, grad_axis)
+            # the metric NAME encodes its collective (impala_loss contract):
+            # "mean_*" are true means -> pmean; everything else sums over the
+            # batch -> psum, so each shard's sum over B/n lanes aggregates to
+            # the same value a single device reports at the global batch
+            metrics = {
+                k: jax.lax.pmean(v, grad_axis)
+                if k.startswith("mean_")
+                else jax.lax.psum(v, grad_axis)
+                for k, v in metrics.items()
+            }
             n_shards = jax.lax.psum(1, grad_axis)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

@@ -125,50 +125,68 @@ class DeviceActorLearnerLoop:
                 inner_synced,
                 mesh=self.mesh,
                 in_specs=(state_spec, carry_spec, P()),
-                # metrics were pmean-ed inside the learn step -> replicated
+                # metrics leave the learn step replicated (sum-convention
+                # losses psum-ed, mean_* pmean-ed — impala_loss contract)
                 out_specs=(state_spec, carry_spec, P()),
                 check_rep=False,
             )
-            self._sharded_fn = jax.jit(fn, donate_argnums=(0, 1))
             # check_rep=False disables the replication check, so a learn_fn
             # built WITHOUT grad_axis would silently train each shard on its
-            # own grads; verify the traced program psums over our axis
-            self._assert_grad_synced(fn, state, carry, key)
+            # own grads; verify the traced program psums over our axis.
+            # Trace `inner` (pre-monitoring) so the check is independent of
+            # how many monitoring psums `inner_synced` adds, and cache only
+            # after the check passes — a caller that catches the error and
+            # retries must not get an unsynced cached fn.
+            probe = shard_map(
+                inner,
+                mesh=self.mesh,
+                in_specs=(state_spec, carry_spec, P()),
+                out_specs=(state_spec, carry_spec, P()),
+                check_rep=False,
+            )
+            self._assert_grad_synced(probe, state, carry, key)
+            self._sharded_fn = jax.jit(fn, donate_argnums=(0, 1))
         return self._sharded_fn(state, carry, key)
 
     def _assert_grad_synced(self, fn, state, carry, key) -> None:
-        """Fail fast if the sharded step contains no psum over ``axis_name``
-        beyond the two monitoring sums (i.e. the learn_fn does not sync
-        gradients).  Introspection best-effort: jax-internals changes skip
-        the check rather than break the loop."""
+        """Fail fast if the sharded step has no *gradient-sized* psum over
+        ``axis_name``.  ``fn`` must be the pre-monitoring program — the
+        caller passes a probe without the monitoring psums.  Heuristic:
+        gradient syncs psum *arrays* (param leaves: kernels, biases), while
+        metric/counter psums carry scalars — so require at least one psum
+        over the axis with an operand of rank >= 1.  A learn_fn that psums
+        only scalar metrics still fails the check.  Best-effort:
+        jax-internals changes skip the check rather than break the loop."""
         try:
             jaxpr = jax.make_jaxpr(fn)(state, carry, key)
 
-            def count_psums(jxp) -> int:
+            def count_array_psums(jxp) -> int:
                 n = 0
                 for eqn in jxp.eqns:
-                    if eqn.primitive.name == "psum" and self.axis_name in (
-                        eqn.params.get("axes") or ()
+                    if (
+                        eqn.primitive.name == "psum"
+                        and self.axis_name in (eqn.params.get("axes") or ())
+                        and any(
+                            getattr(v.aval, "ndim", 0) >= 1 for v in eqn.invars
+                        )
                     ):
                         n += 1
                     for v in eqn.params.values():
                         inner_jaxpr = getattr(v, "jaxpr", v)
                         if hasattr(inner_jaxpr, "eqns"):
-                            n += count_psums(inner_jaxpr)
+                            n += count_array_psums(inner_jaxpr)
                 return n
 
-            n_psums = count_psums(jaxpr.jaxpr)
+            n_psums = count_array_psums(jaxpr.jaxpr)
         except Exception:  # noqa: BLE001 — introspection only
             return
-        # monitoring contributes exactly 2; the learn step must add more
-        # (grad pmean lowers to psum, plus the shard-count psum)
-        if n_psums <= 2:
+        if n_psums == 0:
             raise ValueError(
                 "mesh mode needs a gradient-synchronized learn_fn: build it "
                 f"with grad_axis={self.axis_name!r} (e.g. "
                 "agent.make_learn_fn(grad_axis=...)); the traced step "
-                "contains no gradient psum over the mesh axis, so each "
-                "device would train on its own shard only"
+                "contains no array-valued (gradient-sized) psum over the "
+                "mesh axis, so each device would train on its own shard only"
             )
 
     # ------------------------------------------------------------------

@@ -222,7 +222,7 @@ def test_agent_enable_mesh_matches_unsharded():
 
 def test_fused_device_loop_dp_mesh():
     """Anakin-style fused loop: env lanes sharded over dp, params
-    replicated, gradients pmean-ed inside the fused step; the env-frames
+    replicated, gradients psum-ed inside the fused step; the env-frames
     counter sees all shards."""
     import jax
     import jax.numpy as jnp
@@ -305,7 +305,7 @@ def test_grad_axis_psum_matches_single_device():
     )
 
     plain = make_impala_learn_fn(agent.model, agent.optimizer, args)
-    state_single, _ = jax.jit(plain)(agent.state, traj)
+    state_single, m_single = jax.jit(plain)(agent.state, traj)
 
     mesh = make_mesh("dp=8")
     synced = make_impala_learn_fn(agent.model, agent.optimizer, args, grad_axis="dp")
@@ -320,10 +320,20 @@ def test_grad_axis_psum_matches_single_device():
         out_specs=(state_spec, P()),
         check_rep=False,
     )
-    state_sharded, _ = jax.jit(fn)(agent.state, traj)
+    state_sharded, m_sharded = jax.jit(fn)(agent.state, traj)
 
     for a, b in zip(
         jax.tree_util.tree_leaves(state_single.params),
         jax.tree_util.tree_leaves(state_sharded.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
+
+    # logged metrics match too: sum-convention losses are psum-ed across
+    # shards (each shard sums over B/n lanes), true means pmean-ed — so a
+    # dp=8 loss curve is directly comparable to the single-device run
+    for k in ("total_loss", "pg_loss", "baseline_loss", "entropy_loss",
+              "mean_value", "mean_reward"):
+        np.testing.assert_allclose(
+            float(m_sharded[k]), float(m_single[k]), rtol=1e-4,
+            err_msg=f"metric {k} diverges between dp=8 and single device",
+        )
