@@ -1,0 +1,63 @@
+"""PPO on CartPole (beyond-parity family; see ``scalerl_tpu/agents/ppo.py``).
+
+Runs on the same on-policy runtime as A3C (``trainer/on_policy.py``): a
+vector-env actor fleet with central batched inference feeding fused
+epochs x minibatch clipped-surrogate updates.  DD-PPO over a mesh:
+``--mesh-shape "dp=8"`` data-parallels the learner with per-minibatch
+gradient all-reduce.
+
+Usage::
+
+    python examples/train_ppo.py --env-id CartPole-v1 --max-timesteps 100000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import PPOAgent
+from scalerl_tpu.config import PPOArguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OnPolicyTrainer
+
+
+def main() -> None:
+    args = parse_args(PPOArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    train_envs = make_vect_envs(
+        args.env_id,
+        num_envs=args.num_workers,
+        seed=args.seed,
+        normalize_obs=args.normalize_obs,
+    )
+    eval_envs = make_vect_envs(
+        args.env_id,
+        num_envs=2,
+        seed=args.seed + 1,
+        async_envs=False,
+        normalize_obs=args.normalize_obs,
+    )
+    agent = PPOAgent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        num_actions=train_envs.single_action_space.n,
+    )
+    if args.mesh_shape:
+        agent.enable_mesh(args.mesh_shape)
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        train_envs.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

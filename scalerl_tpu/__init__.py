@@ -24,7 +24,7 @@ Package layout
 - ``parallel`` — mesh construction, sharded train steps, multi-host bring-up
 - ``runtime``  — actor-learner runtime: rollout queues, inference server,
                  parameter server, TCP transport, worker fleet
-- ``agents``   — DQN, A3C/A2C, IMPALA, Ape-X agents
+- ``agents``   — DQN, A3C/A2C, PPO, IMPALA, Ape-X agents
 - ``trainer``  — trainer loops (off-policy, actor-learner)
 """
 
@@ -35,6 +35,7 @@ from scalerl_tpu.config import (  # noqa: F401
     ApexArguments,
     DQNArguments,
     ImpalaArguments,
+    PPOArguments,
     RLArguments,
     parse_args,
 )

@@ -2,3 +2,4 @@ from scalerl_tpu.agents.base import BaseAgent  # noqa: F401
 from scalerl_tpu.agents.dqn import DQNAgent, DQNTrainState  # noqa: F401
 from scalerl_tpu.agents.a3c import A3CAgent, A3CTrainState  # noqa: F401
 from scalerl_tpu.agents.impala import ImpalaAgent, ImpalaTrainState  # noqa: F401
+from scalerl_tpu.agents.ppo import PPOAgent, PPOTrainState  # noqa: F401

@@ -1,0 +1,252 @@
+"""PPO: clipped-surrogate policy optimization on the on-policy runtime.
+
+Beyond-parity algorithm family: the reference implements A3C/DQN/Ape-X/
+IMPALA and cites DD-PPO in its architecture bibliography (``README.md:
+21-53``) without shipping an implementation.  This module completes the
+on-policy runtime (``trainer/on_policy.py`` — the same rollout collection
+A3C uses; the trajectory's ``logits`` rows double as the behavior policy)
+with the PPO update:
+
+- GAE advantages and value targets are computed ONCE per rollout chunk from
+  the pre-update policy, then ``ppo_epochs`` passes of ``num_minibatches``
+  clipped-surrogate steps run as a single ``lax.scan`` — one XLA program
+  per chunk, no per-minibatch host dispatch.
+- Minibatches split over env *lanes* (full ``[T+1]`` sequences), never over
+  time, so recurrent policies replay each lane from its stored entering
+  LSTM state exactly as collected (recurrent-PPO-safe shuffling).
+- The lane shuffle is deterministic from ``state.step`` (``fold_in``), so
+  the learn fn stays a pure ``(state, traj) -> (state, metrics)`` function
+  — resumable, jittable, and mesh-shardable unchanged.
+
+DD-PPO on TPU = ``agent.enable_mesh("dp=N")``: the pjit'd learner runs the
+whole epochs x minibatch schedule data-parallel with gradient all-reduce
+per minibatch step — decentralized-distributed PPO (Wijmans et al. 2020)
+without a parameter server, numerically identical to the single-device
+update at the same global batch (the shuffle permutes the *global* lane
+axis).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.a3c import build_model as build_policy_value_model
+from scalerl_tpu.agents.policy_value import PolicyValueAgent, frames_counter
+from scalerl_tpu.config import PPOArguments
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.ops.losses import clipped_surrogate_loss, entropy_loss
+from scalerl_tpu.ops.returns import gae_advantages
+
+
+@struct.dataclass
+class PPOTrainState:
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    env_frames: jnp.ndarray
+
+
+def _taken_logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    """log pi(a|s) of the taken actions: logits [T, B, A], actions [T, B]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1).squeeze(-1)
+
+
+def ppo_loss(
+    params,
+    model,
+    mb: Dict[str, Any],
+    clip_range: float,
+    clip_range_vf: float,
+    value_loss_coef: float,
+    entropy_coef: float,
+    normalize_advantage: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped surrogate + (optionally clipped) value loss + entropy bonus
+    over one lane minibatch (full sequences, ``[T+1, b]`` rows).
+
+    ``mb`` carries the trajectory rows plus the chunk-level precomputations:
+    ``advantages`` / ``value_targets`` (GAE under the pre-update policy),
+    ``behavior_logp`` (collection-time), and ``old_values`` (for the
+    PPO2-style value clip).  Sum convention over [T, b] for the losses,
+    ``mean_*`` for diagnostics — the metric-name contract of
+    ``agents/impala.py``.
+    """
+    out, _ = model.apply(
+        params, mb["obs"], mb["action"], mb["reward"], mb["done"], mb["core_state"]
+    )
+    logits = out.policy_logits[:-1]  # [T, b, A]
+    values_new = out.baseline[:-1]  # [T, b]
+    actions_taken = mb["action"][1:]
+
+    adv = mb["advantages"]
+    if normalize_advantage:
+        adv = (adv - jnp.mean(adv)) / (jnp.std(adv) + 1e-8)
+
+    new_logp = _taken_logp(logits, actions_taken)
+    pg, aux = clipped_surrogate_loss(new_logp, mb["behavior_logp"], adv, clip_range)
+
+    vs = jax.lax.stop_gradient(mb["value_targets"])
+    if clip_range_vf > 0.0:
+        # PPO2 value clip: bound the value update around the pre-update
+        # prediction, pessimistically taking the worse of the two errors
+        v_old = jax.lax.stop_gradient(mb["old_values"])
+        v_clipped = v_old + jnp.clip(values_new - v_old, -clip_range_vf, clip_range_vf)
+        vl = 0.5 * jnp.sum(
+            jnp.maximum(
+                jnp.square(values_new - vs), jnp.square(v_clipped - vs)
+            )
+        )
+    else:
+        vl = 0.5 * jnp.sum(jnp.square(values_new - vs))
+    vl = value_loss_coef * vl
+    ent = entropy_coef * entropy_loss(logits)
+
+    total = pg + vl + ent
+    metrics = {
+        "total_loss": total,
+        "pg_loss": pg,
+        "value_loss": vl,
+        "entropy_loss": ent,
+        "mean_value": jnp.mean(values_new),
+        "mean_advantage": jnp.mean(mb["advantages"]),
+        **aux,
+    }
+    return total, metrics
+
+
+def make_ppo_learn_fn(
+    model, optimizer: optax.GradientTransformation, args: PPOArguments
+) -> Callable:
+    """Build the pure (state, traj) -> (state, metrics) PPO update.
+
+    One call consumes one ``[T+1, B]`` on-policy chunk and runs the full
+    ``ppo_epochs x num_minibatches`` schedule as a ``lax.scan`` over lane
+    slabs.  Logged loss metrics are the mean over the scanned minibatch
+    updates (each itself sum-convention over its [T, B/M] elements).
+    """
+
+    def learn(state: PPOTrainState, traj: Trajectory):
+        T1, B = traj.reward.shape
+        T = T1 - 1
+        M = args.num_minibatches
+        mb_lanes = B // M
+
+        # ---- chunk-level precomputation under the pre-update policy ----
+        out, _ = model.apply(
+            state.params, traj.obs, traj.action, traj.reward, traj.done,
+            traj.core_state,
+        )
+        values = jax.lax.stop_gradient(out.baseline)  # [T+1, B]
+        rewards = traj.reward[1:]
+        discounts = args.gamma * (1.0 - traj.done[1:].astype(jnp.float32))
+        advantages, value_targets = gae_advantages(
+            rewards, discounts, values[:-1], values[-1], lambda_=args.gae_lambda
+        )
+        advantages = jax.lax.stop_gradient(advantages)
+        behavior_logp = _taken_logp(traj.logits[:-1], traj.action[1:])
+
+        # ---- deterministic lane shuffle per epoch (pure fn of step) ----
+        key = jax.random.fold_in(jax.random.PRNGKey(args.seed), state.step)
+        perms = jax.vmap(lambda k: jax.random.permutation(k, B))(
+            jax.random.split(key, args.ppo_epochs)
+        )  # [E, B]
+        lane_slabs = perms.reshape(args.ppo_epochs * M, mb_lanes)
+
+        def take_lanes(x, lanes, axis):
+            return jnp.take(x, lanes, axis=axis)
+
+        def mb_step(carry, lanes):
+            params, opt_state = carry
+            mb = {
+                "obs": take_lanes(traj.obs, lanes, 1),
+                "action": take_lanes(traj.action, lanes, 1),
+                "reward": take_lanes(traj.reward, lanes, 1),
+                "done": take_lanes(traj.done, lanes, 1),
+                "core_state": jax.tree_util.tree_map(
+                    lambda x: take_lanes(x, lanes, 0), traj.core_state
+                ),
+                "advantages": take_lanes(advantages, lanes, 1),
+                "value_targets": take_lanes(value_targets, lanes, 1),
+                "behavior_logp": take_lanes(behavior_logp, lanes, 1),
+                "old_values": take_lanes(values[:-1], lanes, 1),
+            }
+            (_, metrics), grads = jax.value_and_grad(ppo_loss, has_aux=True)(
+                params,
+                model,
+                mb,
+                clip_range=args.clip_range,
+                clip_range_vf=args.clip_range_vf,
+                value_loss_coef=args.value_loss_coef,
+                entropy_coef=args.entropy_coef,
+                normalize_advantage=args.normalize_advantage,
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return (params, opt_state), metrics
+
+        (params, opt_state), scanned = jax.lax.scan(
+            mb_step, (state.params, state.opt_state), lane_slabs
+        )
+        metrics = {k: jnp.mean(v) for k, v in scanned.items()}
+        new_state = PPOTrainState(
+            params=params,
+            opt_state=opt_state,
+            step=state.step + 1,
+            env_frames=state.env_frames + T * B,
+        )
+        return new_state, metrics
+
+    return learn
+
+
+def make_ppo_optimizer(args: PPOArguments) -> optax.GradientTransformation:
+    """Adam + global-norm clip (the standard PPO recipe; clip 0.5)."""
+    return optax.chain(
+        optax.clip_by_global_norm(args.max_grad_norm),
+        optax.adam(args.learning_rate),
+    )
+
+
+class PPOAgent(PolicyValueAgent):
+    """Host-facing PPO agent: jitted act + fused epochs/minibatch learn.
+
+    Drops into ``trainer/on_policy.py`` unchanged (same act/learn surface
+    as A3C); the model zoo is shared with A3C (``agents/a3c.py``
+    ``build_model``: MLP for flat obs, conv[+LSTM] AtariNet for pixels).
+    """
+
+    def __init__(
+        self,
+        args: PPOArguments,
+        obs_shape: Tuple[int, ...],
+        num_actions: int,
+        obs_dtype=jnp.float32,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        model = build_policy_value_model(args, obs_shape, num_actions)
+        optimizer = make_ppo_optimizer(args)
+        self._setup(
+            model=model,
+            optimizer=optimizer,
+            make_state=lambda params, opt_state: PPOTrainState(
+                params=params,
+                opt_state=opt_state,
+                step=jnp.zeros((), jnp.int32),
+                env_frames=frames_counter(),
+            ),
+            learn_fn=make_ppo_learn_fn(model, optimizer, args),
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=obs_dtype,
+            seed=args.seed,
+            key=key,
+        )

@@ -177,6 +177,55 @@ class A3CArguments(RLArguments):
 
 
 @dataclass
+class PPOArguments(RLArguments):
+    """PPO options (beyond-parity algorithm family).
+
+    The reference ships A3C/DQN/Ape-X/IMPALA and lists DD-PPO in its
+    architecture bibliography (``README.md:21-53``) without implementing it;
+    this schema drives the PPO agent (``agents/ppo.py``) on the same
+    on-policy runtime as A3C.  Data-parallel PPO over a mesh
+    (``agent.enable_mesh``) is the DD-PPO topology: every chip runs the
+    full epochs x minibatches schedule with gradients all-reduced per
+    minibatch step.
+    """
+
+    algo_name: str = "ppo"
+    num_workers: int = 8
+    # Clipped-surrogate objective
+    clip_range: float = 0.2
+    clip_range_vf: float = 0.0  # 0 disables value clipping
+    ppo_epochs: int = 4
+    num_minibatches: int = 4  # minibatches per epoch, split over env lanes
+    gae_lambda: float = 0.95
+    value_loss_coef: float = 0.5
+    entropy_coef: float = 0.01
+    normalize_advantage: bool = True
+    # Model (same zoo as A3C: MLP for flat obs, conv[+LSTM] for pixels)
+    hidden_sizes: str = "128,128"
+    use_lstm: bool = False
+    hidden_size: int = 256
+    max_episode_steps: int = 500
+    max_grad_norm: float = 0.5
+    normalize_obs: bool = False
+    normalized_init: bool = False
+
+    def validate(self) -> None:
+        super().validate()
+        if self.num_minibatches <= 0:
+            raise ValueError(
+                f"num_minibatches must be positive, got {self.num_minibatches}"
+            )
+        if self.num_workers % self.num_minibatches != 0:
+            raise ValueError(
+                "minibatches split over env lanes (full sequences, so LSTM "
+                f"carries stay valid): num_workers ({self.num_workers}) must "
+                f"divide by num_minibatches ({self.num_minibatches})"
+            )
+        if self.ppo_epochs <= 0:
+            raise ValueError(f"ppo_epochs must be positive, got {self.ppo_epochs}")
+
+
+@dataclass
 class ImpalaArguments(RLArguments):
     """IMPALA options: the complete schema the reference never declared.
 

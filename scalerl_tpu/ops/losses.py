@@ -41,6 +41,38 @@ def policy_gradient_loss(
     return jnp.sum(nll * jax.lax.stop_gradient(advantages))
 
 
+def clipped_surrogate_loss(
+    new_logp: jnp.ndarray,
+    behavior_logp: jnp.ndarray,
+    advantages: jnp.ndarray,
+    clip_range: float,
+) -> Tuple[jnp.ndarray, dict]:
+    """PPO clipped surrogate objective (Schulman et al. 2017, eq. 7).
+
+    Sum convention over ``[T, B]`` like the other policy losses here.
+    Advantages are detached; ``behavior_logp`` is the collection-time log
+    probability of the taken action.  Returns ``(loss, aux)`` where aux
+    holds detached diagnostics (``mean_ratio`` / ``mean_approx_kl`` — the
+    low-variance k3 estimator ``E[(r-1) - log r]`` — / ``mean_clip_frac``),
+    named per the ``mean_*`` metric contract (``agents/impala.py``).
+    """
+    log_ratio = new_logp - jax.lax.stop_gradient(behavior_logp)
+    ratio = jnp.exp(log_ratio)
+    adv = jax.lax.stop_gradient(advantages)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_range, 1.0 + clip_range) * adv
+    loss = -jnp.sum(jnp.minimum(unclipped, clipped))
+    aux = {
+        "mean_ratio": jnp.mean(ratio),
+        "mean_approx_kl": jnp.mean((ratio - 1.0) - log_ratio),
+        "mean_clip_frac": jnp.mean(
+            (jnp.abs(ratio - 1.0) > clip_range).astype(jnp.float32)
+        ),
+    }
+    aux = {k: jax.lax.stop_gradient(v) for k, v in aux.items()}
+    return loss, aux
+
+
 def double_dqn_targets(
     q_next_online: jnp.ndarray,
     q_next_target: jnp.ndarray,
