@@ -349,6 +349,69 @@ def a3c_cartpole(
 
 
 # ----------------------------------------------------------------------
+def ppo_cartpole(
+    num_envs: int = 8,
+    max_frames: int = 300_000,
+    threshold: float = 400.0,
+    seed: int = 5,
+):
+    """PPO (fused epochs x minibatch clipped surrogate) on the same
+    on-policy runtime as A3C, to a CartPole eval threshold."""
+    from scalerl_tpu.agents.ppo import PPOAgent
+    from scalerl_tpu.config import PPOArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer import OnPolicyTrainer
+
+    args = PPOArguments(
+        env_id="CartPole-v1",
+        rollout_length=32,
+        num_workers=num_envs,
+        num_minibatches=4,
+        ppo_epochs=4,
+        hidden_sizes="64,64",
+        learning_rate=3e-4,
+        entropy_coef=0.01,
+        gae_lambda=0.95,
+        gamma=0.99,
+        seed=seed,
+        max_timesteps=max_frames,
+        eval_frequency=10**9,
+        logger_frequency=2_000,
+        logger_backend="tensorboard",
+        work_dir=str(OUT_DIR),
+        project="",
+        save_model=False,
+        normalize_obs=False,
+    )
+    train_envs = make_vect_envs(
+        "CartPole-v1", num_envs=num_envs, seed=seed, async_envs=False
+    )
+    eval_envs = make_vect_envs("CartPole-v1", num_envs=4, seed=seed + 99, async_envs=False)
+    agent = PPOAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=np.float32)
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs, run_name="ppo_cartpole")
+    t0 = time.time()
+    trainer.run()
+    ev = trainer.run_evaluate_episodes(n_episodes=10)
+    wall = time.time() - t0
+    hit = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
+    trainer.close()
+    train_envs.close()
+    eval_envs.close()
+    return {
+        "experiment": "ppo_cartpole",
+        "env": "CartPole-v1",
+        "algo": "PPO (fused minibatch epochs, on-policy runtime)",
+        "threshold": threshold,
+        "final_return": round(ev["reward_mean"], 2),
+        "frames": trainer.global_step,
+        "frames_to_threshold": hit,
+        "wall_s": round(wall, 1),
+        "fps": round(trainer.global_step / wall, 1),
+        "passed": ev["reward_mean"] >= threshold,
+    }
+
+
+# ----------------------------------------------------------------------
 def dqn_cartpole(
     num_envs: int = 4,
     max_frames: int = 300_000,
@@ -429,6 +492,7 @@ EXPERIMENTS = {
     "impala_catch": impala_catch,
     "impala_cartpole": impala_cartpole,
     "a3c_cartpole": a3c_cartpole,
+    "ppo_cartpole": ppo_cartpole,
     "dqn_cartpole": dqn_cartpole,
 }
 

@@ -36,11 +36,13 @@ import optax
 from flax import struct
 
 from scalerl_tpu.agents.a3c import build_model as build_policy_value_model
+from scalerl_tpu.agents.a3c import make_a3c_optimizer
 from scalerl_tpu.agents.policy_value import PolicyValueAgent, frames_counter
 from scalerl_tpu.config import PPOArguments
 from scalerl_tpu.data.trajectory import Trajectory
 from scalerl_tpu.ops.losses import clipped_surrogate_loss, entropy_loss
 from scalerl_tpu.ops.returns import gae_advantages
+from scalerl_tpu.ops.vtrace import action_log_probs
 
 
 @struct.dataclass
@@ -49,12 +51,6 @@ class PPOTrainState:
     opt_state: Any
     step: jnp.ndarray
     env_frames: jnp.ndarray
-
-
-def _taken_logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
-    """log pi(a|s) of the taken actions: logits [T, B, A], actions [T, B]."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return jnp.take_along_axis(logp, actions[..., None], axis=-1).squeeze(-1)
 
 
 def ppo_loss(
@@ -88,7 +84,7 @@ def ppo_loss(
     if normalize_advantage:
         adv = (adv - jnp.mean(adv)) / (jnp.std(adv) + 1e-8)
 
-    new_logp = _taken_logp(logits, actions_taken)
+    new_logp = action_log_probs(logits, actions_taken)
     pg, aux = clipped_surrogate_loss(new_logp, mb["behavior_logp"], adv, clip_range)
 
     vs = jax.lax.stop_gradient(mb["value_targets"])
@@ -135,6 +131,14 @@ def make_ppo_learn_fn(
         T1, B = traj.reward.shape
         T = T1 - 1
         M = args.num_minibatches
+        if B % M != 0:
+            # validate() checks args.num_workers, but the runtime batch comes
+            # from the env fleet and can disagree — fail here with a clear
+            # message instead of a cryptic trace-time reshape error
+            raise ValueError(
+                f"trajectory batch ({B} env lanes) must divide by "
+                f"num_minibatches ({M})"
+            )
         mb_lanes = B // M
 
         # ---- chunk-level precomputation under the pre-update policy ----
@@ -149,7 +153,7 @@ def make_ppo_learn_fn(
             rewards, discounts, values[:-1], values[-1], lambda_=args.gae_lambda
         )
         advantages = jax.lax.stop_gradient(advantages)
-        behavior_logp = _taken_logp(traj.logits[:-1], traj.action[1:])
+        behavior_logp = action_log_probs(traj.logits[:-1], traj.action[1:])
 
         # ---- deterministic lane shuffle per epoch (pure fn of step) ----
         key = jax.random.fold_in(jax.random.PRNGKey(args.seed), state.step)
@@ -207,11 +211,10 @@ def make_ppo_learn_fn(
 
 
 def make_ppo_optimizer(args: PPOArguments) -> optax.GradientTransformation:
-    """Adam + global-norm clip (the standard PPO recipe; clip 0.5)."""
-    return optax.chain(
-        optax.clip_by_global_norm(args.max_grad_norm),
-        optax.adam(args.learning_rate),
-    )
+    """Adam + global-norm clip (the standard PPO recipe; clip 0.5) — the
+    same shared recipe as A3C, reused so the two on-policy agents cannot
+    silently diverge."""
+    return make_a3c_optimizer(args)
 
 
 class PPOAgent(PolicyValueAgent):

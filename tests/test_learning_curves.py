@@ -122,3 +122,44 @@ def test_impala_fused_loop_learns_synthetic_pixels():
         agent.state, carry, k_run, threshold=threshold, max_calls=120
     )
     assert summary["hit"], f"windowed return {summary['windowed_return']} < {threshold}"
+
+
+@pytest.mark.slow
+def test_ppo_learns_cartpole(tmp_path):
+    """~120k frames of fused-epoch PPO should far exceed random (~20).
+    (PPO at lr 3e-4 crosses later than A2C's 60k budget — the recorded
+    curve hits the 400 threshold at ~139k frames; this shortened form
+    checks clear learning progress, not the full threshold.)"""
+    from scalerl_tpu.agents.ppo import PPOAgent
+    from scalerl_tpu.config import PPOArguments
+    from scalerl_tpu.trainer import OnPolicyTrainer
+
+    args = PPOArguments(
+        env_id="CartPole-v1",
+        rollout_length=32,
+        num_workers=8,
+        num_minibatches=4,
+        ppo_epochs=4,
+        hidden_sizes="64,64",
+        learning_rate=3e-4,
+        entropy_coef=0.01,
+        gae_lambda=0.95,
+        gamma=0.99,
+        seed=1,
+        max_timesteps=120_000,
+        eval_frequency=10**9,
+        logger_frequency=10**9,
+        logger_backend="none",
+        work_dir=str(tmp_path),
+        save_model=False,
+    )
+    train_envs = make_vect_envs("CartPole-v1", num_envs=8, seed=1, async_envs=False)
+    eval_envs = make_vect_envs("CartPole-v1", num_envs=4, seed=99, async_envs=False)
+    agent = PPOAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=np.float32)
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs)
+    trainer.run()
+    ev = trainer.run_evaluate_episodes(n_episodes=5)
+    assert ev["reward_mean"] > 120, f"did not learn: {ev}"
+    trainer.close()
+    train_envs.close()
+    eval_envs.close()

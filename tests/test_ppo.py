@@ -231,3 +231,32 @@ def test_ppo_trainer_cartpole_smoke(tmp_path):
     finally:
         trainer.close()
         envs.close()
+
+
+def test_ppo_fused_device_loop():
+    """PPO's learn fn drops into the fused device loop (Anakin-style
+    device-native PPO, a la Brax): env step + inference + the full
+    epochs x minibatch schedule in one XLA program."""
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
+    from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+
+    T, B = 4, 4
+    args = _args(
+        rollout_length=T, num_workers=B, num_minibatches=2, ppo_epochs=2,
+        use_lstm=False,
+    )
+    env = SyntheticPixelEnv(size=16)
+    venv = JaxVecEnv(env, num_envs=B)
+    agent = PPOAgent(
+        args, obs_shape=env.observation_shape, num_actions=env.num_actions,
+        obs_dtype=jnp.uint8,
+    )
+    learn = make_ppo_learn_fn(agent.model, agent.optimizer, args)
+    loop = DeviceActorLearnerLoop(agent.model, venv, learn, T, iters_per_call=2)
+    carry = loop.init_carry(jax.random.PRNGKey(0))
+    state, carry, m = loop.train_chunk(agent.state, carry, jax.random.PRNGKey(1))
+    assert int(state.step) == 2
+    assert int(state.env_frames) == 2 * T * B
+    loss = float(m["total_loss"])
+    assert loss == loss
