@@ -46,7 +46,12 @@ def _run_measurement() -> None:
     from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
     from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
 
-    platform = jax.default_backend()
+    from scalerl_tpu.utils.platform import setup_platform
+
+    # backend already pinned by __main__ when --cpu; "auto" here just turns
+    # on the persistent compilation cache (warm relaunches skip the 20-40 s
+    # TPU compile of the fused loop)
+    platform = setup_platform("auto")
     # batch/unroll sized for one chip (swept: B=512/iters=5 beats B=128/10
     # by ~21% — bigger batches keep the MXU busy between infeed boundaries);
     # CPU fallback shrinks to stay quick

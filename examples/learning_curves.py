@@ -16,6 +16,8 @@ Experiments (all CPU-runnable; the same code paths serve the TPU):
 - ``impala_cartpole``   — host actor plane (SEED-style) on CartPole to a
   return threshold; also records host-path frames/sec.
 - ``a3c_cartpole``      — on-policy A2C runtime on CartPole.
+- ``ppo_cartpole``      — PPO (fused epochs x minibatch clipped surrogate)
+  on the same on-policy runtime.
 - ``dqn_cartpole``      — off-policy trainer (double DQN) on CartPole,
   final greedy eval over 10 episodes.
 

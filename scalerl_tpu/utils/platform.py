@@ -7,15 +7,51 @@ plugin registers regardless), so ``--platform cpu`` must go through
 
 from __future__ import annotations
 
+import os
 
-def setup_platform(platform: str = "auto") -> str:
+
+def setup_platform(
+    platform: str = "auto", compilation_cache: bool = True
+) -> str:
     """Pin the JAX backend. Call before any jax array/computation is created.
 
     ``auto`` keeps JAX's default (TPU when present).  Returns the backend
     actually in use.
+
+    ``compilation_cache`` enables JAX's persistent compilation cache
+    (``~/.cache/scalerl_tpu_xla`` unless ``JAX_COMPILATION_CACHE_DIR`` is
+    set) on accelerator backends: TPU first-compiles of the fused loop run
+    20-40 s, and every entry script re-traces the same programs — the cache
+    turns relaunch compiles into disk reads.  CPU is deliberately excluded:
+    XLA:CPU caches AOT machine code whose recorded target features can
+    mismatch the loading host (the loader warns about possible SIGILL).
+    Disable with ``compilation_cache=False`` or
+    ``SCALERL_NO_COMPILATION_CACHE=1``.
     """
     import jax
 
     if platform and platform != "auto":
         jax.config.update("jax_platforms", platform)
-    return jax.default_backend()
+    backend = jax.default_backend()
+    if (
+        compilation_cache
+        and backend in ("tpu", "gpu")
+        and not os.environ.get("SCALERL_NO_COMPILATION_CACHE")
+    ):
+        cache_dir = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "scalerl_tpu_xla"),
+        )
+        try:
+            # jax's default min-compile-time threshold (~1 s) stays: the
+            # expensive fused-loop compiles clear it, and trivial programs
+            # don't bloat the cache dir
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            import warnings
+
+            warnings.warn(
+                f"persistent compilation cache unavailable ({e}); "
+                "relaunches will pay full XLA compile times"
+            )
+    return backend
