@@ -48,7 +48,7 @@ class RolloutQueue:
         """Take a free slot index (None on shutdown)."""
         while not self._closed.is_set():
             try:
-                return self.free.get(timeout=timeout if timeout else 0.1)
+                return self.free.get(timeout=0.1 if timeout is None else timeout)
             except queue.Empty:
                 if timeout is not None:
                     return None
@@ -79,27 +79,38 @@ class RolloutQueue:
         immediately after this returns is also safe).
         """
         idxs: List[int] = []
-        while len(idxs) < batch_size:
-            self._check_error()
-            try:
-                idxs.append(self.full.get(timeout=timeout if timeout else 0.5))
-            except queue.Empty:
-                if self._closed.is_set():
-                    self._check_error()
-                    raise RuntimeError("rollout queue closed")
-                if timeout is not None:
-                    raise TimeoutError(
-                        f"get_batch: only {len(idxs)}/{batch_size} slots ready"
+        try:
+            while len(idxs) < batch_size:
+                self._check_error()
+                try:
+                    idxs.append(
+                        self.full.get(timeout=0.5 if timeout is None else timeout)
                     )
-        batch = {
-            # core-state keys describe row 0 only: batch axis is 0; the
-            # time-major fields batch on axis 1
-            k: np.concatenate(
-                [self.slots[i][k] for i in idxs],
-                axis=0 if k.startswith("core_") else 1,
-            )
-            for k in self.slots[idxs[0]].keys()
-        }
+                except queue.Empty:
+                    if self._closed.is_set():
+                        self._check_error()
+                        raise RuntimeError("rollout queue closed")
+                    if timeout is not None:
+                        raise TimeoutError(
+                            f"get_batch: only {len(idxs)}/{batch_size} slots ready"
+                        )
+            batch = {
+                # core-state keys describe row 0 only: batch axis is 0; the
+                # time-major fields batch on axis 1
+                k: np.concatenate(
+                    [self.slots[i][k] for i in idxs],
+                    axis=0 if k.startswith("core_") else 1,
+                )
+                for k in self.slots[idxs[0]].keys()
+            }
+        except BaseException:
+            # any exit (error funnel, timeout, close, KeyboardInterrupt,
+            # a bad slot in the batch build): the drained slots are still
+            # full and unconsumed — hand them back, or the pool leaks one
+            # slot per exit until acquire() deadlocks
+            for i in idxs:
+                self.full.put(i)
+            raise
         return batch, idxs
 
     def recycle(self, idxs: List[int]) -> None:

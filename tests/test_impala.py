@@ -152,6 +152,21 @@ def test_rollout_queue_batching():
     assert traj.core_state == ()
 
 
+def test_rollout_queue_timeout_returns_drained_slots():
+    """A partial get_batch that times out must hand its drained slots back
+    to the full queue — otherwise every timeout leaks a slot until the
+    pool deadlocks."""
+    spec = TrajectorySpec(unroll_length=2, batch_size=1, obs_shape=(4,), num_actions=2)
+    q = RolloutQueue(spec, num_slots=2)
+    i1 = q.acquire()
+    q.commit(i1)
+    with pytest.raises(TimeoutError):
+        q.get_batch(2, timeout=0.2)  # only 1 slot full
+    # the drained slot is back: a 1-slot batch succeeds immediately
+    batch, idxs = q.get_batch(1, timeout=0.5)
+    assert idxs == [i1]
+
+
 def test_rollout_queue_error_funnel():
     spec = TrajectorySpec(unroll_length=2, batch_size=1, obs_shape=(4,), num_actions=2)
     q = RolloutQueue(spec, num_slots=2)
