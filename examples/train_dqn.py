@@ -32,6 +32,10 @@ def main() -> None:
         obs_shape=train_envs.single_observation_space.shape,
         action_dim=train_envs.single_action_space.n,
     )
+    if args.mesh_shape:
+        # DDP DQN (the reference's accelerate_config.yaml topology):
+        # batch sharded over the mesh, gradients all-reduced by GSPMD
+        agent.enable_mesh(args.mesh_shape)
     trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs)
     try:
         summary = trainer.run()

@@ -9,10 +9,10 @@ PER importance weights, checkpoint save/load.  TPU-shaped design:
   with donated state, so the update runs in-place in HBM.
 - The reference's ``accelerator.prepare``/``backward`` DDP machinery
   (``dqn_agent.py:194-198,173-174``) is replaced by constructing the train
-  step under ``jax.jit`` — to data-parallelize, the same function is
-  ``pjit``-ed over a mesh with the batch axis sharded (see
-  ``scalerl_tpu.parallel``): gradients then all-reduce over ICI with zero
-  code changes here.
+  step under ``jax.jit``; ``DQNAgent.enable_mesh`` pjit-s the same learn
+  core over a device mesh with the batch axis sharded (see
+  ``scalerl_tpu.parallel``) — gradients then all-reduce over ICI, the DDP
+  capability as one method call.
 - Target-net updates are pure pytree ops inside the step (no host sync).
 """
 
@@ -325,6 +325,11 @@ class DQNAgent(BaseAgent):
                 soft_update_tau=args.soft_update_tau,
                 target_update_frequency=args.target_update_frequency,
             )
+        self._learn_raw = learn_fn  # un-jitted, for enable_mesh re-wrap
+        self._donate_state = donate_state
+        self._shard_batch = None
+        self._learn_mesh = None
+        self.mesh = None
         self._learn = jax.jit(
             learn_fn, donate_argnums=(0,) if donate_state else ()
         )
@@ -373,8 +378,51 @@ class DQNAgent(BaseAgent):
         self.eps = self.eps_scheduler.step(num_env_steps)
         return self.eps
 
+    def enable_mesh(self, mesh_or_spec) -> None:
+        """Data-parallel learn over a mesh — the reference's one *working*
+        distributed path (Accelerate/DDP DQN, ``dqn_agent.py:194-198`` +
+        ``accelerate_config.yaml``), as a pjit: the batch dim shards over
+        ``dp×fsdp``, big params over ``fsdp/tp`` where divisible, GSPMD
+        all-reduces gradients over ICI, and the per-sample |TD| vector
+        comes back replicated for PER priority feedback.  Call once before
+        training; numerically identical to the single-device update at the
+        same global batch (asserted by test)."""
+        from scalerl_tpu.parallel import make_parallel_learn_fn, resolve_mesh
+
+        mesh = resolve_mesh(mesh_or_spec)
+        n_batch_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
+        if self.args.batch_size % n_batch_shards != 0:
+            raise ValueError(
+                f"batch_size ({self.args.batch_size}) must divide by the "
+                f"mesh's dp*fsdp extent ({n_batch_shards}) to shard the "
+                "replay batch"
+            )
+        raw = self._learn_raw
+
+        def two_out(state, batch):
+            # make_parallel_learn_fn expects (state, batch) -> (state, aux);
+            # fold the per-sample |TD| into the aux pytree
+            state, metrics, td_abs = raw(state, batch)
+            return state, (metrics, td_abs)
+
+        plearn = make_parallel_learn_fn(
+            two_out,
+            mesh,
+            self.state,
+            batch_time_major=False,  # replay batches are [B, ...]
+            donate_state=self._donate_state,
+        )
+        self.mesh = mesh
+        self.state = plearn.shard_state(self.state)
+        self._shard_batch = plearn.shard_batch
+        self._learn_mesh = plearn
+
     def learn(self, batch: Mapping[str, Any]) -> Dict[str, float]:
-        self.state, metrics, td_abs = self._learn(self.state, dict(batch))
+        if self._learn_mesh is not None:
+            sharded = self._shard_batch(dict(batch))
+            self.state, (metrics, td_abs) = self._learn_mesh(self.state, sharded)
+        else:
+            self.state, metrics, td_abs = self._learn(self.state, dict(batch))
         out = {k: float(v) for k, v in metrics.items()}
         out["td_abs"] = td_abs  # device array, for PER priority feedback
         out["eps"] = self.eps

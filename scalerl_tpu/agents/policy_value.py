@@ -130,15 +130,9 @@ class PolicyValueAgent(BaseAgent):
         path): batch over dp×fsdp, params/opt state over fsdp/tp where
         divisible, gradient psum inserted by GSPMD.  Call once, before
         training; subsequent ``learn()`` calls shard incoming batches."""
-        from jax.sharding import Mesh
+        from scalerl_tpu.parallel import make_parallel_learn_fn, resolve_mesh
 
-        from scalerl_tpu.parallel import make_mesh, make_parallel_learn_fn
-
-        mesh = (
-            mesh_or_spec
-            if isinstance(mesh_or_spec, Mesh)
-            else make_mesh(mesh_or_spec)
-        )
+        mesh = resolve_mesh(mesh_or_spec)
         plearn = make_parallel_learn_fn(
             self._learn_fn, mesh, self.state, batch_example=batch_example
         )

@@ -24,6 +24,7 @@ drop in without re-plumbing.
 
 from scalerl_tpu.parallel.mesh import (  # noqa: F401
     AXIS_NAMES,
+    resolve_mesh,
     MeshSpec,
     make_mesh,
 )

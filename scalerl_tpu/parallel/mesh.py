@@ -83,3 +83,11 @@ def make_mesh(
     shape = tuple(sizes.get(a, 1) for a in AXIS_NAMES)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_NAMES)
+
+
+def resolve_mesh(mesh_or_spec) -> Mesh:
+    """A ``Mesh`` passes through; a spec string (or ``None``) builds one —
+    the one resolution rule shared by every ``enable_mesh`` entry point."""
+    if isinstance(mesh_or_spec, Mesh):
+        return mesh_or_spec
+    return make_mesh(mesh_or_spec)

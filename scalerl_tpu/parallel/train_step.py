@@ -68,12 +68,21 @@ def make_parallel_learn_fn(
     def shard_state(state: Any) -> Any:
         return jax.device_put(state, st_sh)
 
+    # batch sharding depends only on the pytree structure and per-leaf
+    # ranks (batch_sharding_tree reads ndim + path, never sizes), so cache
+    # it — replay/trajectory batches have a fixed shape after the first
+    # sample and the hot learner loop calls shard_batch every step
+    _sh_cache: dict = {}
+
     def shard_batch(batch: Any) -> Any:
-        sh = (
-            data_sh
-            if data_sh is not None
-            else batch_sharding_tree(batch, mesh, time_major=batch_time_major)
-        )
+        if data_sh is not None:
+            return jax.device_put(batch, data_sh)
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        key = (treedef, tuple(getattr(x, "ndim", 0) for x in leaves))
+        sh = _sh_cache.get(key)
+        if sh is None:
+            sh = batch_sharding_tree(batch, mesh, time_major=batch_time_major)
+            _sh_cache[key] = sh
         return jax.device_put(batch, sh)
 
     jitted.shard_state = shard_state  # type: ignore[attr-defined]
