@@ -80,17 +80,6 @@ def per_add_with_priorities(
     return state.replace(replay=replay, priorities=new_prio, max_priority=new_max)
 
 
-def _flat_physical(state: PrioritizedState, flat_logical: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Map flat logical indices (row-major over [logical_row, env]) to
-    physical (row, env)."""
-    capacity, num_envs = state.priorities.shape
-    start = _logical_start(state.replay, capacity)
-    logical = flat_logical // num_envs
-    envs = flat_logical % num_envs
-    rows = (start + logical) % capacity
-    return rows, envs
-
-
 def per_sample(
     state: PrioritizedState,
     key: jax.Array,
@@ -139,15 +128,27 @@ def per_sample(
     envs = flat_logical % num_envs
     batch = gather_transitions(state.replay, logical, envs, n_step, gamma)
     batch["weights"] = weights
+    # batch["indices"] (from gather_transitions) is the flat PHYSICAL slot:
+    # stable across interleaved inserts, so a priority update that races
+    # adds still writes the rows it sampled (a stale write to an
+    # overwritten row is benign — the OpenAI-baselines contract)
     return batch
 
 
 def per_update_priorities(
     state: PrioritizedState,
-    flat_logical: jnp.ndarray,  # [B] as returned in batch["indices"]
+    flat_physical: jnp.ndarray,  # [B] as returned in batch["indices"]
     priorities: jnp.ndarray,  # [B] new raw priorities (e.g. |td| + eps)
 ) -> PrioritizedState:
-    rows, envs = _flat_physical(state, flat_logical)
+    """Scatter new priorities at the sampled PHYSICAL slots.
+
+    ``batch["indices"]`` is physical (see ``per_sample``), so this stays
+    correct even when inserts landed between sample and update — the
+    failure mode a logical-index contract would have had.
+    """
+    num_envs = state.priorities.shape[1]
+    rows = flat_physical // num_envs
+    envs = flat_physical % num_envs
     priorities = jnp.maximum(priorities, 1e-6)
     new_prio = state.priorities.at[rows, envs].set(priorities)
     new_max = jnp.maximum(state.max_priority, jnp.max(priorities))

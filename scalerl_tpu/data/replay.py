@@ -153,7 +153,11 @@ def gather_transitions(
         "next_obs": state.storage["next_obs"][row_last, envs],
         "done": done_n,
         "n_steps": (last_idx + 1).astype(jnp.int32),
-        "indices": logical * num_envs + envs,  # flat logical index
+        # flat PHYSICAL index (row-major over [row, env]): physical rows
+        # don't shift when later adds advance the logical start, so the
+        # index stays addressable across interleaved inserts (the PER
+        # priority-update contract, data/prioritized.py)
+        "indices": row0 * num_envs + envs,
     }
     # Extra storage fields (beyond the standard five) pass through, gathered
     # at the window head; a stored field may override a computed key — e.g.

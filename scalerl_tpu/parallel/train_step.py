@@ -74,8 +74,32 @@ def make_parallel_learn_fn(
     # sample and the hot learner loop calls shard_batch every step
     _sh_cache: dict = {}
 
+    def _check_divisible(batch: Any, sh: Any) -> None:
+        # fail fast with an actionable message instead of an opaque XLA
+        # "dimension not divisible" error at the first learn step
+        def chk(x, s):
+            spec = getattr(s, "spec", None)
+            if spec is None or not hasattr(x, "shape"):
+                return
+            for d, axes in enumerate(spec):
+                if axes is None:
+                    continue
+                names = (axes,) if isinstance(axes, str) else tuple(axes)
+                extent = 1
+                for a in names:
+                    extent *= mesh.shape[a]
+                if extent > 1 and x.shape[d] % extent != 0:
+                    raise ValueError(
+                        f"batch dim {d} of size {x.shape[d]} must divide by "
+                        f"the mesh extent {extent} (axes {names}) to shard; "
+                        "adjust batch_size/num_envs or the mesh shape"
+                    )
+
+        jax.tree_util.tree_map(chk, batch, sh)
+
     def shard_batch(batch: Any) -> Any:
         if data_sh is not None:
+            _check_divisible(batch, data_sh)
             return jax.device_put(batch, data_sh)
         leaves, treedef = jax.tree_util.tree_flatten(batch)
         key = (treedef, tuple(getattr(x, "ndim", 0) for x in leaves))
@@ -83,6 +107,7 @@ def make_parallel_learn_fn(
         if sh is None:
             sh = batch_sharding_tree(batch, mesh, time_major=batch_time_major)
             _sh_cache[key] = sh
+        _check_divisible(batch, sh)
         return jax.device_put(batch, sh)
 
     jitted.shard_state = shard_state  # type: ignore[attr-defined]

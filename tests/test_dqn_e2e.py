@@ -247,5 +247,5 @@ def test_dqn_enable_mesh_matches_unsharded(tmp_path):
     bad = DQNAgent(
         _mk_args(str(tmp_path), batch_size=100), obs_shape=(4,), action_dim=2
     )
-    with pytest.raises(ValueError, match="dp\*fsdp"):
+    with pytest.raises(ValueError, match=r"dp\*fsdp"):
         bad.enable_mesh("dp=8")
