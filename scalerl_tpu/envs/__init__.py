@@ -12,6 +12,7 @@ from scalerl_tpu.envs.gym_env import (  # noqa: F401
 from scalerl_tpu.envs.jax_envs import (  # noqa: F401
     JaxCartPole,
     JaxCatch,
+    JaxRecall,
     JaxVecEnv,
     SyntheticPixelEnv,
     make_jax_vec_env,

@@ -83,6 +83,7 @@ class JaxVecEnv:
 def make_jax_vec_env(env_id: str, num_envs: int, **kwargs) -> JaxVecEnv:
     from scalerl_tpu.envs.jax_envs.cartpole import JaxCartPole
     from scalerl_tpu.envs.jax_envs.catch import JaxCatch
+    from scalerl_tpu.envs.jax_envs.recall import JaxRecall
     from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
 
     registry = {
@@ -90,6 +91,7 @@ def make_jax_vec_env(env_id: str, num_envs: int, **kwargs) -> JaxVecEnv:
         "CartPole-v0": lambda: JaxCartPole(max_steps=200),
         "SyntheticPixel-v0": lambda: SyntheticPixelEnv(**kwargs),
         "Catch-v0": lambda: JaxCatch(**kwargs),
+        "Recall-v0": lambda: JaxRecall(**kwargs),
     }
     if env_id not in registry:
         raise KeyError(

@@ -1,0 +1,92 @@
+"""Device-native delayed-recall task: the recurrent-learning litmus test.
+
+A cue (one of ``num_actions`` quadrant patterns) flashes in the FIRST frame
+only; ``delay`` blank frames follow; at the final step the agent must output
+the action matching the cue (+1 correct, -1 wrong).  Expected return of any
+memoryless policy is ``2/num_actions - 1`` (−0.5 at 4 actions), so crossing
+a high threshold *requires* the policy to carry the cue through the blank
+frames — this is the to-convergence evidence for the done-masked LSTM carry
+(``models/atari.py`` ``_LSTMCore``) inside the fused device loop, which the
+Catch/Synthetic curves (feed-forward torsos) cannot provide.
+
+Same protocol as the other ``envs/jax_envs`` tasks (reset/step pure fns,
+auto-reset on done); observations are ``[size, size, 1]`` uint8 frames so
+the standard Atari conv torso applies unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.envs.jax_envs.base import JaxEnv
+
+
+class RecallState(NamedTuple):
+    cue: jnp.ndarray  # int32 in [0, num_actions)
+    t: jnp.ndarray  # int32 step counter
+
+
+class JaxRecall(JaxEnv):
+    """Flash a quadrant cue, wait ``delay`` blank steps, demand recall."""
+
+    def __init__(self, size: int = 16, delay: int = 6, num_cues: int = 4) -> None:
+        if num_cues not in (2, 4):
+            raise ValueError("num_cues must be 2 or 4 (quadrant patterns)")
+        self.size = size
+        self.delay = delay
+        self.num_cues = num_cues
+
+    @property
+    def observation_shape(self) -> Tuple[int, ...]:
+        return (self.size, self.size, 1)
+
+    @property
+    def observation_dtype(self):
+        return jnp.uint8
+
+    @property
+    def num_actions(self) -> int:
+        return self.num_cues
+
+    def _render(self, state: RecallState) -> jnp.ndarray:
+        half = self.size // 2
+        rows = jnp.arange(self.size)[:, None]
+        cols = jnp.arange(self.size)[None, :]
+        # quadrant q: (row half, col half) = (q // 2, q % 2); with 2 cues the
+        # pattern uses left/right halves only
+        if self.num_cues == 4:
+            in_q = ((rows >= half) == (state.cue // 2)) & (
+                (cols >= half) == (state.cue % 2)
+            )
+        else:
+            in_q = (cols >= half) == (state.cue % 2)
+        frame = jnp.where((state.t == 0) & in_q, 255, 0).astype(jnp.uint8)
+        return frame[:, :, None]
+
+    def _spawn(self, key: jax.Array) -> RecallState:
+        return RecallState(
+            cue=jax.random.randint(key, (), 0, self.num_cues),
+            t=jnp.zeros((), jnp.int32),
+        )
+
+    def reset(self, key: jax.Array):
+        state = self._spawn(key)
+        return state, self._render(state)
+
+    def step(self, state: RecallState, action: jnp.ndarray, key: jax.Array):
+        t = state.t + 1
+        done = t > self.delay  # episode = 1 cue frame + delay blanks
+        reward = jnp.where(
+            done,
+            jnp.where(action.astype(jnp.int32) == state.cue, 1.0, -1.0),
+            0.0,
+        ).astype(jnp.float32)
+        next_state = RecallState(state.cue, t)
+        respawn = self._spawn(key)
+        new_state = jax.tree_util.tree_map(
+            lambda r, n: jnp.where(done, r, n), respawn, next_state
+        )
+        return new_state, self._render(new_state), reward, done

@@ -247,3 +247,31 @@ def test_make_gym_env_normalize_obs_flag():
     obs, _ = env.reset(seed=0)
     assert obs.shape == (4,) and np.all(np.isfinite(obs))
     env.close()
+
+
+def test_jax_recall_env_dynamics():
+    """Cue visible only in frame 0; reward fires at the final step for the
+    action matching the cue; auto-reset renders the next cue."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalerl_tpu.envs import JaxRecall
+
+    env = JaxRecall(size=16, delay=3, num_cues=4)
+    state, obs = env.reset(jax.random.PRNGKey(0))
+    cue = int(state.cue)
+    assert obs.shape == (16, 16, 1) and obs.dtype == jnp.uint8
+    assert int(obs.max()) == 255  # cue frame
+    # quadrant pattern identifies the cue uniquely
+    for t in range(3):
+        state, obs, r, d = env.step(state, jnp.asarray(0), jax.random.PRNGKey(t + 1))
+        assert int(obs.max()) == 0  # blank during the delay
+        assert float(r) == 0.0 and not bool(d)
+    # final step: correct action -> +1
+    s2, obs2, r2, d2 = env.step(state, jnp.asarray(cue), jax.random.PRNGKey(99))
+    assert bool(d2) and float(r2) == 1.0
+    assert int(obs2.max()) == 255  # auto-reset shows the next cue
+    # wrong action -> -1
+    wrong = (cue + 1) % 4
+    _, _, r3, d3 = env.step(state, jnp.asarray(wrong), jax.random.PRNGKey(100))
+    assert bool(d3) and float(r3) == -1.0

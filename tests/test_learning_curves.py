@@ -163,3 +163,34 @@ def test_ppo_learns_cartpole(tmp_path):
     trainer.close()
     train_envs.close()
     eval_envs.close()
+
+
+@pytest.mark.slow
+def test_impala_lstm_learns_delayed_recall():
+    """Recurrent learning regression: delayed-recall is unsolvable without
+    memory (memoryless ceiling = -0.5 expected return), so crossing 0.5
+    proves the done-masked LSTM carry trains end to end in the fused loop."""
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.envs import JaxRecall
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+
+    env = JaxRecall(size=16, delay=6, num_cues=4)
+    B, T, I = 32, 8, 5
+    args = ImpalaArguments(
+        use_lstm=True, hidden_size=64, rollout_length=T, batch_size=B,
+        max_timesteps=0, learning_rate=1e-3, entropy_cost=0.02,
+    )
+    venv = JaxVecEnv(env, B)
+    agent = ImpalaAgent(args, obs_shape=env.observation_shape,
+                        num_actions=env.num_actions)
+    loop = DeviceActorLearnerLoop(
+        agent.model, venv, agent.make_learn_fn(), T, iters_per_call=I
+    )
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    carry = loop.init_carry(k1)
+    _, _, summary = loop.run_until(
+        agent.state, carry, k2, threshold=0.5, max_calls=180
+    )
+    assert summary["hit"], f"LSTM failed to recall: {summary}"
