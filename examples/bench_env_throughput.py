@@ -163,8 +163,19 @@ def main() -> None:
     parser.add_argument("--num-envs", type=int, default=8)
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--stacks", nargs="*", default=list(STACKS))
+    # the jax stacks touch the default backend; "cpu" pins them off a
+    # wedged TPU tunnel (which would hang the first jax call), "auto"
+    # benches the accelerator when it is healthy
+    parser.add_argument("--platform", default="auto")
     args = parser.parse_args()
 
+    if args.platform != "auto":
+        # only pin on request: "auto" must not force backend init here, or
+        # a gym-stacks-only run would hang on a wedged TPU tunnel before
+        # benchmarking anything (the jax stacks init the backend lazily)
+        from scalerl_tpu.utils.platform import setup_platform
+
+        setup_platform(args.platform)
     print(f"env throughput: num_envs={args.num_envs} steps={args.steps}")
     results = {}
     for name in args.stacks:

@@ -83,7 +83,15 @@ def main() -> None:
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--publish-every", type=int, default=10)
     parser.add_argument("--eps", type=float, default=0.2)
+    # "cpu" pins the learner off the TPU plugin (under the axon tunnel a
+    # wedged backend hangs the first jax call indefinitely); pass "auto"
+    # to put the learner on the accelerator
+    parser.add_argument("--platform", default="cpu")
     args = parser.parse_args()
+
+    from scalerl_tpu.utils.platform import setup_platform
+
+    setup_platform(args.platform)
 
     import jax
 
