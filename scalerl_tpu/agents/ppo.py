@@ -225,6 +225,13 @@ class PPOAgent(PolicyValueAgent):
     ``build_model``: MLP for flat obs, conv[+LSTM] AtariNet for pixels).
     """
 
+    def make_learn_fn(self):
+        """Learn fn from *this agent's* model/optimizer/args — callers (the
+        fused-loop experiments/tests) must not re-derive hyperparameters
+        from a possibly-different args object (parity with
+        ``ImpalaAgent.make_learn_fn``)."""
+        return make_ppo_learn_fn(self.model, self.optimizer, self.args)
+
     def __init__(
         self,
         args: PPOArguments,
