@@ -57,12 +57,18 @@ def bench_async_gym(num_envs: int, steps: int) -> float:
     return steps * num_envs / dt
 
 
-def bench_shm_single(num_envs: int, steps: int) -> float:
+def _make_cartpole():
+    # module-level: under auto-spawn (JAX live in this process after the
+    # jax-vec stack runs) the factory must pickle into env workers
     import gymnasium as gym
 
+    return gym.make("CartPole-v1")
+
+
+def bench_shm_single(num_envs: int, steps: int) -> float:
     from scalerl_tpu.envs import make_shared_vec_envs
 
-    vec = make_shared_vec_envs(lambda: gym.make("CartPole-v1"), num_envs)
+    vec = make_shared_vec_envs(_make_cartpole, num_envs)
     vec.reset(seed=0)
     actions = {"agent_0": np.zeros(num_envs, np.int64)}
     t0 = time.perf_counter()

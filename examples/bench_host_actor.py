@@ -43,77 +43,24 @@ if "--cpu" in sys.argv:
 import numpy as np
 
 
-class PixelRingEnv:
-    """Gym-API synthetic pixel env (numpy twin of ``SyntheticPixelEnv``):
-    pre-rendered [84,84,4] uint8 frames per ring cell, so ``step`` costs an
-    index lookup and the measurement isolates the training pipeline."""
-
-    metadata: dict = {}
-    render_mode = None
-    spec = None
-
-    def __init__(self, size: int = 84, stack: int = 4, num_actions: int = 6,
-                 num_states: int = 16, episode_length: int = 128) -> None:
-        import gymnasium as gym
-
-        self.observation_space = gym.spaces.Box(0, 255, (size, size, stack), np.uint8)
-        self.action_space = gym.spaces.Discrete(num_actions)
-        self.num_states = num_states
-        self.num_actions = num_actions
-        self.episode_length = episode_length
-        # pre-render through the real jax env's renderer so the two stay in
-        # lockstep (this class only re-implements the *dynamics* in numpy)
-        import jax.numpy as jnp
-
-        from scalerl_tpu.envs import SyntheticPixelEnv
-
-        ref = SyntheticPixelEnv(
-            size=size, stack=stack, num_actions=num_actions,
-            num_states=num_states, episode_length=episode_length,
-        )
-        self._frames = np.stack(
-            [np.asarray(ref._render(jnp.asarray(c))) for c in range(num_states)]
-        )
-        self._rng = np.random.default_rng(0)
-        self._cell = 0
-        self._t = 0
-
-    def reset(self, *, seed=None, options=None):
-        if seed is not None:
-            self._rng = np.random.default_rng(seed)
-        self._cell = int(self._rng.integers(self.num_states))
-        self._t = 0
-        return self._frames[self._cell], {}
-
-    def step(self, action):
-        correct = int(action) == (self._cell % self.num_actions)
-        reward = float(correct)
-        if correct:
-            self._cell = (self._cell + 1) % self.num_states
-        else:
-            self._cell = int(self._rng.integers(self.num_states))
-        self._t += 1
-        done = self._t >= self.episode_length
-        if done:
-            self._cell = int(self._rng.integers(self.num_states))
-            self._t = 0
-        return self._frames[self._cell], reward, done, False, {}
-
-    def close(self):
-        pass
+from scalerl_tpu.envs.synthetic_gym import PixelRingEnv  # noqa: E402 — kept importable here
 
 
-def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int) -> dict:
+def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int,
+               mode: str = "threads") -> dict:
     import gymnasium as gym
 
     from scalerl_tpu.agents.impala import ImpalaAgent
     from scalerl_tpu.config import ImpalaArguments
     from scalerl_tpu.envs import make_vect_envs
     from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+    from scalerl_tpu.trainer.process_actor_learner import (
+        ProcessActorLearnerTrainer,
+    )
 
     pixels = kind == "pixels"
     args = ImpalaArguments(
-        env_id="PixelRing" if pixels else "CartPole-v1",
+        env_id="PixelRing-v0" if pixels else "CartPole-v1",
         rollout_length=20 if pixels else 16,
         batch_size=2 * envs_per_actor,
         num_actors=num_actors,
@@ -124,6 +71,7 @@ def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int) -> 
         logger_frequency=10**9,
         save_model=False,
         max_timesteps=frames,
+        num_envs=num_actors * envs_per_actor,
     )
     if pixels:
         env_fns = [
@@ -175,7 +123,15 @@ def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int) -> 
         agent.initial_state(Ba),
     )
 
-    trainer = HostActorLearnerTrainer(args, agent, env_fns)
+    if mode == "processes":
+        # monobeast topology: spawned actor processes with local CPU
+        # inference over the C++ shm ring — the path that scales across
+        # host cores (each actor is GIL-free and backend-independent)
+        trainer = ProcessActorLearnerTrainer(
+            args, agent, envs_per_actor=envs_per_actor
+        )
+    else:
+        trainer = HostActorLearnerTrainer(args, agent, env_fns)
     warm_steps = int(agent.state.step)
     t0 = time.time()
     result = trainer.train(total_frames=frames)
@@ -185,6 +141,9 @@ def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int) -> 
         "metric": f"host_actor_plane_fps_{kind}",
         "value": round(result["sps"], 1),
         "unit": "env-frames/sec (actors+learner, end to end)",
+        "mode": mode,
+        "num_actors": num_actors,
+        "envs_per_actor": envs_per_actor,
         "frames": int(result["env_frames"]),
         "wall_s": round(wall, 1),
         "learn_steps": int(agent.state.step) - warm_steps,
@@ -195,6 +154,12 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("kinds", nargs="*", default=["cartpole", "pixels"])
     ap.add_argument("--num-actors", type=int, default=2)
+    ap.add_argument("--sweep", type=str, default="",
+                    help="comma list of actor counts; one JSON line each "
+                         "(overrides --num-actors), e.g. --sweep 1,2,4,8")
+    ap.add_argument("--mode", choices=["threads", "processes"], default="threads",
+                    help="threads = SEED central inference; processes = "
+                         "monobeast spawned actors over the C++ shm ring")
     ap.add_argument("--envs-per-actor", type=int, default=8)
     ap.add_argument("--frames", type=int, default=40_000)
     ap.add_argument("--pixel-frames", type=int, default=0,
@@ -202,11 +167,22 @@ def main() -> None:
     ap.add_argument("--cpu", action="store_true",
                     help="pin the CPU backend (handled at import; kept for --help)")
     args = ap.parse_args()
+    counts = (
+        [int(c) for c in args.sweep.split(",") if c]
+        if args.sweep
+        else [args.num_actors]
+    )
     for kind in args.kinds or ["cartpole", "pixels"]:
         frames = args.frames if kind == "cartpole" else (
             args.pixel_frames or args.frames // 4
         )
-        print(json.dumps(bench_host(kind, args.num_actors, args.envs_per_actor, frames)), flush=True)
+        for n in counts:
+            print(
+                json.dumps(
+                    bench_host(kind, n, args.envs_per_actor, frames, mode=args.mode)
+                ),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

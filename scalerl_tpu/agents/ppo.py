@@ -72,6 +72,13 @@ def ppo_loss(
     PPO2-style value clip).  Sum convention over [T, b] for the losses,
     ``mean_*`` for diagnostics — the metric-name contract of
     ``agents/impala.py``.
+
+    NOTE on learning rates: the sum convention means the gradient scale
+    grows with ``rollout_length`` x lanes-per-minibatch, unlike SB3/
+    baselines PPO which averages over the minibatch.  Published PPO
+    learning rates (e.g. 3e-4) do not transfer directly — scale lr down
+    by roughly the minibatch element count, or retune per batch shape
+    (see PPOArguments).
     """
     out, _ = model.apply(
         params, mb["obs"], mb["action"], mb["reward"], mb["done"], mb["core_state"]

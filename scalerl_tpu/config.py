@@ -187,6 +187,13 @@ class PPOArguments(RLArguments):
     (``agent.enable_mesh``) is the DD-PPO topology: every chip runs the
     full epochs x minibatches schedule with gradients all-reduced per
     minibatch step.
+
+    Learning-rate convention: losses use the repo-wide SUM over [T, b]
+    (see ``agents/ppo.py:ppo_loss``), not the per-element mean of SB3/
+    baselines PPO — so the effective gradient scale grows with
+    ``rollout_length`` and lanes per minibatch, and published PPO lrs
+    (3e-4 etc.) must be divided by the minibatch element count (or
+    retuned) when transferring configs.
     """
 
     algo_name: str = "ppo"

@@ -179,8 +179,10 @@ def replay_sample(
 ) -> Dict[str, jnp.ndarray]:
     """Uniformly sample ``batch_size`` (possibly n-step) transitions on device.
 
-    Returns fields obs/action/reward/next_obs/done (+``indices`` of the
-    logical (row, env) pair for PER-style callers).
+    Returns fields obs/action/reward/next_obs/done (+``indices``: flat
+    PHYSICAL ``row0 * num_envs + env`` slots of the window head, the
+    contract ``gather_transitions`` documents and ``data/prioritized.py``
+    keys its priority updates on).
     """
     num_envs = next(iter(state.storage.values())).shape[1]
     # valid logical rows leave room for the n-step window: a window starting

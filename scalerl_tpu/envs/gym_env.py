@@ -29,6 +29,12 @@ def make_gym_env(
     """Return a thunk building one env (thunks are what vector ctors want)."""
 
     def thunk() -> gym.Env:
+        # idempotent + cheap, and inside the thunk on purpose: vector-env
+        # spawn children run this with a fresh gymnasium registry, so
+        # parent-side registration would not survive the pickle boundary
+        from scalerl_tpu.envs.synthetic_gym import register_synthetic_envs
+
+        register_synthetic_envs()
         render_mode = "rgb_array" if (capture_video and idx == 0) else None
         env = gym.make(env_id, render_mode=render_mode, **env_kwargs)
         if capture_video and idx == 0 and video_dir is not None:

@@ -36,6 +36,7 @@ from scalerl_tpu.envs.jax_envs.base import JaxEnv
 class SyntheticState(NamedTuple):
     cell: jnp.ndarray  # int32 ring position
     t: jnp.ndarray  # int32 step counter
+    last_action: jnp.ndarray  # int32 previous *executed* action (sticky)
 
 
 class SyntheticPixelEnv(JaxEnv):
@@ -46,7 +47,15 @@ class SyntheticPixelEnv(JaxEnv):
         num_actions: int = 6,
         num_states: int = 16,
         episode_length: int = 128,
+        sticky_prob: float = 0.0,
     ) -> None:
+        """``sticky_prob``: ALE-style sticky actions (Machado et al. 2018)
+        — with this probability the env *repeats the previously executed
+        action* instead of the agent's choice.  Makes the dynamics
+        stochastic at the north-star 84x84x4 learning shape (VERDICT r2
+        #7) the way real Atari evaluation is, so a policy cannot exploit
+        determinism; 0.0 (default) executes the agent's action verbatim
+        (the original deterministic-dynamics benchmark env)."""
         if num_states > size:
             # each cell needs a distinct stripe column block; more states
             # than columns would alias cells >= size into identical frames
@@ -59,6 +68,7 @@ class SyntheticPixelEnv(JaxEnv):
         self._num_actions = num_actions
         self.num_states = num_states
         self.episode_length = episode_length
+        self.sticky_prob = float(sticky_prob)
 
     @property
     def observation_shape(self) -> Tuple[int, ...]:
@@ -97,13 +107,22 @@ class SyntheticPixelEnv(JaxEnv):
 
     def reset(self, key: jax.Array):
         cell = jax.random.randint(key, (), 0, self.num_states)
-        state = SyntheticState(cell, jnp.zeros((), jnp.int32))
+        state = SyntheticState(
+            cell, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
+        )
         return state, self._render(cell)
 
     def step(self, state: SyntheticState, action: jnp.ndarray, key: jax.Array):
-        correct = action == self._correct_action(state.cell)
+        k_teleport, k_reset, k_sticky = jax.random.split(key, 3)
+        if self.sticky_prob > 0.0:
+            sticky = jax.random.bernoulli(k_sticky, self.sticky_prob)
+            executed = jnp.where(sticky, state.last_action, action).astype(
+                action.dtype
+            )
+        else:
+            executed = action
+        correct = executed == self._correct_action(state.cell)
         reward = correct.astype(jnp.float32)
-        k_teleport, k_reset = jax.random.split(key)
         teleport = jax.random.randint(k_teleport, (), 0, self.num_states)
         cell = jnp.where(correct, (state.cell + 1) % self.num_states, teleport)
         t = state.t + 1
@@ -111,5 +130,11 @@ class SyntheticPixelEnv(JaxEnv):
 
         reset_cell = jax.random.randint(k_reset, (), 0, self.num_states)
         new_cell = jnp.where(done, reset_cell, cell)
-        new_state = SyntheticState(new_cell, jnp.where(done, 0, t))
+        new_state = SyntheticState(
+            new_cell,
+            jnp.where(done, 0, t),
+            # sticky carry resets with the episode (fresh episodes have no
+            # previous action to repeat)
+            jnp.where(done, 0, executed.astype(jnp.int32)),
+        )
         return new_state, self._render(new_cell), reward, done

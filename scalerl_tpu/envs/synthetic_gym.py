@@ -1,0 +1,100 @@
+"""Gym-API synthetic benchmark envs (numpy twins of ``envs/jax_envs``).
+
+``PixelRingEnv`` pre-renders its ``[84, 84, 4]`` uint8 frames with a pure
+numpy twin of the ``SyntheticPixelEnv`` renderer (bit-equality asserted in
+``tests/test_envs.py``), so ``step`` costs an index lookup and — crucially
+— constructing it never imports jax: spawned actor processes
+(``trainer/process_actor_learner.py``) build it by id string and must stay
+free of the multi-second jax import + backend init.  Registered with
+gymnasium as ``PixelRing-v0`` via :func:`register_synthetic_envs`.
+
+Parity context: the reference benchmarks env stacks only
+(``examples/test_env_throughput.py:16-606``); a synthetic pixel env at the
+Atari north-star shape is what lets the pipeline be measured end to end
+without ALE ROMs (absent from this image — see docs/LEARNING_CURVES.md).
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+import numpy as np
+
+
+def render_ring_frame(
+    cell: int, size: int, stack: int, num_states: int
+) -> np.ndarray:
+    """Numpy twin of ``SyntheticPixelEnv._render`` — MUST stay formula-
+    identical (bright stripe at the cell-indexed column block over the
+    fixed dim texture); ``tests/test_envs.py`` asserts bit-equality
+    against the jax renderer so the two cannot drift."""
+    rows = np.arange(size)[:, None, None]
+    cols = np.arange(size)[None, :, None]
+    chans = np.arange(stack)[None, None, :]
+    texture = (rows * 2 + cols * 5 + chans * 17) % 128
+    stripe_w = max(size // num_states, 1)
+    in_stripe = (cols // stripe_w) == cell
+    return np.where(in_stripe, 255, texture).astype(np.uint8)
+
+
+class PixelRingEnv(gym.Env):
+    """Deterministic-dynamics pixel env: N pre-rendered ring cells; the
+    "correct" action advances the ring, anything else teleports randomly.
+
+    A real ``gym.Env`` subclass: ``gym.make("PixelRing-v0")`` type-checks
+    the inheritance, and spawned actor processes build it by id string.
+    """
+
+    metadata: dict = {"render_modes": []}
+
+    def __init__(self, size: int = 84, stack: int = 4, num_actions: int = 6,
+                 num_states: int = 16, episode_length: int = 128,
+                 render_mode=None) -> None:
+        # gym.make forwards render_mode to the ctor even when None
+        self.render_mode = render_mode
+        self.observation_space = gym.spaces.Box(0, 255, (size, size, stack), np.uint8)
+        self.action_space = gym.spaces.Discrete(num_actions)
+        self.num_states = num_states
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        self._frames = np.stack(
+            [render_ring_frame(c, size, stack, num_states) for c in range(num_states)]
+        )
+        self._rng = np.random.default_rng(0)
+        self._cell = 0
+        self._t = 0
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cell = int(self._rng.integers(self.num_states))
+        self._t = 0
+        return self._frames[self._cell], {}
+
+    def step(self, action):
+        correct = int(action) == (self._cell % self.num_actions)
+        reward = float(correct)
+        if correct:
+            self._cell = (self._cell + 1) % self.num_states
+        else:
+            self._cell = int(self._rng.integers(self.num_states))
+        self._t += 1
+        done = self._t >= self.episode_length
+        if done:
+            self._cell = int(self._rng.integers(self.num_states))
+            self._t = 0
+        return self._frames[self._cell], reward, done, False, {}
+
+    def close(self):
+        pass
+
+
+def register_synthetic_envs() -> None:
+    """Idempotently register the synthetic envs with gymnasium."""
+    import gymnasium as gym
+
+    if "PixelRing-v0" not in gym.registry:
+        gym.register(
+            id="PixelRing-v0",
+            entry_point="scalerl_tpu.envs.synthetic_gym:PixelRingEnv",
+            disable_env_checker=True,
+        )

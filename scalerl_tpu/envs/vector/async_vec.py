@@ -70,11 +70,11 @@ def _probe_spaces(env_fn: Callable[[], Any]):
 class AsyncMultiAgentVecEnv:
     """N env subprocesses writing observations into a shared plane.
 
-    ``context``: on a JAX learner host prefer ``"forkserver"`` or
-    ``"spawn"`` — the default start method on Linux is fork, and forking
-    after JAX has started backend threads can deadlock the child.  Env
-    factories must be picklable under those contexts (module-level
-    callables, not lambdas).
+    ``context``: when unset and a JAX backend already lives in this
+    process, workers start via ``"spawn"`` automatically — the default
+    start method on Linux is fork, and forking after JAX has started
+    backend threads can deadlock the child.  Env factories must be
+    picklable under those contexts (module-level callables, not lambdas).
     """
 
     def __init__(
@@ -84,8 +84,10 @@ class AsyncMultiAgentVecEnv:
         autoreset: bool = True,
         context: Optional[str] = None,
     ) -> None:
+        from scalerl_tpu.utils.platform import safe_mp_context
+
         self.num_envs = len(env_fns)
-        ctx = mp.get_context(context)
+        ctx = mp.get_context(safe_mp_context(context))
         if obs_spaces is None:
             self.agents, obs_spaces, self.action_spaces = _probe_spaces(env_fns[0])
         else:

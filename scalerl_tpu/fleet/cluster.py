@@ -435,16 +435,19 @@ class LocalCluster:
         self.server = server
         self.config = config
         self.runner = runner
-        # fork-after-JAX can deadlock in XLA's thread pools; a parent that
-        # holds a JAX runtime should pass mp_context='spawn' (runner must
-        # then be picklable, e.g. GenerationRunner over module-level fns)
+        # fork-after-JAX can deadlock in XLA's thread pools; when the
+        # parent holds a JAX runtime and no context was requested, start()
+        # auto-selects spawn (runners must be picklable, e.g.
+        # GenerationRunner over module-level fns)
         self.mp_context = mp_context
         self.procs: List[mp.Process] = []
 
     def start(self) -> None:
+        from scalerl_tpu.utils.platform import safe_mp_context
+
         per = self.config.workers_per_gather
         remaining = self.config.num_workers
-        ctx = mp.get_context(self.mp_context)
+        ctx = mp.get_context(safe_mp_context(self.mp_context))
         for _g in range(self.config.num_gathers):
             n = min(per, remaining)
             remaining -= n
@@ -483,7 +486,7 @@ class RemoteCluster:
         self.config = config
         self.runner = runner
         self.num_workers = num_workers or config.num_workers
-        self.mp_context = mp_context  # see LocalCluster: 'spawn' if JAX in parent
+        self.mp_context = mp_context  # see LocalCluster: auto-spawn if JAX in parent
         self.procs: List[mp.Process] = []
 
     def entry(self) -> Tuple[int, Dict[str, Any]]:
@@ -514,10 +517,12 @@ class RemoteCluster:
             ),
             extra={**self.config.extra, **remote_cfg.get("extra", {})},
         )
+        from scalerl_tpu.utils.platform import safe_mp_context
+
         per = config.workers_per_gather
         remaining = self.num_workers
         offset = 0
-        ctx = mp.get_context(self.mp_context)
+        ctx = mp.get_context(safe_mp_context(self.mp_context))
         while remaining > 0:
             n = min(per, remaining)
             proc = ctx.Process(

@@ -191,8 +191,15 @@ def open_worker_pipes(
     (``scalerl/hpc/connection.py:179-204``).  ``args_fn(i)`` builds the
     worker's extra args; the worker ``target`` receives
     ``(pipe_connection, *args_fn(i))``.
+
+    When no ``ctx`` is given and JAX is live in this process, workers
+    start via spawn (``target``/args must then be picklable) — see
+    ``utils.platform.safe_mp_context``.
     """
-    ctx = ctx or mp.get_context()
+    if ctx is None:
+        from scalerl_tpu.utils.platform import safe_mp_context
+
+        ctx = mp.get_context(safe_mp_context(None))
     conns: List[PipeConnection] = []
     procs: List[mp.Process] = []
     for i in range(n):

@@ -1,3 +1,32 @@
-from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop  # noqa: F401
-from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
-from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401
+"""Runtime layer: device loop, parameter server, rollout queue.
+
+Lazy exports (PEP 562): ``DeviceActorLearnerLoop`` pulls in the full
+JAX/agents/orbax stack (~5 s cold), but fleet workers and spawn-context
+children import this package only for the jax-free ``ParameterServer`` /
+``RolloutQueue`` — eager imports here would put seconds of dead weight on
+every spawned actor process (and every remote CPU fleet host).
+"""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # static analyzers see the real symbols
+    from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop  # noqa: F401
+    from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
+    from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401
+
+_EXPORTS = {
+    "DeviceActorLearnerLoop": "scalerl_tpu.runtime.device_loop",
+    "ParameterServer": "scalerl_tpu.runtime.param_server",
+    "RolloutQueue": "scalerl_tpu.runtime.rollout_queue",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

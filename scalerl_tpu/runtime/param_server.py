@@ -11,18 +11,47 @@ actor pulls never block the train step.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Optional, Tuple
 
-import jax
 import numpy as np
 
 
 def jnp_copy(x):
-    """Async device-side copy (new buffer, survives donation of ``x``)."""
-    import jax.numpy as jnp
+    """Async device-side copy (new buffer, survives donation of ``x``).
 
-    return jnp.copy(x) if isinstance(x, jax.Array) else np.asarray(x)
+    jax is referenced only if it is already loaded: fleet workers and
+    spawn children publish/pull plain numpy trees and must not pay the
+    multi-second jax import just to hold weights.
+    """
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(x, jax.Array):
+        import jax.numpy as jnp
+
+        return jnp.copy(x)
+    return np.asarray(x)
+
+
+def _tree_map(fn, tree):
+    """``jax.tree_util.tree_map`` when jax is loaded; a stdlib-container
+    fallback otherwise.  A process that never imported jax can only be
+    holding dict/list/tuple/leaf weight trees (fleet workers), so the
+    fallback is complete for them — and flax/custom pytrees always arrive
+    with jax already in ``sys.modules``."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        return jax.tree_util.tree_map(fn, tree)
+    if tree is None:
+        return None  # match jax: None is empty structure, not a leaf
+    if isinstance(tree, dict):
+        return {k: _tree_map(fn, v) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        # NamedTuple: positional-field constructor, not iterable-accepting
+        return type(tree)(*(_tree_map(fn, v) for v in tree))
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_tree_map(fn, v) for v in tree)
+    return fn(tree)
 
 
 class ParameterServer:
@@ -51,9 +80,9 @@ class ParameterServer:
         storing the live params would leave pullers holding deleted arrays.
         """
         if to_host:
-            weights = jax.tree_util.tree_map(np.asarray, weights)
+            weights = _tree_map(np.asarray, weights)
         else:
-            weights = jax.tree_util.tree_map(jnp_copy, weights)
+            weights = _tree_map(jnp_copy, weights)
         with self._lock:
             self._version += 1
             self._weights = weights
@@ -75,7 +104,7 @@ class ParameterServer:
                 return None, self._version
             weights, version, is_host = self._weights, self._version, self._is_host
         if not is_host:
-            weights = jax.tree_util.tree_map(np.asarray, weights)
+            weights = _tree_map(np.asarray, weights)
             with self._lock:
                 if self._version == version:
                     self._weights = weights

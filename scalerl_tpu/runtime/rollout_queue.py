@@ -107,7 +107,13 @@ class RolloutQueue:
             # any exit (error funnel, timeout, close, KeyboardInterrupt,
             # a bad slot in the batch build): the drained slots are still
             # full and unconsumed — hand them back, or the pool leaks one
-            # slot per exit until acquire() deadlocks
+            # slot per exit until acquire() deadlocks.  Re-enqueueing at
+            # the tail perturbs FIFO order: rollouts drained here age to
+            # the back of the queue and pick up extra policy lag before
+            # they are finally consumed.  Acceptable — V-trace corrects
+            # bounded lag, and this path only runs on timeouts/teardown —
+            # but callers that need strict lag bounds should drain and
+            # drop instead of retrying.
             for i in idxs:
                 self.full.put(i)
             raise

@@ -8,6 +8,42 @@ plugin registers regardless), so ``--platform cpu`` must go through
 from __future__ import annotations
 
 import os
+import sys
+from typing import Optional
+
+
+def jax_runtime_initialized() -> bool:
+    """True iff a JAX backend has been created in this process.
+
+    Passive: never imports jax or triggers backend init itself (backend
+    init can hang for minutes under the axon tunnel).  Used to decide the
+    multiprocessing start method — forking after XLA has started its
+    thread pools clones held mutexes into the child, which can deadlock
+    (the reference never hits this: torch tolerates fork; JAX does not).
+    """
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(xb._backends)
+    except Exception:  # noqa: BLE001 — jax-internals drift: assume not init
+        return False
+
+
+def safe_mp_context(requested: Optional[str] = None) -> Optional[str]:
+    """Resolve a multiprocessing start-method name.
+
+    Explicit ``requested`` always wins.  Otherwise: ``"spawn"`` when a JAX
+    backend already lives in this process (fork would be unsafe — see
+    ``jax_runtime_initialized``), else ``None`` (the platform default,
+    fork on Linux, which is cheapest when no runtime is at risk).
+    Call sites must keep worker targets/runners picklable so the spawn
+    path works when it triggers.
+    """
+    if requested is not None:
+        return requested
+    return "spawn" if jax_runtime_initialized() else None
 
 
 def setup_platform(

@@ -1,0 +1,52 @@
+"""Importable helpers for the combined multi-host rehearsal test.
+
+Module-level (picklable) runner + task source: the fleet's gather/worker
+processes start via the auto-spawn context inside jax.distributed ranks
+(`utils.platform.safe_mp_context`), so everything they receive must
+import cleanly by qualified name from a real module — closures inside a
+``python -c`` script cannot cross that boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+FEATURE_DIM = 4
+
+
+def bandit_runner(task, weights, worker_id):
+    """One toy rollout: reward is the pulled policy's score on a fixed
+    feature vector — enough to prove weights flowed server -> worker."""
+    w = (
+        weights["w"]
+        if weights is not None
+        else np.zeros(FEATURE_DIM, np.float32)
+    )
+    seed = int(task.get("seed", 0))
+    rng = np.random.default_rng(seed)
+    features = rng.standard_normal(FEATURE_DIM).astype(np.float32)
+    return {
+        "seed": seed,
+        "features": features,
+        "reward": float(features @ w),
+    }
+
+
+class CountingTaskSource:
+    """Thread-safe numbered task source (the server's job generator)."""
+
+    def __init__(self, version_fn=None) -> None:
+        self._i = 0
+        self._lock = threading.Lock()
+        self._version_fn = version_fn or (lambda: 0)
+
+    def __call__(self):
+        with self._lock:
+            self._i += 1
+            return {
+                "role": "rollout",
+                "seed": self._i,
+                "param_version": self._version_fn(),
+            }

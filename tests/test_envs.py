@@ -130,6 +130,58 @@ def test_synthetic_pixel_env():
     )
 
 
+def test_numpy_ring_renderer_matches_jax_renderer():
+    """The jax-free gym twin (spawned actor processes must not import jax)
+    renders bit-identical frames to the device env's renderer."""
+    from scalerl_tpu.envs.synthetic_gym import render_ring_frame
+
+    env = SyntheticPixelEnv(size=32, stack=3, num_actions=4, num_states=8)
+    for cell in range(8):
+        np.testing.assert_array_equal(
+            render_ring_frame(cell, 32, 3, 8),
+            np.asarray(env._render(jnp.asarray(cell))),
+        )
+
+
+def test_synthetic_pixel_env_sticky_actions():
+    """ALE-style sticky actions: with sticky_prob=1 the env always executes
+    the PREVIOUS action; prob=0 reproduces the deterministic env exactly."""
+    env = SyntheticPixelEnv(
+        size=42, stack=2, num_actions=4, episode_length=10, sticky_prob=1.0
+    )
+    key = jax.random.PRNGKey(0)
+    state, _obs = env.reset(key)
+    correct = env._correct_action(state.cell)
+    wrong = (correct + 1) % 4
+    # first step: last_action is 0 (fresh episode) — executed action is 0,
+    # regardless of the agent's choice
+    k1, k2 = jax.random.split(key)
+    s1, _o, r1, _d = env.step(state, wrong, k1)
+    expected = 1.0 if int(correct) == 0 else 0.0
+    assert float(r1) == expected
+    assert int(s1.last_action) == 0  # the EXECUTED action is carried
+    # second step: agent's choice is again ignored; previous executed (0)
+    # repeats
+    c2 = env._correct_action(s1.cell)
+    _s2, _o2, r2, _d2 = env.step(s1, (c2 + 1) % 4, k2)
+    assert float(r2) == (1.0 if int(c2) == 0 else 0.0)
+
+    # sticky_prob=0 (the default) bit-matches the pre-sticky env: same
+    # reset obs and same step outcome under the same key
+    det = SyntheticPixelEnv(size=42, stack=2, num_actions=4, episode_length=10)
+    zero = SyntheticPixelEnv(
+        size=42, stack=2, num_actions=4, episode_length=10, sticky_prob=0.0
+    )
+    sd, od = det.reset(key)
+    sz, oz = zero.reset(key)
+    np.testing.assert_array_equal(np.asarray(od), np.asarray(oz))
+    a = det._correct_action(sd.cell)
+    _, od1, rd, _ = det.step(sd, a, k1)
+    _, oz1, rz, _ = zero.step(sz, a, k1)
+    assert float(rd) == float(rz)
+    np.testing.assert_array_equal(np.asarray(od1), np.asarray(oz1))
+
+
 def test_jax_catch_env():
     from scalerl_tpu.envs import JaxCatch
 

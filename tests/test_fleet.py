@@ -170,7 +170,9 @@ def test_job_executor():
     jobs = iter([{"x": i} for i in range(6)])
     ex = JobExecutor(_square_worker, jobs, num_workers=2)
     ex.start()
-    got = sorted(ex.results.get(timeout=10.0)["out"] for _ in range(6))
+    # generous first-result timeout: under an auto-spawn context (JAX live
+    # in the pytest parent) each worker re-imports the test module (~2-3 s)
+    got = sorted(ex.results.get(timeout=60.0)["out"] for _ in range(6))
     assert got == [0, 1, 4, 9, 16, 25]
     ex.shutdown()
 
@@ -338,12 +340,14 @@ def test_episode_generator_turn_based():
     assert returns[players == 1][-1] == pytest.approx(-1.0)
 
 
-def test_generation_runner_in_local_cluster():
-    def policy(weights, obs, player):
-        return np.zeros(3, np.float32)
+def _zero_policy(weights, obs, player):
+    # module-level: the runner must survive pickling into spawn children
+    return np.zeros(3, np.float32)
 
+
+def test_generation_runner_in_local_cluster():
     runner = make_generation_runner(
-        _TicTacToeLite, policy, num_actions=3, gamma=1.0, chunk_len=4
+        _TicTacToeLite, _zero_policy, num_actions=3, gamma=1.0, chunk_len=4
     )
     config = FleetConfig(num_workers=2, workers_per_gather=2, upload_batch=1)
     server = WorkerServer(config, _make_task_source(4))

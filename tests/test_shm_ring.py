@@ -89,8 +89,11 @@ def _actor_proc(ring, actor_id, episodes):
 def test_ring_multiprocess_producers(use_native):
     ring = ShmRolloutRing(_spec(), num_slots=4, use_native=use_native)
     n_actors, episodes = 3, 5
+    # spawn: the pytest parent holds a live JAX runtime; forking it clones
+    # locked XLA mutexes into the children (deadlock-prone, and warns)
+    ctx = mp.get_context("spawn")
     procs = [
-        mp.Process(target=_actor_proc, args=(ring, a, episodes))
+        ctx.Process(target=_actor_proc, args=(ring, a, episodes))
         for a in range(n_actors)
     ]
     try:

@@ -42,16 +42,18 @@ def test_shared_plane_layout_and_zero_copy():
     assert batch["a"][0].sum() == 0
 
 
+def _plane_writer_child(plane, idx):
+    # module-level: must pickle into a spawn child (fork-after-JAX warns)
+    plane.write_env(idx, {"x": np.array([3.0, 4.0], np.float32)})
+
+
 def test_shared_plane_visible_across_processes():
     spec = ExperienceSpec({"x": ((2,), np.float32)}, num_envs=2)
     plane = SharedObservationPlane(spec)
 
-    def child(plane, idx):
-        plane.write_env(idx, {"x": np.array([3.0, 4.0], np.float32)})
-
-    p = mp.Process(target=child, args=(plane, 1))
+    p = mp.get_context("spawn").Process(target=_plane_writer_child, args=(plane, 1))
     p.start()
-    p.join(timeout=10.0)
+    p.join(timeout=30.0)
     np.testing.assert_array_equal(plane.view("x")[1], [3.0, 4.0])
 
 
@@ -162,9 +164,16 @@ def test_autoreset_wrapper_resets():
     assert obs["chaser"].shape == (4,)
 
 
+def _make_cartpole():
+    # module-level: env factories must pickle into auto-spawn children
+    import gymnasium as gym
+
+    return gym.make("CartPole-v1")
+
+
 def test_single_agent_adapter_cartpole():
-    gym = pytest.importorskip("gymnasium")
-    vec = make_shared_vec_envs(lambda: gym.make("CartPole-v1"), num_envs=2)
+    pytest.importorskip("gymnasium")
+    vec = make_shared_vec_envs(_make_cartpole, num_envs=2)
     try:
         obs, _ = vec.reset(seed=0)
         assert obs["agent_0"].shape == (2, 4)

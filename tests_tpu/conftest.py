@@ -1,0 +1,39 @@
+"""TPU-gated test suite: runs ONLY against a live TPU backend.
+
+Deliberately separate from ``tests/`` (whose conftest force-pins the CPU
+backend): everything here exists to exercise *compiled* TPU execution —
+Pallas kernel tiling/VMEM legality, bf16 numerics on the MXU — which
+interpret mode on CPU cannot validate (``ops/pallas_attention.py:27``).
+
+Invoke explicitly when the tunnel is up:
+
+    python -m pytest tests_tpu -q
+
+Every test is marked ``tpu`` and the whole session skips unless
+``jax.default_backend() == "tpu"`` — a CPU-only host skips cleanly rather
+than failing.  NOTE: merely importing jax here touches the backend; under
+a wedged axon tunnel that can hang, so run this suite with an external
+timeout when probing.
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        item.add_marker(pytest.mark.tpu)
+
+
+def pytest_sessionstart(session):
+    import jax
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        session.config._scalerl_skip_all = f"backend is {backend!r}, not tpu"
+
+
+@pytest.fixture(autouse=True)
+def _require_tpu(request):
+    reason = getattr(request.config, "_scalerl_skip_all", None)
+    if reason:
+        pytest.skip(reason)
