@@ -1,0 +1,170 @@
+"""Per-component cost breakdown of one host actor (the bottleneck analysis).
+
+The host actor plane's aggregate frames/sec is actors x per-actor rate,
+and the per-actor rate decomposes into env stepping, trajectory-slot
+writes, and inference (dispatch + compute).  This harness measures each
+in isolation on one core so the scaling arithmetic in
+``docs/PERFORMANCE.md`` rests on committed measurements, not estimates:
+
+  env-only        SyncVectorEnv(PixelRing).step in a loop — the pure env cost
+  env+write       fill_rollout_slot with a zero-cost stub policy — adds the
+                  [T+1, B] slot writes (the obs memcpy dominates at pixels)
+  full (cpu inf)  fill_rollout_slot with the real jitted agent — adds
+                  inference at host-CPU speed (upper bound on the SEED
+                  topology's per-step host cost; on TPU the compute moves
+                  off-host and only dispatch+transfer remain)
+
+Prints one JSON line per stage.  Usage:
+    python examples/bench_actor_components.py [--cpu] [--envs 8] [--kind pixels]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+class _StubAgent:
+    """Zero-cost policy: isolates env+write from inference."""
+
+    def __init__(self, num_actions: int, batch: int) -> None:
+        self._action = np.zeros(batch, np.int32)
+        self._logits = np.zeros((batch, num_actions), np.float32)
+
+    def act(self, obs, last_action, reward, done, core_state):
+        return self._action, self._logits, core_state
+
+    def initial_state(self, batch):
+        return ()
+
+
+def _spec(kind: str):
+    """(obs_shape, num_actions, obs_dtype) — constants, no env build."""
+    if kind == "pixels":
+        return (84, 84, 4), 6, np.uint8
+    return (4,), 2, np.float32
+
+
+def _make_envs(kind: str, num_envs: int):
+    from scalerl_tpu.envs import make_vect_envs
+
+    env_id = "PixelRing-v0" if kind == "pixels" else "CartPole-v1"
+    return make_vect_envs(env_id, num_envs=num_envs, async_envs=False)
+
+
+def bench_env_only(kind: str, num_envs: int, steps: int) -> dict:
+    envs = _make_envs(kind, num_envs)
+    envs.reset(seed=0)
+    actions = np.zeros(num_envs, np.int64)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        envs.step(actions)
+    dt = time.perf_counter() - t0
+    envs.close()
+    fps = steps * num_envs / dt
+    return {"stage": "env_only", "kind": kind, "fps": round(fps, 1),
+            "us_per_frame": round(1e6 / fps, 2)}
+
+
+def _bench_slot_loop(kind: str, num_envs: int, chunks: int, agent) -> float:
+    from scalerl_tpu.data.trajectory import TrajectorySpec
+    from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+    from scalerl_tpu.trainer.actor_learner import fill_rollout_slot
+
+    obs_shape, num_actions, obs_dtype = _spec(kind)
+    envs = _make_envs(kind, num_envs)
+    T = 20
+    core = agent.initial_state(num_envs)
+    spec = TrajectorySpec(
+        unroll_length=T,
+        batch_size=num_envs,
+        obs_shape=obs_shape,
+        num_actions=num_actions,
+        obs_dtype=obs_dtype,
+        core_state_shapes=tuple(tuple(c.shape) for c, _ in core)
+        if core else (),
+    )
+    q = RolloutQueue(spec, num_slots=4)
+    obs, _ = envs.reset(seed=0)
+    last_action = np.zeros(num_envs, np.int32)
+    reward = np.zeros(num_envs, np.float32)
+    done = np.ones(num_envs, bool)
+    core_state = core
+    # warmup chunk (jit compile for the real agent)
+    idx = q.acquire()
+    obs, last_action, reward, done, core_state = fill_rollout_slot(
+        q.slots[idx], agent, envs, obs, last_action, reward, done, core_state, T
+    )
+    q.commit(idx)
+    _warm_batch, warm_idxs = q.get_batch(1)
+    q.recycle(warm_idxs)
+    t0 = time.perf_counter()
+    for _ in range(chunks):
+        idx = q.acquire()
+        obs, last_action, reward, done, core_state = fill_rollout_slot(
+            q.slots[idx], agent, envs, obs, last_action, reward, done,
+            core_state, T,
+        )
+        q.commit(idx)
+        batch, idxs = q.get_batch(1)
+        q.recycle(idxs)
+    dt = time.perf_counter() - t0
+    envs.close()
+    q.close()
+    return chunks * T * num_envs / dt
+
+
+def bench_env_write(kind: str, num_envs: int, chunks: int) -> dict:
+    _shape, num_actions, _dtype = _spec(kind)
+    agent = _StubAgent(num_actions, num_envs)
+    fps = _bench_slot_loop(kind, num_envs, chunks, agent)
+    return {"stage": "env_plus_write", "kind": kind, "fps": round(fps, 1),
+            "us_per_frame": round(1e6 / fps, 2)}
+
+
+def bench_full(kind: str, num_envs: int, chunks: int) -> dict:
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+
+    obs_shape, num_actions, obs_dtype = _spec(kind)
+    pixels = kind == "pixels"
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=512 if pixels else 64,
+        rollout_length=20, batch_size=num_envs, logger_backend="none",
+    )
+    agent = ImpalaAgent(
+        args, obs_shape=obs_shape, num_actions=num_actions, obs_dtype=obs_dtype
+    )
+    fps = _bench_slot_loop(kind, num_envs, chunks, agent)
+    return {"stage": "full_cpu_inference", "kind": kind, "fps": round(fps, 1),
+            "us_per_frame": round(1e6 / fps, 2)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kind", choices=["pixels", "cartpole"], default="pixels")
+    ap.add_argument("--envs", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=2000)
+    ap.add_argument("--chunks", type=int, default=8)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    print(json.dumps(bench_env_only(args.kind, args.envs, args.steps)), flush=True)
+    print(json.dumps(bench_env_write(args.kind, args.envs, args.chunks)), flush=True)
+    print(json.dumps(bench_full(args.kind, args.envs, args.chunks)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -136,8 +136,7 @@ def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int,
     t0 = time.time()
     result = trainer.train(total_frames=frames)
     wall = time.time() - t0
-    trainer.close()
-    return {
+    out = {
         "metric": f"host_actor_plane_fps_{kind}",
         "value": round(result["sps"], 1),
         "unit": "env-frames/sec (actors+learner, end to end)",
@@ -148,6 +147,23 @@ def bench_host(kind: str, num_actors: int, envs_per_actor: int, frames: int,
         "wall_s": round(wall, 1),
         "learn_steps": int(agent.state.step) - warm_steps,
     }
+    # phase split (thread mode): actor model/step/write + learner
+    # dequeue/learn mean seconds — the bottleneck analysis in
+    # docs/PERFORMANCE.md reads these, not guesses
+    if mode == "threads" and getattr(trainer, "actors", None):
+        phases = {
+            f"actor_{k}_ms": round(v * 1e3, 3)
+            for k, v in trainer.actors[0].timings.means().items()
+        }
+        phases.update(
+            {
+                f"learner_{k}_ms": round(v * 1e3, 3)
+                for k, v in trainer.learn_timings.means().items()
+            }
+        )
+        out["phase_means"] = phases
+    trainer.close()
+    return out
 
 
 def main() -> None:

@@ -32,11 +32,73 @@ if "--cpu" in sys.argv:
     jax.config.update("jax_platforms", "cpu")
 
 
-def summarize_xplane(trace_dir: str) -> dict:
-    """Best-effort XPlane summary: top ops by self time on the device plane.
+def _varint(buf: bytes, i: int):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
 
-    Uses tensorflow's profiler proto (baked into this image via tensorboard)
-    if parseable; otherwise reports the artifact paths only.
+
+def _proto_fields(buf: bytes):
+    """Yield (field_no, wire_type, value) over one protobuf message."""
+    import struct
+
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        f, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i : i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack("<I", buf[i : i + 4])[0]
+            i += 4
+        elif wt == 1:
+            v = struct.unpack("<Q", buf[i : i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield f, wt, v
+
+
+def _busy_ps(intervals) -> int:
+    """Union length of (start, end) spans — trace events NEST (an executor
+    span encloses per-op spans on the same line), so a plain duration sum
+    double-counts busy time."""
+    total = 0
+    cur_s = cur_e = None
+    for s, e in sorted(intervals):
+        if cur_s is None:
+            cur_s, cur_e = s, e
+        elif s <= cur_e:
+            cur_e = max(cur_e, e)
+        else:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+    if cur_s is not None:
+        total += cur_e - cur_s
+    return total
+
+
+def summarize_xplane(trace_dir: str) -> dict:
+    """XPlane summary with a self-contained protobuf walker (this image has
+    no tensorflow/tensorboard profiler proto module): top ops by time, busy
+    time (interval union), span, and idle fraction per device.
+
+    Plane choice: real device planes (``/device:TPU:N`` etc.) when present;
+    otherwise the ``/host:CPU`` plane (XLA:CPU op events live there).  Line
+    choice differs by plane kind — device planes summarize their busiest
+    line only (lines are granularity levels of the same wall time), the CPU
+    fallback merges all non-``python`` lines (they are concurrent Eigen
+    worker threads; see the inline comment).
     """
     paths = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
@@ -45,60 +107,117 @@ def summarize_xplane(trace_dir: str) -> dict:
         return {"error": f"no xplane.pb under {trace_dir}"}
     out: dict = {"xplane": paths[-1]}
     try:
-        from tensorflow.python.profiler.protobuf import xplane_pb2  # type: ignore
-    except Exception:
-        try:
-            from tensorboard_plugin_profile.protobuf import xplane_pb2  # type: ignore
-        except Exception:
-            out["note"] = "no xplane proto parser in image; raw trace kept"
-            return out
-    with open(paths[-1], "rb") as f:
-        space = xplane_pb2.XSpace.FromString(f.read())
-    # A device plane carries several LINES covering the same wall time at
-    # different granularities ("XLA Modules", "XLA Ops", "Steps", ...) and
-    # each line's offsets are relative to that line's own timestamp —
-    # summing across lines double-counts time and mixing offsets breaks
-    # the span.  Use exactly ONE line per plane: the busiest (op-level)
-    # one, with the span computed within it.
+        return {**out, **_summarize_xplane_bytes(open(paths[-1], "rb").read())}
+    except Exception as e:  # noqa: BLE001 — a malformed trace must not eat
+        # the run: the wall-clock summary still prints, raw trace is kept
+        out["error"] = f"xplane parse failed: {type(e).__name__}: {e}"
+        return out
+
+
+def _summarize_xplane_bytes(space: bytes) -> dict:
+
+    def parse_meta_entry(buf):  # map<int64, XEventMetadata>
+        key, name = None, ""
+        for f_, wt, v in _proto_fields(buf):
+            if f_ == 1 and wt == 0:
+                key = v
+            elif f_ == 2 and wt == 2:
+                for mf, mwt, mv in _proto_fields(v):
+                    if mf == 2 and mwt == 2:
+                        name = mv.decode(errors="replace")
+        return key, name
+
+    def parse_event(buf):  # XEvent: metadata_id=1, offset_ps=2, duration_ps=3
+        mid = off = dur = 0
+        for f_, wt, v in _proto_fields(buf):
+            if f_ == 1 and wt == 0:
+                mid = v
+            elif f_ == 2 and wt == 0:
+                off = v
+            elif f_ == 3 and wt == 0:
+                dur = v
+        return mid, off, dur
+
+    planes = []  # (name, lines=[(line_name, [(mid, off, dur)])], meta)
+    for f_, wt, v in _proto_fields(space):
+        if f_ != 1 or wt != 2:  # XSpace.planes
+            continue
+        name, lines, meta = "", [], {}
+        for pf, pwt, pv in _proto_fields(v):
+            if pf == 2 and pwt == 2:
+                name = pv.decode(errors="replace")
+            elif pf == 3 and pwt == 2:  # XLine
+                lname, evs = "", []
+                for lf, lwt, lv in _proto_fields(pv):
+                    if lf == 2 and lwt == 2:
+                        lname = lv.decode(errors="replace")
+                    elif lf == 11 and lwt == 2 and not lname:
+                        lname = lv.decode(errors="replace")
+                    elif lf == 4 and lwt == 2:
+                        evs.append(parse_event(lv))
+                lines.append((lname, evs))
+            elif pf == 4 and pwt == 2:  # event_metadata map entry
+                k, n = parse_meta_entry(pv)
+                meta[k] = n
+        planes.append((name, lines, meta))
+
+    device_planes = [
+        p for p in planes
+        if "/device:" in p[0].lower() and "host" not in p[0].lower()
+    ]
+    # On a real device plane, lines are granularity levels of the SAME wall
+    # time ("XLA Modules" / "XLA Ops" / "Steps") — use exactly one (the
+    # busiest).  On the CPU fallback plane, non-python lines are CONCURRENT
+    # Eigen worker threads — they must be merged, not picked from, or an
+    # N-thread pool undercounts compute N-fold.
+    merge_lines = False
+    if not device_planes:  # CPU backend: XLA ops live on the host plane
+        device_planes = [p for p in planes if "/host:cpu" in p[0].lower()]
+        merge_lines = True
+
     per_op: dict = {}
-    device_total_ps = 0
-    device_span_ps = 0
-    for plane in space.planes:
-        name = plane.name.lower()
-        is_device = ("tpu" in name or "gpu" in name or "/device:" in name) and (
-            "host" not in name
+    busy_ps = 0
+    span_ps = 0
+    per_plane = []
+    out: dict = {}
+    for name, lines, meta in device_planes:
+        usable = [
+            (lname, evs) for lname, evs in lines
+            if evs and lname.lower() != "python"
+        ]
+        if not usable:
+            continue
+        if merge_lines:
+            chosen = usable
+            line_label = f"{len(usable)} worker lines (merged)"
+        else:
+            lname, evs = max(usable, key=lambda le: sum(e[2] for e in le[1]))
+            chosen = [(lname, evs)]
+            line_label = lname
+        intervals = [
+            (off, off + dur)
+            for _lname, evs in chosen
+            for _mid, off, dur in evs
+        ]
+        p_busy = _busy_ps(intervals)
+        p_span = max(e for _s, e in intervals) - min(s for s, _e in intervals)
+        busy_ps += p_busy
+        span_ps += p_span
+        per_plane.append(
+            {"plane": name, "line": line_label,
+             "busy_ms": round(p_busy / 1e9, 2),
+             "idle_frac": round(max(1 - p_busy / max(p_span, 1), 0.0), 4)}
         )
-        if not is_device:
-            continue
-        ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
-        best = None  # (total_ps, line)
-        for line in plane.lines:
-            total = sum(ev.duration_ps for ev in line.events)
-            if total > 0 and (best is None or total > best[0]):
-                best = (total, line)
-        if best is None:
-            continue
-        total, line = best
-        device_total_ps += total
-        t_min, t_max = None, 0
-        for ev in line.events:
-            start = ev.offset_ps
-            t_min = start if t_min is None else min(t_min, start)
-            t_max = max(t_max, start + ev.duration_ps)
-            op = ev_meta.get(ev.metadata_id, str(ev.metadata_id))
-            per_op[op] = per_op.get(op, 0) + ev.duration_ps
-        if t_min is not None:
-            # SUM spans across device planes (one per chip): the idle
-            # denominator is total available device-time, so a 4-chip trace
-            # with half-busy chips reports ~0.5 idle, not a clamped 0
-            device_span_ps += t_max - t_min
+        for _lname, evs in chosen:
+            for mid, _off, dur in evs:
+                op = meta.get(mid, str(mid))
+                per_op[op] = per_op.get(op, 0) + dur
     top = sorted(per_op.items(), key=lambda kv: -kv[1])[:10]
-    out["device_time_ms"] = round(device_total_ps / 1e9, 2)
-    out["device_span_ms"] = round(device_span_ps / 1e9, 2)
-    if device_span_ps:
-        out["device_idle_frac"] = round(
-            max(1.0 - device_total_ps / device_span_ps, 0.0), 4
-        )
+    out["device_busy_ms"] = round(busy_ps / 1e9, 2)
+    out["device_span_ms"] = round(span_ps / 1e9, 2)
+    if span_ps:
+        out["device_idle_frac"] = round(max(1.0 - busy_ps / span_ps, 0.0), 4)
+    out["per_device"] = per_plane
     out["top_ops_ms"] = [
         {"op": op, "ms": round(ps / 1e9, 3)} for op, ps in top
     ]

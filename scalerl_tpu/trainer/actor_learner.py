@@ -75,6 +75,10 @@ def fill_rollout_slot(
         slot["action"][t] = last_action
         slot["reward"][t] = reward
         slot["done"][t] = done
+        if timings is not None:
+            # separate mark: the obs row memcpy is the dominant write cost
+            # at pixel shapes and must not be attributed to "model"
+            timings.time("write_row")
         if t == unroll_length:
             slot["logits"][t] = 0.0
             break
@@ -236,6 +240,7 @@ class HostActorLearnerTrainer(BaseTrainer):
         for i, fn in enumerate(self.env_fns):
             envs = self._probe_env if i == 0 else fn()
             actors.append(_ActorThread(i, self, envs))
+        self.actors = actors  # exposed for phase-timing inspection (bench)
         for a in actors:
             a.start()
 
