@@ -26,7 +26,13 @@ def make_gym_env(
     normalize_obs: bool = False,
     **env_kwargs,
 ) -> Callable[[], gym.Env]:
-    """Return a thunk building one env (thunks are what vector ctors want)."""
+    """Return a thunk building one env (thunks are what vector ctors want).
+
+    ``env_id`` accepts either a gymnasium registry id or a direct
+    ``"pkg.module:ClassName"`` path — the latter imports and constructs the
+    class with ``env_kwargs``, no registration required (handy for custom
+    envs in spawned actor processes, whose registries start fresh).
+    """
 
     def thunk() -> gym.Env:
         # idempotent + cheap, and inside the thunk on purpose: vector-env
@@ -36,7 +42,18 @@ def make_gym_env(
 
         register_synthetic_envs()
         render_mode = "rgb_array" if (capture_video and idx == 0) else None
-        env = gym.make(env_id, render_mode=render_mode, **env_kwargs)
+        mod_name, _, cls_name = env_id.partition(":")
+        if cls_name.isidentifier():
+            # "pkg.module:ClassName" — a direct class path.  Gymnasium's own
+            # "module:EnvId" import syntax (e.g. "ale_py:ALE/Pong-v5") has a
+            # registry id, never a bare identifier, on the right-hand side,
+            # so it falls through to gym.make below.
+            import importlib
+
+            env_cls = getattr(importlib.import_module(mod_name), cls_name)
+            env = env_cls(render_mode=render_mode, **env_kwargs)
+        else:
+            env = gym.make(env_id, render_mode=render_mode, **env_kwargs)
         if capture_video and idx == 0 and video_dir is not None:
             env = gym.wrappers.RecordVideo(env, video_dir)
         env = gym.wrappers.RecordEpisodeStatistics(env)

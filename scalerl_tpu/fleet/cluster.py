@@ -423,7 +423,16 @@ class WorkerServer:
 
 class LocalCluster:
     """Gathers as local processes over pipes (parity: ``WorkerCluster``,
-    ``hpc/worker.py:241-258``) — doubles as the multi-node simulator."""
+    ``hpc/worker.py:241-258``) — doubles as the multi-node simulator.
+
+    ``max_restarts``: elastic recovery, beyond the reference (whose fleet
+    simply forgot dead workers — SURVEY.md §5).  When > 0, a supervisor
+    thread respawns a gather that dies unexpectedly — same worker-id range,
+    fresh pipe registered with the server — up to ``max_restarts`` times
+    across the cluster.  The ``QueueHub`` already drops the dead pipe; the
+    learner sees at most a brief throughput dip.  0 (default) keeps the
+    fail-fast behavior (errors surface via ``server.worker_errors``).
+    """
 
     def __init__(
         self,
@@ -431,6 +440,7 @@ class LocalCluster:
         config: FleetConfig,
         runner: EpisodeRunner,
         mp_context: Optional[str] = None,
+        max_restarts: int = 0,
     ) -> None:
         self.server = server
         self.config = config
@@ -440,31 +450,99 @@ class LocalCluster:
         # auto-selects spawn (runners must be picklable, e.g.
         # GenerationRunner over module-level fns)
         self.mp_context = mp_context
+        self.max_restarts = max_restarts
+        self.restarts = 0
         self.procs: List[mp.Process] = []
+        self._spans: List[Tuple[int, int]] = []  # (base_worker_id, n) per gather
+        self._ctx = None
+        self._stopping = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+
+    def _spawn(self, slot: int, base: int, n: int) -> None:
+        parent, child = self._ctx.Pipe(duplex=True)
+        # gathers spawn worker children, so they cannot be daemonic;
+        # join() terminates stragglers and their daemonic workers
+        proc = self._ctx.Process(
+            target=gather_main,
+            args=(PipeConnection(child), self.config, self.runner, base, n),
+        )
+        proc.start()
+        child.close()
+        self.server.add_gather_connection(PipeConnection(parent))
+        if slot < len(self.procs):
+            self.procs[slot] = proc
+        else:
+            self.procs.append(proc)
+            self._spans.append((base, n))
 
     def start(self) -> None:
         from scalerl_tpu.utils.platform import safe_mp_context
 
         per = self.config.workers_per_gather
         remaining = self.config.num_workers
-        ctx = mp.get_context(safe_mp_context(self.mp_context))
-        for _g in range(self.config.num_gathers):
+        self._ctx = mp.get_context(safe_mp_context(self.mp_context))
+        for g in range(self.config.num_gathers):
             n = min(per, remaining)
             remaining -= n
             base = self.server.assign_worker_ids(n)
-            parent, child = ctx.Pipe(duplex=True)
-            # gathers spawn worker children, so they cannot be daemonic;
-            # join() terminates stragglers and their daemonic workers
-            proc = ctx.Process(
-                target=gather_main,
-                args=(PipeConnection(child), self.config, self.runner, base, n),
+            self._spawn(g, base, n)
+        if self.max_restarts > 0:
+            self._supervisor = threading.Thread(
+                target=self._supervise, name="fleet-supervisor", daemon=True
             )
-            proc.start()
-            child.close()
-            self.server.add_gather_connection(PipeConnection(parent))
-            self.procs.append(proc)
+            self._supervisor.start()
+
+    def _supervise(self) -> None:
+        given_up: set = set()
+        while not self._stopping.wait(0.5):
+            for slot, proc in enumerate(self.procs):
+                if (
+                    proc.is_alive()
+                    or slot in given_up
+                    or self._stopping.is_set()
+                ):
+                    continue
+                if proc.exitcode == 0:
+                    # clean exit (task source drained): not a failure —
+                    # respawning would just burn budget on process churn
+                    given_up.add(slot)
+                    continue
+                if self.restarts >= self.max_restarts:
+                    # budget exhausted: surface it the fail-fast way (the
+                    # learner polls worker_errors) and keep watching the
+                    # OTHER slots rather than abandoning supervision
+                    logger.error(
+                        "fleet gather %d died (exit %s); restart budget "
+                        "exhausted (%d used)",
+                        slot, proc.exitcode, self.restarts,
+                    )
+                    self.server.worker_errors.put(
+                        {
+                            "worker_id": None,
+                            "task": None,
+                            "error": (
+                                f"gather {slot} died (exit {proc.exitcode}); "
+                                f"restart budget exhausted "
+                                f"({self.restarts}/{self.max_restarts})"
+                            ),
+                        }
+                    )
+                    given_up.add(slot)
+                    continue
+                self.restarts += 1
+                base, n = self._spans[slot]
+                logger.warning(
+                    "fleet gather %d died (exit %s); respawning workers "
+                    "%d..%d (restart %d/%d)",
+                    slot, proc.exitcode, base, base + n - 1,
+                    self.restarts, self.max_restarts,
+                )
+                self._spawn(slot, base, n)
 
     def join(self, timeout: float = 10.0) -> None:
+        self._stopping.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=2.0)
         deadline = time.monotonic() + timeout
         for p in self.procs:
             p.join(timeout=max(0.1, deadline - time.monotonic()))

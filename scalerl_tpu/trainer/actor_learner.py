@@ -116,22 +116,46 @@ class _ActorThread(threading.Thread):
 
     def run(self) -> None:
         tr = self.trainer
+        q = tr.queue
+        while True:
+            try:
+                self._act_loop()
+                return
+            except Exception as e:  # noqa: BLE001 - restart or funnel
+                if not tr.grant_actor_restart(self.actor_id, e):
+                    q.report_error(e)
+                    return
+                # the env stack is suspect after a crash (a dead subprocess
+                # env can't step again): rebuild it from the factory
+                try:
+                    self.envs.close()
+                except Exception:  # noqa: BLE001 - already broken
+                    pass
+                try:
+                    self.envs = tr.env_fns[self.actor_id]()
+                except Exception as rebuild_err:  # noqa: BLE001
+                    q.report_error(rebuild_err)
+                    return
+
+    def _act_loop(self) -> None:
+        tr = self.trainer
         agent = tr.agent
         q = tr.queue
         T = tr.args.rollout_length
         B = self.envs.num_envs
-        try:
-            obs, _ = self.envs.reset(seed=tr.args.seed + 1000 * self.actor_id)
-            last_action = np.zeros(B, np.int32)
-            reward = np.zeros(B, np.float32)
-            done = np.ones(B, bool)
-            core_state = agent.initial_state(B)
-            metrics = tr.episode_metrics[self.actor_id]
-            while not tr.stop_event.is_set():
-                idx = q.acquire(timeout=1.0)
-                if idx is None:
-                    continue
-                self.timings.reset()
+        obs, _ = self.envs.reset(seed=tr.args.seed + 1000 * self.actor_id)
+        last_action = np.zeros(B, np.int32)
+        reward = np.zeros(B, np.float32)
+        done = np.ones(B, bool)
+        core_state = agent.initial_state(B)
+        metrics = tr.episode_metrics[self.actor_id]
+        while not tr.stop_event.is_set():
+            idx = q.acquire(timeout=1.0)
+            if idx is None:
+                continue
+            self.timings.reset()
+            committed = False
+            try:
                 obs, last_action, reward, done, core_state = fill_rollout_slot(
                     q.slots[idx],
                     agent,  # central batched inference on device
@@ -146,11 +170,17 @@ class _ActorThread(threading.Thread):
                     timings=self.timings,
                 )
                 q.commit(idx)
-                self.timings.time("write")
-                with tr.frame_lock:
-                    tr.env_frames += T * B
-        except Exception as e:  # noqa: BLE001 - funneled to the learner
-            q.report_error(e)
+                committed = True
+            except BaseException:
+                # crash mid-fill: the acquired slot was never committed —
+                # hand it back or the pool shrinks one slot per restart
+                # until acquire() starves
+                if not committed:
+                    q.recycle([idx])
+                raise
+            self.timings.time("write")
+            with tr.frame_lock:
+                tr.env_frames += T * B
 
 
 class HostActorLearnerTrainer(BaseTrainer):
@@ -160,13 +190,23 @@ class HostActorLearnerTrainer(BaseTrainer):
         agent: ImpalaAgent,
         env_fns,  # list of callables, one vector env per actor
         run_name: Optional[str] = None,
+        max_actor_restarts: int = 0,
     ) -> None:
+        """``max_actor_restarts``: elastic actors (beyond the reference's
+        fail-fast funnels).  An actor thread that crashes — typically a
+        dead env subprocess — rebuilds its env stack from ``env_fns`` and
+        resumes, up to this many times across all actors; the learner sees
+        a throughput dip, not a dead run.  0 keeps fail-fast (the crash
+        re-raises in the learner via the rollout queue's error funnel)."""
         super().__init__(args, run_name=run_name)
         self.agent = agent
         self.env_fns = env_fns
         self.stop_event = threading.Event()
         self.frame_lock = threading.Lock()
         self.env_frames = 0
+        self.max_actor_restarts = max_actor_restarts
+        self.actor_restarts = 0
+        self._restart_lock = threading.Lock()
         self.param_server = ParameterServer()
 
         probe_env = env_fns[0]()
@@ -189,6 +229,21 @@ class HostActorLearnerTrainer(BaseTrainer):
             EpisodeMetrics(self.envs_per_actor) for _ in range(len(env_fns))
         ]
         self.learn_timings = Timings()
+
+    # ------------------------------------------------------------------
+    def grant_actor_restart(self, actor_id: int, exc: BaseException) -> bool:
+        """Consume one unit of the elastic-actor budget; False = fail fast."""
+        with self._restart_lock:
+            if self.actor_restarts >= self.max_actor_restarts:
+                return False
+            self.actor_restarts += 1
+            used = self.actor_restarts
+        if self.is_main_process:
+            self.text_logger.warning(
+                f"actor {actor_id} crashed ({type(exc).__name__}: {exc}); "
+                f"rebuilding its envs (restart {used}/{self.max_actor_restarts})"
+            )
+        return True
 
     # ------------------------------------------------------------------
     def _resume_pytree(self) -> Dict:

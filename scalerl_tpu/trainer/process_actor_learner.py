@@ -126,14 +126,23 @@ def _proc_actor_main(conn: PipeConnection, cfg: _ProcActorConfig, ring: ShmRollo
             idx = ring.acquire(timeout=1.0)
             if idx is None:
                 continue
-            slot = ring.slot(idx)
-            returns.clear()
-            obs, last_action, reward, done, core_state = fill_rollout_slot(
-                slot, agent, envs, obs, last_action, reward, done,
-                core_state, T, on_step=on_step,
-            )
-            slot["meta"][0] = cfg.actor_id
-            slot["meta"][1] = version
+            try:
+                slot = ring.slot(idx)
+                returns.clear()
+                obs, last_action, reward, done, core_state = fill_rollout_slot(
+                    slot, agent, envs, obs, last_action, reward, done,
+                    core_state, T, on_step=on_step,
+                )
+                slot["meta"][0] = cfg.actor_id
+                slot["meta"][1] = version
+            except BaseException:
+                # funneled failure mid-fill: hand the slot back before the
+                # error propagates, or each elastic restart strands one of
+                # num_buffers slots until the ring starves (mirror of the
+                # thread plane's q.recycle on crash)
+                slot = None  # drop views first so detach() can close later
+                ring.release(idx)
+                raise
             ring.commit(idx)
             slot = None  # release shm views now: a live view at loop exit
             # keeps the mapping exported and detach() cannot close it
@@ -171,7 +180,22 @@ class ProcessActorLearnerTrainer(BaseTrainer):
         agent,
         envs_per_actor: Optional[int] = None,
         run_name: Optional[str] = None,
+        max_actor_restarts: int = 0,
     ) -> None:
+        """``max_actor_restarts``: elastic actors — an actor that fails is
+        respawned (same actor id/seed/config, fresh pipe) up to this many
+        times across the run instead of failing the learner.
+
+        Contract: recovery is guaranteed only for *funneled* failures (the
+        actor caught its exception and sent ``{"kind": "error"}`` — env
+        crashes, OOM in the actor's Python, etc.); at that point the shm
+        ring is consistent, though the slot the actor had acquired but not
+        committed is stranded — size ``num_buffers`` with headroom.  A
+        hard-killed actor (SIGKILL mid-ring-push) is respawned best-effort,
+        but a producer that died between claiming and publishing a ring
+        cell wedges the lock-free ring for every later consumer at that
+        position — no user-space recovery exists for that, by the nature
+        of lock-free shared memory.  0 (default) keeps fail-fast."""
         super().__init__(args, run_name=run_name)
         self.agent = agent
         # args.num_envs is the TOTAL env-lane count (CLI semantics shared
@@ -184,8 +208,13 @@ class ProcessActorLearnerTrainer(BaseTrainer):
         self.env_frames = 0
         self._stop = threading.Event()
         self._actor_error: List[str] = []
+        self.max_actor_restarts = max_actor_restarts
+        self.actor_restarts = 0
         self.procs: List[mp.process.BaseProcess] = []
         self.conns: List[PipeConnection] = []
+        self._actor_of: Dict[PipeConnection, int] = {}
+        self._cfgs: List[_ProcActorConfig] = []
+        self._dying: Dict[int, float] = {}  # actor_id -> recheck deadline
 
         T1 = args.rollout_length + 1
         B = self.envs_per_actor
@@ -211,20 +240,77 @@ class ProcessActorLearnerTrainer(BaseTrainer):
         return "uint8" if len(self.agent.obs_shape) == 3 else "float32"
 
     # -- weight / stats / error service --------------------------------
+    def _grant_restart(self) -> bool:
+        if self.actor_restarts >= self.max_actor_restarts:
+            return False
+        self.actor_restarts += 1
+        return True
+
+    def _drop_conn(self, conn: PipeConnection, reason: str) -> None:
+        """A connection died: respawn its actor (elastic) or record the
+        failure (fail-fast).  Clean shutdown drops silently."""
+        if conn in self.conns:
+            self.conns.remove(conn)
+        actor_id = self._actor_of.pop(conn, None)
+        if actor_id is None or self._stop.is_set():
+            return
+        proc = self.procs[actor_id]
+        if proc.is_alive():
+            # pipe EOF'd while the process is still tearing down (the
+            # actor closes its conn in `finally` before interpreter exit):
+            # PARK it for the service loop to recheck — forgetting it here
+            # would yield neither restart nor error, and the learner would
+            # starve waiting on a producer that no longer exists
+            self._dying[actor_id] = time.monotonic() + 30.0
+            return
+        self._handle_actor_death(actor_id, reason, proc.exitcode)
+
+    def _handle_actor_death(self, actor_id: int, reason: str, exitcode) -> None:
+        if exitcode == 0:
+            # clean exit outside shutdown: the actor decided it was done
+            # (ring closed under it); nothing to recover, nothing to raise
+            return
+        if self._grant_restart():
+            logger.warning(
+                "actor process %d died (%s, exit %s); respawning "
+                "(restart %d/%d)",
+                actor_id, reason, exitcode,
+                self.actor_restarts, self.max_actor_restarts,
+            )
+            self._spawn_actor(actor_id)
+        else:
+            self._actor_error.append(
+                f"actor {actor_id} died ({reason}, exit {exitcode})"
+            )
+
+    def _check_dying(self) -> None:
+        """Recheck parked actors (pipe gone, process was still alive)."""
+        for actor_id, deadline in list(self._dying.items()):
+            proc = self.procs[actor_id]
+            if not proc.is_alive():
+                del self._dying[actor_id]
+                self._handle_actor_death(actor_id, "pipe dead", proc.exitcode)
+            elif time.monotonic() > deadline:
+                del self._dying[actor_id]
+                self._actor_error.append(
+                    f"actor {actor_id}: pipe closed but process still "
+                    "alive after 30s (hung teardown)"
+                )
+
     def _weight_service(self) -> None:
         while not self._stop.is_set():
+            self._check_dying()
             if not self.conns:
                 self._stop.wait(0.05)
                 continue
             ready, dead = wait_readable(self.conns, timeout=0.1)
             for conn in dead:
-                self.conns.remove(conn)
+                self._drop_conn(conn, "pipe dead")
             for conn in ready:
                 try:
                     msg = conn.recv()
                 except (EOFError, OSError, ConnectionError, ValueError):
-                    if conn in self.conns:
-                        self.conns.remove(conn)
+                    self._drop_conn(conn, "recv failed")
                     continue
                 if msg is None:
                     continue
@@ -241,37 +327,67 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                 elif msg["kind"] == "stats":
                     self.returns.extend(float(r) for r in msg["returns"])
                 elif msg["kind"] == "error":
-                    self._actor_error.append(
-                        f"actor {msg['actor_id']}:\n{msg['traceback']}"
-                    )
+                    actor_id = int(msg["actor_id"])
+                    if self._grant_restart():
+                        logger.warning(
+                            "actor %d failed; respawning (restart %d/%d):\n%s",
+                            actor_id, self.actor_restarts,
+                            self.max_actor_restarts, msg["traceback"],
+                        )
+                        # no blocking join here: it would stall weight/stats
+                        # service for every OTHER actor while the errored
+                        # process tears down; _spawn_actor retires the old
+                        # pipe, and mp reaps the finished child on the next
+                        # Process creation
+                        self._spawn_actor(actor_id)
+                    else:
+                        self._actor_error.append(
+                            f"actor {actor_id}:\n{msg['traceback']}"
+                        )
+
+    def _spawn_actor(self, i: int) -> None:
+        # retire any previous pipe registered for this actor slot
+        for c, a in list(self._actor_of.items()):
+            if a == i:
+                self._actor_of.pop(c, None)
+                if c in self.conns:
+                    self.conns.remove(c)
+        parent, child = self._ctx.Pipe(duplex=True)
+        proc = self._ctx.Process(
+            target=_proc_actor_main,
+            args=(PipeConnection(child), self._cfgs[i], self.ring),
+            daemon=True,
+        )
+        proc.start()
+        child.close()
+        if i < len(self.procs):
+            self.procs[i] = proc
+        else:
+            self.procs.append(proc)
+        conn = PipeConnection(parent)
+        self.conns.append(conn)
+        self._actor_of[conn] = i
 
     def start_actors(self) -> None:
         # spawn, not fork: the learner has JAX initialized (ADVICE r1 /
         # envs/vector/async_vec.py hazard note)
-        ctx = mp.get_context("spawn")
+        self._ctx = mp.get_context("spawn")
         env_id = self.args.env_id
         atari = env_id.startswith("ALE/") or "NoFrameskip" in env_id
         for i in range(self.args.num_actors):
-            parent, child = ctx.Pipe(duplex=True)
-            cfg = _ProcActorConfig(
-                actor_id=i,
-                args=self.args,
-                obs_shape=tuple(self.agent.obs_shape),
-                num_actions=self.agent.num_actions,
-                obs_dtype_name=self._obs_dtype_name(),
-                envs_per_actor=self.envs_per_actor,
-                seed=self.args.seed + 7919 * i,
-                atari=atari,
+            self._cfgs.append(
+                _ProcActorConfig(
+                    actor_id=i,
+                    args=self.args,
+                    obs_shape=tuple(self.agent.obs_shape),
+                    num_actions=self.agent.num_actions,
+                    obs_dtype_name=self._obs_dtype_name(),
+                    envs_per_actor=self.envs_per_actor,
+                    seed=self.args.seed + 7919 * i,
+                    atari=atari,
+                )
             )
-            proc = ctx.Process(
-                target=_proc_actor_main,
-                args=(PipeConnection(child), cfg, self.ring),
-                daemon=True,
-            )
-            proc.start()
-            child.close()
-            self.procs.append(proc)
-            self.conns.append(PipeConnection(parent))
+            self._spawn_actor(i)
         self._weight_thread.start()
 
     # -- resume (parity with HostActorLearnerTrainer) ------------------

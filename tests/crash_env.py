@@ -1,0 +1,58 @@
+"""Fault-injection env for elasticity tests (importable by spawn children).
+
+``CrashOnceEnv`` is a trivial Box(4)/Discrete(2) env that raises
+``RuntimeError`` on its Nth step — but only ONCE machine-wide: the first
+instance to reach the crash step claims the marker file named by the
+``SCALERL_CRASH_MARKER`` env var (inherited by spawned actor processes)
+and dies; every later instance, in any process, steps normally.  With the
+marker var unset the env never crashes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import gymnasium as gym
+import numpy as np
+
+
+class CrashOnceEnv(gym.Env):
+    metadata: dict = {"render_modes": []}
+
+    def __init__(self, crash_at_step: int = 24, episode_length: int = 16,
+                 render_mode=None) -> None:
+        self.render_mode = render_mode
+        self.observation_space = gym.spaces.Box(-1.0, 1.0, (4,), np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+        self.crash_at_step = crash_at_step
+        self.episode_length = episode_length
+        self._t = 0
+        self._total = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.full(4, (self._t % self.episode_length) / self.episode_length,
+                       np.float32)
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self._total += 1
+        marker = os.environ.get("SCALERL_CRASH_MARKER")
+        if marker and self._total >= self.crash_at_step:
+            try:
+                # O_EXCL: exactly one instance machine-wide wins the crash
+                fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                raise RuntimeError("injected env fault (CrashOnceEnv)")
+            except FileExistsError:
+                pass  # someone already crashed; behave normally forever
+        self._t += 1
+        done = self._t >= self.episode_length
+        if done:
+            self._t = 0
+        return self._obs(), 0.1, done, False, {}
+
+    def close(self):
+        pass

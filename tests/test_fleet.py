@@ -238,6 +238,33 @@ def test_local_cluster_end_to_end():
     assert worker_ids <= set(range(4)) and len(worker_ids) >= 2
 
 
+def test_local_cluster_elastic_restart():
+    """Elastic recovery (beyond the reference, which forgot dead workers):
+    kill a gather process mid-run; the supervisor respawns it with the same
+    worker-id range and results keep flowing."""
+    config = FleetConfig(num_workers=2, workers_per_gather=2, upload_batch=1)
+    server = WorkerServer(config, _make_task_source(60, lambda: server.params.version))
+    server.publish({"w": np.array([1.0, 2.0], np.float32)})
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, _bandit_runner, max_restarts=2)
+    cluster.start()
+    try:
+        # let the fleet produce, then kill its only gather
+        pre = _drain(server, 5)
+        assert len(pre) == 5
+        cluster.procs[0].terminate()
+        cluster.procs[0].join(timeout=10.0)
+        # supervisor respawns within ~0.5 s; results must keep flowing
+        post = _drain(server, 10, timeout=60.0)
+        assert len(post) == 10, f"only {len(post)} results after gather kill"
+        assert cluster.restarts >= 1
+        # respawned workers still pull the published weights
+        assert all(r["param_version"] == 1 for r in post)
+    finally:
+        cluster.join()
+        server.stop()
+
+
 def test_remote_cluster_over_sockets():
     entry_port, worker_port = _free_port(), _free_port()
     config = FleetConfig(

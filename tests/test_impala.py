@@ -207,6 +207,56 @@ def test_host_actor_learner_trainer_smoke(tmp_path):
     assert trainer.param_server.version > 0
 
 
+class _CrashOnceVec:
+    """Vector-env proxy: the FIRST instance raises after ``crash_after``
+    steps (a dead env backend); rebuilds behave normally."""
+
+    built = 0
+
+    def __init__(self, inner, crash_after: int) -> None:
+        type(self).built += 1
+        self._inner = inner
+        self._crash_after = crash_after if type(self).built == 1 else None
+        self._steps = 0
+
+    def step(self, actions):
+        self._steps += 1
+        if self._crash_after is not None and self._steps >= self._crash_after:
+            raise RuntimeError("env backend died")
+        return self._inner.step(actions)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_host_actor_elastic_restart(tmp_path):
+    """Elastic actors: a crashing env stack is rebuilt from the factory and
+    training runs to completion instead of dying (restart budget honored)."""
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    _CrashOnceVec.built = 0
+    args = _args(
+        rollout_length=8, batch_size=4, num_actors=1, num_buffers=8,
+        logger_frequency=10**9, work_dir=str(tmp_path), hidden_size=32,
+    )
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+
+    def env_fn():
+        return _CrashOnceVec(
+            make_vect_envs("CartPole-v1", num_envs=4, seed=0, async_envs=False),
+            crash_after=12,
+        )
+
+    trainer = HostActorLearnerTrainer(
+        args, agent, [env_fn], max_actor_restarts=1
+    )
+    result = trainer.train(total_frames=512)
+    assert result["env_frames"] >= 512
+    assert trainer.actor_restarts == 1
+    assert _CrashOnceVec.built == 2  # the crashed stack was rebuilt
+    trainer.close()
+
+
 def test_parameter_server_lazy_host_snapshot():
     """A to_host=False publish (SEED hot loop) still hands pullers numpy:
     materialization happens lazily on first pull and is cached."""
