@@ -1,0 +1,64 @@
+"""R2D2 — recurrent replay DQN on the host actor plane.
+
+Beyond-parity entry point (the reference's DQN family is feed-forward;
+R2D2 completes the Ape-X lineage its README cites): actor threads fill
+``[T+1, B]`` sequence slots with their entering LSTM state through the
+same machinery as the IMPALA host plane; the learner keeps a prioritized
+SEQUENCE replay in device memory and runs burn-in + n-step double-Q
+updates under value rescaling as one jitted program.
+
+Usage::
+
+    python examples/train_r2d2.py --env-id CartPole-v1 --max-timesteps 100000
+    # memory task (flash cue -> delay -> recall; positive return needs LSTM)
+    python examples/train_r2d2.py --env-id RecallGym-v0 --max-timesteps 60000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import R2D2Agent
+from scalerl_tpu.config import R2D2Arguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+
+
+def main() -> None:
+    args = parse_args(R2D2Arguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    import numpy as np
+
+    from scalerl_tpu.trainer.r2d2 import R2D2Trainer
+
+    envs_per_actor = max(args.num_envs // args.num_actors, 1)
+
+    def env_fn(i: int):
+        return lambda: make_vect_envs(
+            args.env_id, num_envs=envs_per_actor, seed=args.seed + i,
+            async_envs=False,
+        )
+
+    probe = make_vect_envs(args.env_id, num_envs=1, async_envs=False)
+    obs_shape = probe.single_observation_space.shape
+    num_actions = probe.single_action_space.n
+    obs_dtype = np.uint8 if len(obs_shape) == 3 else np.float32
+    probe.close()
+
+    agent = R2D2Agent(
+        args, obs_shape=obs_shape, num_actions=num_actions, obs_dtype=obs_dtype
+    )
+    trainer = R2D2Trainer(
+        args, agent, [env_fn(i) for i in range(args.num_actors)]
+    )
+    try:
+        summary = trainer.train(total_frames=args.max_timesteps)
+        print("final:", {k: round(v, 3) for k, v in summary.items()})
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,340 @@
+"""R2D2: recurrent replay distributed DQN (Kapturowski et al. 2019).
+
+Beyond-parity algorithm family: the reference's DQN lineage is feed-
+forward only (``scalerl/algorithms/dqn``, ``scalerl/algorithms/apex``) and
+its README cites the Ape-X line without a recurrent member.  R2D2 = Ape-X
+plus: sequence replay with the actor's stored LSTM state, burn-in to
+de-stale that state before the gradient window, n-step double-Q targets
+under the invertible value rescaling ``h``, and per-sequence priorities
+``eta * max|td| + (1 - eta) * mean|td|``.
+
+TPU shape: the whole learn step — burn-in unrolls, train unrolls of both
+online and target nets, n-step target assembly, IS-weighted loss, new
+priorities — is ONE jitted pure function over ``[B, T+1]`` sequence
+batches (time axes static, ``nn.scan`` LSTM), so XLA fuses it the same
+way it does the IMPALA learner.  Actors ride the host actor plane's
+``[T+1, B]`` slot machinery unchanged (``fill_rollout_slot`` stores
+entering core states already).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.models.recurrent_q import RecurrentQNet
+
+
+def value_rescale(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    """h(x) = sign(x)(sqrt(|x|+1) - 1) + eps*x  (R2D2 eq. from Pohlen et
+    al. 2018): compresses large returns so one fixed lr handles Atari-scale
+    reward magnitudes without clipping away magnitude information."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def value_rescale_inv(x: jnp.ndarray, eps: float = 1e-3) -> jnp.ndarray:
+    """Closed-form inverse of :func:`value_rescale`."""
+    return jnp.sign(x) * (
+        jnp.square(
+            (jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0)
+            / (2.0 * eps)
+        )
+        - 1.0
+    )
+
+
+@struct.dataclass
+class R2D2TrainState:
+    params: Any
+    target_params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def build_model(args: R2D2Arguments, num_actions: int) -> RecurrentQNet:
+    return RecurrentQNet(
+        num_actions=num_actions,
+        use_lstm=args.use_lstm,
+        hidden_size=args.hidden_size,
+        lstm_layers=args.lstm_layers,
+        dueling=args.dueling_dqn,
+    )
+
+
+def n_step_double_q_targets(
+    q_online: jnp.ndarray,  # [Tt, B, A] over train rows (post burn-in)
+    q_target: jnp.ndarray,  # [Tt, B, A]
+    action: jnp.ndarray,  # [T1, B] trajectory rows (model-input convention)
+    reward: jnp.ndarray,  # [T1, B]
+    done: jnp.ndarray,  # [T1, B] bool
+    burn_in: int,
+    n_steps: int,
+    gamma: float,
+    rescale_eps: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(td_errors [M, B], qa [M, B]) for the M = T1 - burn_in - n valid rows.
+
+    Row convention (``data/trajectory.py``): ``action[t]``/``reward[t]``
+    are the action leading TO ``obs[t]`` / the reward received with it, so
+    the transition at row g pairs ``Q(s_g, action[g+1])`` with rewards
+    ``g+1..g+n`` and a bootstrap at row ``g+n``.
+    """
+    T1 = action.shape[0]
+    b = burn_in
+    M = T1 - b - n_steps
+    # Q(s_g, a_g-taken) over rows g in [b, b+M)
+    a_taken = action[b + 1 : b + 1 + M]  # [M, B]
+    qa = jnp.take_along_axis(q_online[:M], a_taken[..., None], axis=-1)[..., 0]
+
+    rewards = reward[1:]  # index g-1 holds r_{g} arrival reward for row g
+    disc = 1.0 - done[1:].astype(jnp.float32)
+    ret = jnp.zeros_like(qa)
+    live = jnp.ones_like(qa)
+    for k in range(n_steps):
+        ret = ret + (gamma**k) * live * rewards[b + k : b + k + M]
+        live = live * disc[b + k : b + k + M]
+
+    # double-Q at the bootstrap row g + n (local index g + n - b)
+    q_boot_online = q_online[n_steps : n_steps + M]  # [M, B, A]
+    q_boot_target = q_target[n_steps : n_steps + M]
+    a_star = jnp.argmax(q_boot_online, axis=-1)
+    boot = jnp.take_along_axis(q_boot_target, a_star[..., None], axis=-1)[..., 0]
+
+    target = value_rescale(
+        ret
+        + (gamma**n_steps)
+        * live
+        * value_rescale_inv(boot, rescale_eps),
+        rescale_eps,
+    )
+    td = qa - jax.lax.stop_gradient(target)
+    return td, qa
+
+
+def make_r2d2_learn_fn(model: RecurrentQNet, optimizer, args: R2D2Arguments):
+    """Pure (state, fields[B,T1,...], core, is_weights) ->
+    (state, metrics, new_priorities)."""
+    b = args.burn_in
+
+    def unroll(params, obs, action, reward, done, core):
+        out, core = model.apply(params, obs, action, reward, done, core)
+        return out.q_values, core
+
+    def loss_fn(params, target_params, fields, core, weights):
+        # [B, T1, ...] -> time-major [T1, B, ...]
+        obs = jnp.moveaxis(fields["obs"], 0, 1)
+        action = jnp.moveaxis(fields["action"], 0, 1)
+        reward = jnp.moveaxis(fields["reward"], 0, 1)
+        done = jnp.moveaxis(fields["done"], 0, 1)
+
+        if b > 0:
+            # burn-in: advance both cores over the stale prefix, no grads
+            _, warm_core = unroll(
+                params, obs[:b], action[:b], reward[:b], done[:b], core
+            )
+            warm_core = jax.lax.stop_gradient(warm_core)
+            _, warm_core_t = unroll(
+                target_params, obs[:b], action[:b], reward[:b], done[:b], core
+            )
+        else:
+            warm_core = warm_core_t = core
+        q_online, _ = unroll(
+            params, obs[b:], action[b:], reward[b:], done[b:], warm_core
+        )
+        q_target, _ = unroll(
+            target_params, obs[b:], action[b:], reward[b:], done[b:], warm_core_t
+        )
+        q_target = jax.lax.stop_gradient(q_target)
+
+        td, qa = n_step_double_q_targets(
+            q_online, q_target, action, reward, done,
+            burn_in=b, n_steps=args.n_steps, gamma=args.gamma,
+            rescale_eps=args.value_rescale_eps,
+        )
+        # mean over the sequence's TD rows, IS-weighted sum over the batch
+        # (repo loss convention: sums over batch — lr tuning is batch-size
+        # dependent, see agents/ppo.py note)
+        per_seq = jnp.mean(jnp.square(td), axis=0)  # [B]
+        loss = 0.5 * jnp.sum(weights * per_seq)
+
+        abs_td = jnp.abs(td)
+        new_prio = args.priority_eta * jnp.max(abs_td, axis=0) + (
+            1.0 - args.priority_eta
+        ) * jnp.mean(abs_td, axis=0)
+        metrics = {
+            "total_loss": loss,
+            "mean_q": jnp.mean(qa),
+            "mean_abs_td": jnp.mean(abs_td),
+        }
+        return loss, (metrics, new_prio)
+
+    def learn(state: R2D2TrainState, fields, core, weights):
+        (loss, (metrics, new_prio)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.target_params, fields, core, weights)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        step = state.step + 1
+        target_params = optax.periodic_update(
+            params, state.target_params, step, args.target_update_frequency
+        )
+        return (
+            R2D2TrainState(
+                params=params,
+                target_params=target_params,
+                opt_state=opt_state,
+                step=step,
+            ),
+            metrics,
+            new_prio,
+        )
+
+    return learn
+
+
+class _EpsGreedyActorView:
+    """Per-actor policy facade for ``fill_rollout_slot``: eps-greedy over
+    the agent's LIVE params (central inference), Ape-X eps ladder."""
+
+    def __init__(self, agent: "R2D2Agent", eps: float, seed: int) -> None:
+        self._agent = agent
+        self._eps = eps
+        self._key = jax.random.PRNGKey(seed)
+
+    def initial_state(self, batch_size: int):
+        return self._agent.model.initial_state(batch_size)
+
+    def act(self, obs, last_action, reward, done, core_state):
+        self._key, sub = jax.random.split(self._key)
+        action, q, core = self._agent._act(
+            self._agent.state.params, obs, last_action, reward, done,
+            core_state, self._eps, sub,
+        )
+        # q rides the slot's logits field: not consumed by the learner,
+        # but keeps the slot layout identical to the IMPALA planes
+        return action, q, core
+
+    def close(self) -> None:
+        pass
+
+
+class R2D2Agent(BaseAgent):
+    def __init__(
+        self,
+        args: R2D2Arguments,
+        obs_shape: Tuple[int, ...],
+        num_actions: int,
+        obs_dtype=np.float32,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self.obs_dtype = obs_dtype
+        self.model = build_model(args, num_actions)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(args.max_grad_norm)
+            if getattr(args, "max_grad_norm", 0) and args.max_grad_norm > 0
+            else optax.identity(),
+            optax.adam(args.learning_rate),
+        )
+        key = key if key is not None else jax.random.PRNGKey(args.seed)
+        dummy_obs = jnp.zeros((1, 1) + self.obs_shape, obs_dtype)
+        params = self.model.init(
+            key,
+            dummy_obs,
+            jnp.zeros((1, 1), jnp.int32),
+            jnp.zeros((1, 1), jnp.float32),
+            jnp.zeros((1, 1), bool),
+            self.model.initial_state(1),
+        )
+        self.state = R2D2TrainState(
+            params=params,
+            target_params=params,
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        self._learn = jax.jit(make_r2d2_learn_fn(self.model, self.optimizer, args))
+        self._act = jax.jit(self._act_impl)
+
+    # -- acting --------------------------------------------------------
+    def _act_impl(self, params, obs, last_action, reward, done, core, eps, key):
+        out, new_core = self.model.apply(
+            params,
+            jnp.asarray(obs)[None],
+            jnp.asarray(last_action)[None],
+            jnp.asarray(reward)[None],
+            jnp.asarray(done)[None],
+            core,
+        )
+        q = out.q_values[0]  # [B, A]
+        greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+        B = greedy.shape[0]
+        k_eps, k_rand = jax.random.split(key)
+        explore = jax.random.uniform(k_eps, (B,)) < eps
+        random_a = jax.random.randint(k_rand, (B,), 0, self.num_actions)
+        return jnp.where(explore, random_a, greedy), q, new_core
+
+    def actor_view(self, actor_id: int) -> _EpsGreedyActorView:
+        """Ape-X eps ladder: eps_i = eps_base ** (1 + i/(N-1) * alpha)."""
+        n = max(self.args.num_actors, 1)
+        frac = actor_id / max(n - 1, 1)
+        eps = self.args.eps_base ** (1.0 + frac * self.args.eps_alpha)
+        return _EpsGreedyActorView(self, eps, self.args.seed + 101 * actor_id)
+
+    def initial_state(self, batch_size: int):
+        return self.model.initial_state(batch_size)
+
+    def get_action(self, obs: np.ndarray) -> np.ndarray:
+        B = obs.shape[0]
+        view = self._default_view()
+        a, _q, view_core = view.act(
+            obs,
+            np.zeros(B, np.int32),
+            np.zeros(B, np.float32),
+            np.ones(B, bool),
+            self.model.initial_state(B),
+        )
+        return np.asarray(a)
+
+    def _default_view(self) -> _EpsGreedyActorView:
+        if not hasattr(self, "_dview"):
+            self._dview = self.actor_view(0)
+        return self._dview
+
+    def predict(self, obs: np.ndarray) -> np.ndarray:
+        B = obs.shape[0]
+        _a, q, _c = self._act(
+            self.state.params, obs, np.zeros(B, np.int32),
+            np.zeros(B, np.float32), np.ones(B, bool),
+            self.model.initial_state(B), 0.0, jax.random.PRNGKey(0),
+        )
+        return np.asarray(jnp.argmax(q, axis=-1))
+
+    # -- learning ------------------------------------------------------
+    def learn_sequences(self, fields, core, weights):
+        """One update on a sampled sequence batch; returns (metrics,
+        new_priorities) with the state updated in place."""
+        self.state, metrics, prio = self._learn(self.state, fields, core, weights)
+        return metrics, prio
+
+    def learn(self, batch) -> Dict[str, float]:
+        metrics, _ = self.learn_sequences(
+            batch["fields"], batch["core"], batch["weights"]
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self.state.params
+
+    def set_weights(self, weights) -> None:
+        self.state = self.state.replace(params=weights)

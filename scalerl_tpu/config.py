@@ -177,6 +177,68 @@ class A3CArguments(RLArguments):
 
 
 @dataclass
+class R2D2Arguments(RLArguments):
+    """R2D2 options (beyond-parity: recurrent replay distributed DQN,
+    Kapturowski et al. 2019 — the Ape-X lineage the reference's README
+    cites without a recurrent member).
+
+    Sequences of ``rollout_length`` steps are stored with the actor's
+    entering LSTM state; the learner burns in the first ``burn_in`` rows
+    (no gradient) to de-stale the stored state, trains Q on the rest with
+    n-step double-Q targets under the h-rescaling, and feeds back
+    per-sequence priorities ``eta * max|td| + (1 - eta) * mean|td|``.
+    """
+
+    algo_name: str = "r2d2"
+    # Model
+    use_lstm: bool = True
+    hidden_size: int = 256
+    lstm_layers: int = 1
+    dueling_dqn: bool = True
+    # Sequence pipeline (actor side = the host actor plane's [T+1, B] slots)
+    rollout_length: int = 20
+    burn_in: int = 8
+    num_actors: int = 2
+    num_buffers: int = 16
+    # Exploration: per-actor eps ladder (Ape-X convention)
+    eps_base: float = 0.4
+    eps_alpha: float = 7.0
+    # Learning
+    n_steps: int = 3
+    batch_size: int = 16  # sequences per update
+    replay_capacity: int = 2048  # sequences
+    warmup_sequences: int = 64
+    train_intensity: int = 1  # learn steps per inserted slot batch
+    target_update_frequency: int = 400
+    # PER over sequences
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    priority_eta: float = 0.9
+    # Value rescaling h(x) = sign(x)(sqrt(|x|+1)-1) + eps*x
+    value_rescale_eps: float = 1e-3
+
+    def validate(self) -> None:
+        super().validate()
+        if not 0 <= self.burn_in < self.rollout_length:
+            raise ValueError(
+                f"burn_in ({self.burn_in}) must be in [0, rollout_length="
+                f"{self.rollout_length})"
+            )
+        if self.n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
+        if self.burn_in + self.n_steps >= self.rollout_length + 1:
+            raise ValueError(
+                "rollout_length must leave at least one trainable row: need "
+                f"burn_in ({self.burn_in}) + n_steps ({self.n_steps}) <= "
+                f"rollout_length ({self.rollout_length})"
+            )
+        if not 0.0 <= self.priority_eta <= 1.0:
+            raise ValueError(
+                f"priority_eta must be in [0, 1], got {self.priority_eta}"
+            )
+
+
+@dataclass
 class PPOArguments(RLArguments):
     """PPO options (beyond-parity algorithm family).
 

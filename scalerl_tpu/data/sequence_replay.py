@@ -1,0 +1,127 @@
+"""Prioritized SEQUENCE replay for R2D2: whole [T+1] chunks as units.
+
+Where ``data/replay.py`` stores transitions, this buffer stores fixed-
+length trajectory chunks — each with the recurrent core state the actor
+ENTERED the chunk with (Kapturowski et al. 2019 "stored state") — and
+holds one priority per sequence.  Everything is an HBM-resident pytree
+with static shapes: inserts are batched dynamic-slice writes, sampling is
+the same proportional prefix-sum machinery as transition PER
+(``ops/pallas_per.py``), and priority updates are scatter writes.  The
+reference has no sequence replay (its replay layer is transition-only,
+``scalerl/data/replay_buffer.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from scalerl_tpu.ops.pallas_per import hierarchical_sample
+
+
+@struct.dataclass
+class SequenceReplayState:
+    storage: Dict[str, jnp.ndarray]  # field -> [capacity, T1, ...]
+    core: Tuple  # per-layer (c, h): [capacity, core_dim]
+    priorities: jnp.ndarray  # [capacity] f32, 0 = empty slot
+    pos: jnp.ndarray  # next write cursor
+    size: jnp.ndarray  # filled count
+
+
+def seq_init(
+    field_shapes: Dict[str, Tuple[Tuple[int, ...], Any]],
+    core_shapes: Tuple[Tuple[int, ...], ...],
+    capacity: int,
+) -> SequenceReplayState:
+    """``field_shapes``: name -> (per-sequence shape incl. time axis, dtype);
+    ``core_shapes``: per-LSTM-layer (core_dim,) shapes (c and h alike)."""
+    storage = {
+        name: jnp.zeros((capacity,) + tuple(shape), dtype)
+        for name, (shape, dtype) in field_shapes.items()
+    }
+    core = tuple(
+        (
+            jnp.zeros((capacity,) + tuple(s), jnp.float32),
+            jnp.zeros((capacity,) + tuple(s), jnp.float32),
+        )
+        for s in core_shapes
+    )
+    return SequenceReplayState(
+        storage=storage,
+        core=core,
+        priorities=jnp.zeros(capacity, jnp.float32),
+        pos=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def seq_add(
+    state: SequenceReplayState,
+    batch: Dict[str, jnp.ndarray],  # field -> [B, T1, ...]
+    core: Tuple,  # per-layer (c[B, dim], h[B, dim])
+    priorities: jnp.ndarray,  # [B]
+) -> SequenceReplayState:
+    """Insert B sequences at the ring cursor (wrapping)."""
+    capacity = state.priorities.shape[0]
+    B = priorities.shape[0]
+    idx = (state.pos + jnp.arange(B)) % capacity
+
+    storage = {
+        name: arr.at[idx].set(batch[name]) for name, arr in state.storage.items()
+    }
+    new_core = tuple(
+        (c.at[idx].set(bc), h.at[idx].set(bh))
+        for (c, h), (bc, bh) in zip(state.core, core)
+    )
+    return SequenceReplayState(
+        storage=storage,
+        core=new_core,
+        priorities=state.priorities.at[idx].set(priorities),
+        pos=(state.pos + B) % capacity,
+        size=jnp.minimum(state.size + B, capacity),
+    )
+
+
+@partial(jax.jit, static_argnums=(2,))
+def seq_sample(
+    state: SequenceReplayState,
+    key: jax.Array,
+    batch_size: int,
+    alpha: float = 0.6,
+    beta: float = 0.4,
+) -> Tuple[Dict[str, jnp.ndarray], Tuple, jnp.ndarray, jnp.ndarray]:
+    """Proportional sample of ``batch_size`` sequences.
+
+    Returns (fields [B, T1, ...], core (c,h)[B,...] per layer,
+    indices [B], importance weights [B] normalized by their max —
+    the PER convention, ``scalerl/data/replay_buffer.py:370-381``).
+    """
+    scaled = jnp.power(state.priorities, alpha)  # empty slots: 0^a = 0
+    total = jnp.sum(scaled)
+    u = jax.random.uniform(key, (batch_size,))
+    # stratified targets over the live mass
+    targets = (jnp.arange(batch_size) + u) / batch_size * total
+    idx = hierarchical_sample(scaled, targets)
+
+    probs = scaled[idx] / jnp.maximum(total, 1e-9)
+    n = jnp.maximum(state.size.astype(jnp.float32), 1.0)
+    weights = jnp.power(n * jnp.maximum(probs, 1e-9), -beta)
+    weights = weights / jnp.maximum(jnp.max(weights), 1e-9)
+
+    fields = {name: arr[idx] for name, arr in state.storage.items()}
+    core = tuple((c[idx], h[idx]) for c, h in state.core)
+    return fields, core, idx, weights
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def seq_update_priorities(
+    state: SequenceReplayState, idx: jnp.ndarray, priorities: jnp.ndarray
+) -> SequenceReplayState:
+    return state.replace(
+        priorities=state.priorities.at[idx].set(jnp.maximum(priorities, 1e-6))
+    )

@@ -62,7 +62,11 @@ class JaxRecall(JaxEnv):
                 (cols >= half) == (state.cue % 2)
             )
         else:
-            in_q = (cols >= half) == (state.cue % 2)
+            # broadcast against rows explicitly: the half-plane formula
+            # alone yields a [1, size] mask and a wrong-shaped frame
+            in_q = jnp.broadcast_to(
+                (cols >= half) == (state.cue % 2), (self.size, self.size)
+            )
         frame = jnp.where((state.t == 0) & in_q, 255, 0).astype(jnp.uint8)
         return frame[:, :, None]
 

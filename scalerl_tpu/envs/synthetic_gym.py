@@ -88,6 +88,69 @@ class PixelRingEnv(gym.Env):
         pass
 
 
+class RecallGymEnv(gym.Env):
+    """Numpy/gym twin of ``envs/jax_envs/recall.py:JaxRecall`` — flash a
+    quadrant cue, wait ``delay`` blank steps, demand recall (+1 / -1 at
+    the final step).  A memoryless policy is pinned at expected return
+    ``(2 - num_cues) / num_cues``; any positive mean return is proof of
+    recurrent memory.  Used by the R2D2 host-plane memory proof."""
+
+    metadata: dict = {"render_modes": []}
+
+    def __init__(self, size: int = 16, delay: int = 6, num_cues: int = 4,
+                 render_mode=None) -> None:
+        if num_cues not in (2, 4):
+            raise ValueError("num_cues must be 2 or 4 (quadrant patterns)")
+        self.render_mode = render_mode
+        self.size = size
+        self.delay = delay
+        self.num_cues = num_cues
+        self.observation_space = gym.spaces.Box(0, 255, (size, size, 1), np.uint8)
+        self.action_space = gym.spaces.Discrete(num_cues)
+        self._rng = np.random.default_rng(0)
+        self._cue = 0
+        self._t = 0
+
+    def _render_frame(self) -> np.ndarray:
+        # formula-identical to JaxRecall._render (cue visible only at t=0)
+        half = self.size // 2
+        rows = np.arange(self.size)[:, None]
+        cols = np.arange(self.size)[None, :]
+        if self.num_cues == 4:
+            in_q = ((rows >= half) == (self._cue // 2)) & (
+                (cols >= half) == (self._cue % 2)
+            )
+        else:
+            # broadcast against rows explicitly (same fix as JaxRecall:
+            # the half-plane mask alone is [1, size])
+            in_q = np.broadcast_to(
+                (cols >= half) == (self._cue % 2), (self.size, self.size)
+            )
+        frame = np.where((self._t == 0) & in_q, 255, 0).astype(np.uint8)
+        return frame[:, :, None]
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = int(self._rng.integers(self.num_cues))
+        self._t = 0
+        return self._render_frame(), {}
+
+    def step(self, action):
+        self._t += 1
+        done = self._t > self.delay
+        reward = (
+            (1.0 if int(action) == self._cue else -1.0) if done else 0.0
+        )
+        if done:
+            self._cue = int(self._rng.integers(self.num_cues))
+            self._t = 0
+        return self._render_frame(), reward, done, False, {}
+
+    def close(self):
+        pass
+
+
 def register_synthetic_envs() -> None:
     """Idempotently register the synthetic envs with gymnasium."""
     import gymnasium as gym
@@ -96,5 +159,11 @@ def register_synthetic_envs() -> None:
         gym.register(
             id="PixelRing-v0",
             entry_point="scalerl_tpu.envs.synthetic_gym:PixelRingEnv",
+            disable_env_checker=True,
+        )
+    if "RecallGym-v0" not in gym.registry:
+        gym.register(
+            id="RecallGym-v0",
+            entry_point="scalerl_tpu.envs.synthetic_gym:RecallGymEnv",
             disable_env_checker=True,
         )

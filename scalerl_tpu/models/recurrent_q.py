@@ -1,0 +1,119 @@
+"""Recurrent Q-network for R2D2 (beyond-parity algorithm family).
+
+Same time-major recurrent signature as ``models/atari.py:AtariNet`` —
+``(obs[T,B,...], last_action[T,B], reward[T,B], done[T,B], core)`` with a
+done-masked LSTM carry — but the head is a (optionally dueling) Q-value
+layer instead of policy/baseline.  The torso is chosen by observation
+rank: conv stack for pixel obs (rank 3 per step), Dense stack for vector
+obs.  Recurrence rides the same ``nn.scan`` over ``_LSTMCore`` so rollout
+chunks replay exactly as collected (Kapturowski et al. 2019, "stored
+state" strategy).
+
+Reference context: the reference ships no recurrent value-based agent at
+all (its DQN family is feed-forward MLPs, ``scalerl/algorithms/dqn``);
+R2D2 completes the Ape-X lineage its README cites.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from scalerl_tpu.models.atari import _LSTMCore, LSTMState
+
+
+class RecurrentQOutput(NamedTuple):
+    q_values: jnp.ndarray  # [T, B, num_actions]
+
+
+class RecurrentQNet(nn.Module):
+    num_actions: int
+    use_lstm: bool = True
+    hidden_size: int = 512
+    lstm_layers: int = 1
+    dueling: bool = True
+    conv_features: Sequence[int] = (32, 64, 64)
+    conv_kernels: Sequence[int] = (8, 4, 3)
+    conv_strides: Sequence[int] = (4, 2, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def core_size(self) -> int:
+        return self.hidden_size + self.num_actions + 1
+
+    def initial_state(self, batch_size: int) -> LSTMState:
+        if not self.use_lstm:
+            return ()
+        return tuple(
+            (
+                jnp.zeros((batch_size, self.core_size), jnp.float32),
+                jnp.zeros((batch_size, self.core_size), jnp.float32),
+            )
+            for _ in range(self.lstm_layers)
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jnp.ndarray,  # [T, B, ...]: rank-3 trailing = pixels, rank-1 = vector
+        last_action: jnp.ndarray,  # [T, B] int32
+        reward: jnp.ndarray,  # [T, B] float
+        done: jnp.ndarray,  # [T, B] bool
+        core_state: LSTMState = (),
+    ) -> Tuple[RecurrentQOutput, LSTMState]:
+        T, B = obs.shape[0], obs.shape[1]
+        pixels = obs.ndim == 5
+        if pixels:
+            x = obs.astype(self.dtype) / jnp.asarray(255.0, self.dtype)
+            x = x.reshape((T * B,) + tuple(obs.shape[2:]))
+            for feat, kern, stride in zip(
+                self.conv_features, self.conv_kernels, self.conv_strides
+            ):
+                x = nn.Conv(
+                    feat, (kern, kern), strides=(stride, stride), dtype=self.dtype
+                )(x)
+                x = nn.relu(x)
+            x = x.reshape(T * B, -1)
+        else:
+            x = obs.astype(self.dtype).reshape(T * B, -1)
+        x = nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype)(x))
+
+        one_hot_action = jax.nn.one_hot(
+            last_action.reshape(T * B), self.num_actions, dtype=self.dtype
+        )
+        clipped_reward = (
+            jnp.clip(reward, -1.0, 1.0).reshape(T * B, 1).astype(self.dtype)
+        )
+        core_input = jnp.concatenate([x, one_hot_action, clipped_reward], axis=-1)
+
+        if self.use_lstm:
+            core_input = core_input.reshape(T, B, -1).astype(jnp.float32)
+            if not core_state:
+                core_state = self.initial_state(B)
+            scan_core = nn.scan(
+                _LSTMCore,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=0,
+                out_axes=0,
+            )(hidden_size=self.core_size, num_layers=self.lstm_layers)
+            core_state, core_output = scan_core(core_state, (core_input, done))
+            core_output = core_output.reshape(T * B, -1)
+        else:
+            core_output = core_input
+
+        core_output = core_output.astype(jnp.float32)
+        if self.dueling:
+            value = nn.Dense(1, name="value")(
+                nn.relu(nn.Dense(self.hidden_size // 2, name="value_h")(core_output))
+            )
+            adv = nn.Dense(self.num_actions, name="advantage")(
+                nn.relu(nn.Dense(self.hidden_size // 2, name="advantage_h")(core_output))
+            )
+            q = value + adv - jnp.mean(adv, axis=-1, keepdims=True)
+        else:
+            q = nn.Dense(self.num_actions, name="q")(core_output)
+        return RecurrentQOutput(q_values=q.reshape(T, B, self.num_actions)), core_state

@@ -105,13 +105,19 @@ class _ActorThread(threading.Thread):
     def __init__(
         self,
         actor_id: int,
-        trainer: "HostActorLearnerTrainer",
+        trainer,
         envs,
+        policy=None,
     ) -> None:
+        """``policy``: the acting facade (``act`` + ``initial_state``);
+        defaults to ``trainer.agent`` (IMPALA central inference).  R2D2
+        passes per-actor eps-greedy views so each actor gets its own rung
+        of the Ape-X exploration ladder."""
         super().__init__(name=f"actor-{actor_id}", daemon=True)
         self.actor_id = actor_id
         self.trainer = trainer
         self.envs = envs
+        self.policy = policy if policy is not None else trainer.agent
         self.timings = Timings()
 
     def run(self) -> None:
@@ -139,7 +145,7 @@ class _ActorThread(threading.Thread):
 
     def _act_loop(self) -> None:
         tr = self.trainer
-        agent = tr.agent
+        agent = self.policy
         q = tr.queue
         T = tr.args.rollout_length
         B = self.envs.num_envs
@@ -183,7 +189,60 @@ class _ActorThread(threading.Thread):
                 tr.env_frames += T * B
 
 
-class HostActorLearnerTrainer(BaseTrainer):
+class HostPlaneMixin:
+    """Shared scaffolding for host actor-plane trainers (IMPALA threads,
+    R2D2): the elastic-actor restart budget and the agent-state resume
+    trio.  ONE implementation — a fix to restart accounting or checkpoint
+    shape must not have to be mirrored between planes.
+
+    Expects the trainer to define: ``agent`` / ``env_frames`` /
+    ``param_server`` / ``max_actor_restarts`` / ``actor_restarts`` /
+    ``_restart_lock`` plus BaseTrainer's resume plumbing.
+    """
+
+    def grant_actor_restart(self, actor_id: int, exc: BaseException) -> bool:
+        """Consume one unit of the elastic-actor budget; False = fail fast."""
+        with self._restart_lock:
+            if self.actor_restarts >= self.max_actor_restarts:
+                return False
+            self.actor_restarts += 1
+            used = self.actor_restarts
+        if self.is_main_process:
+            self.text_logger.warning(
+                f"actor {actor_id} crashed ({type(exc).__name__}: {exc}); "
+                f"rebuilding its envs (restart {used}/{self.max_actor_restarts})"
+            )
+        return True
+
+    def _resume_pytree(self) -> Dict:
+        return {
+            "agent": self.agent.state,
+            "env_frames": np.asarray(self.env_frames, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.env_frames, int(self.agent.state.step)
+        )
+
+    def try_resume(self) -> bool:
+        """Restore learner state + frame counter (parity: the reference's
+        IMPALA 10-min checkpoints, ``impala_atari.py:460-469,496-515`` —
+        which it saved but never wired a restore for)."""
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.env_frames = int(state["env_frames"])
+        self.param_server.push(self.agent.get_weights())
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: frames {self.env_frames}"
+            )
+        return True
+
+
+class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
     def __init__(
         self,
         args: ImpalaArguments,
@@ -230,48 +289,8 @@ class HostActorLearnerTrainer(BaseTrainer):
         ]
         self.learn_timings = Timings()
 
-    # ------------------------------------------------------------------
-    def grant_actor_restart(self, actor_id: int, exc: BaseException) -> bool:
-        """Consume one unit of the elastic-actor budget; False = fail fast."""
-        with self._restart_lock:
-            if self.actor_restarts >= self.max_actor_restarts:
-                return False
-            self.actor_restarts += 1
-            used = self.actor_restarts
-        if self.is_main_process:
-            self.text_logger.warning(
-                f"actor {actor_id} crashed ({type(exc).__name__}: {exc}); "
-                f"rebuilding its envs (restart {used}/{self.max_actor_restarts})"
-            )
-        return True
-
-    # ------------------------------------------------------------------
-    def _resume_pytree(self) -> Dict:
-        return {
-            "agent": self.agent.state,
-            "env_frames": np.asarray(self.env_frames, np.int64),
-        }
-
-    def save_resume(self) -> None:
-        self.save_resume_checkpoint(
-            self._resume_pytree(), self.env_frames, int(self.agent.state.step)
-        )
-
-    def try_resume(self) -> bool:
-        """Restore learner state + frame counter (parity: the reference's
-        IMPALA 10-min checkpoints, ``impala_atari.py:460-469,496-515`` —
-        which it saved but never wired a restore for)."""
-        state = self.load_resume_checkpoint(self._resume_pytree())
-        if state is None:
-            return False
-        self.agent.state = state["agent"]
-        self.env_frames = int(state["env_frames"])
-        self.param_server.push(self.agent.get_weights())
-        if self.is_main_process:
-            self.text_logger.info(
-                f"resumed from {self.resume_ckpt_path}: frames {self.env_frames}"
-            )
-        return True
+    # grant_actor_restart / _resume_pytree / save_resume / try_resume come
+    # from HostPlaneMixin (shared with the R2D2 plane)
 
     def _assemble_batch(self, n_slots: int, timings: Optional[Timings] = None):
         """Drain ``n_slots`` full slots into one device trajectory — the

@@ -60,6 +60,9 @@ class _ProcActorConfig:
 def _proc_actor_main(conn: PipeConnection, cfg: _ProcActorConfig, ring: ShmRolloutRing) -> None:
     """Actor process: vector env + local CPU policy + shm slot writes."""
     import os
+    import sys
+
+    failed = False
 
     # Pin a single-device CPU backend before any JAX device use: this is a
     # fresh spawned interpreter, but under the axon tunnel JAX_PLATFORMS is
@@ -153,11 +156,27 @@ def _proc_actor_main(conn: PipeConnection, cfg: _ProcActorConfig, ring: ShmRollo
                 except (BrokenPipeError, OSError):
                     break
         envs.close()
-    except (KeyboardInterrupt, EOFError, OSError, ConnectionError):
+    except KeyboardInterrupt:
         pass
+    except (EOFError, OSError, ConnectionError):
+        # benign ONLY at shutdown (the learner closed the ring/pipe under
+        # us).  Outside shutdown this is a real failure — e.g. an env
+        # backend raising OSError — and exiting 0 silently here would give
+        # the elastic learner neither an error message nor a nonzero exit
+        # to react to (it treats exit 0 as a clean departure)
+        if not ring.closed:
+            import traceback
+
+            failed = True
+            try:
+                conn.send({"kind": "error", "actor_id": cfg.actor_id,
+                           "traceback": traceback.format_exc()})
+            except Exception:  # noqa: BLE001 — pipe may be the casualty
+                pass
     except Exception:  # noqa: BLE001 - funneled to the learner
         import traceback
 
+        failed = True
         try:
             conn.send({"kind": "error", "actor_id": cfg.actor_id,
                        "traceback": traceback.format_exc()})
@@ -169,6 +188,8 @@ def _proc_actor_main(conn: PipeConnection, cfg: _ProcActorConfig, ring: ShmRollo
             conn.close()
         except Exception:
             pass
+    if failed:
+        sys.exit(1)  # nonzero: never classified as a clean departure
 
 
 class ProcessActorLearnerTrainer(BaseTrainer):
@@ -188,14 +209,14 @@ class ProcessActorLearnerTrainer(BaseTrainer):
 
         Contract: recovery is guaranteed only for *funneled* failures (the
         actor caught its exception and sent ``{"kind": "error"}`` — env
-        crashes, OOM in the actor's Python, etc.); at that point the shm
-        ring is consistent, though the slot the actor had acquired but not
-        committed is stranded — size ``num_buffers`` with headroom.  A
-        hard-killed actor (SIGKILL mid-ring-push) is respawned best-effort,
-        but a producer that died between claiming and publishing a ring
-        cell wedges the lock-free ring for every later consumer at that
-        position — no user-space recovery exists for that, by the nature
-        of lock-free shared memory.  0 (default) keeps fail-fast."""
+        crashes, OOM in the actor's Python, etc.); the actor releases its
+        acquired-but-uncommitted ring slot before the error propagates, so
+        the ring stays whole.  A hard-killed actor (SIGKILL mid-ring-push)
+        is respawned best-effort, but a producer that died between
+        claiming and publishing a ring cell wedges the lock-free ring for
+        every later consumer at that position — no user-space recovery
+        exists for that, by the nature of lock-free shared memory.  0
+        (default) keeps fail-fast."""
         super().__init__(args, run_name=run_name)
         self.agent = agent
         # args.num_envs is the TOTAL env-lane count (CLI semantics shared

@@ -1,0 +1,207 @@
+"""R2D2 trainer: host actor plane -> sequence replay -> recurrent learner.
+
+Topology (beyond-parity; completes the Ape-X lineage recurrently):
+
+- actor THREADS drive vector envs and fill ``[T+1, B]`` trajectory slots
+  through the exact machinery the IMPALA host plane uses
+  (``fill_rollout_slot`` already stores each chunk's entering LSTM state)
+  — each actor acts through its own eps-greedy view on the agent's live
+  params (central inference, Ape-X eps ladder);
+- the learner drains slots, inserts every env lane as one SEQUENCE into
+  the device-resident prioritized sequence replay
+  (``data/sequence_replay.py``) at the running max priority, then runs
+  ``train_intensity`` jitted R2D2 updates per drained batch: sample,
+  burn-in + n-step double-Q under value rescaling, priority write-back.
+
+Failure handling, resume, and metrics mirror ``HostActorLearnerTrainer``
+(same queue error funnel, same Orbax resume pytree shape).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.agents.r2d2 import R2D2Agent
+from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.data.sequence_replay import (
+    seq_add,
+    seq_init,
+    seq_sample,
+    seq_update_priorities,
+)
+from scalerl_tpu.data.trajectory import TrajectorySpec
+from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+from scalerl_tpu.trainer.actor_learner import HostPlaneMixin, _ActorThread
+from scalerl_tpu.trainer.base import BaseTrainer
+from scalerl_tpu.utils.metrics import EpisodeMetrics
+
+
+class R2D2Trainer(HostPlaneMixin, BaseTrainer):
+    def __init__(
+        self,
+        args: R2D2Arguments,
+        agent: R2D2Agent,
+        env_fns,  # list of callables, one vector env per actor
+        run_name: Optional[str] = None,
+        max_actor_restarts: int = 0,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.env_fns = env_fns
+        self.stop_event = threading.Event()
+        self.frame_lock = threading.Lock()
+        self.env_frames = 0
+        self.max_actor_restarts = max_actor_restarts
+        self.actor_restarts = 0
+        self._restart_lock = threading.Lock()
+        self.param_server = ParameterServer()
+
+        probe_env = env_fns[0]()
+        self.envs_per_actor = probe_env.num_envs
+        obs_shape = probe_env.single_observation_space.shape
+        num_actions = probe_env.single_action_space.n
+        self._probe_env = probe_env
+
+        core = agent.initial_state(self.envs_per_actor)
+        self.spec = TrajectorySpec(
+            unroll_length=args.rollout_length,
+            batch_size=self.envs_per_actor,
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=jnp.float32 if len(obs_shape) == 1 else jnp.uint8,
+            core_state_shapes=tuple(tuple(c.shape) for c, _ in core),
+        )
+        self.queue = RolloutQueue(self.spec, num_slots=args.num_buffers)
+        self.episode_metrics = [
+            EpisodeMetrics(self.envs_per_actor) for _ in range(len(env_fns))
+        ]
+
+        T1 = args.rollout_length + 1
+        np_obs_dtype = np.uint8 if len(obs_shape) == 3 else np.float32
+        field_shapes = {
+            "obs": ((T1,) + tuple(obs_shape), np_obs_dtype),
+            "action": ((T1,), np.int32),
+            "reward": ((T1,), np.float32),
+            "done": ((T1,), bool),
+        }
+        core_shapes = tuple(tuple(c.shape[1:]) for c, _ in core)
+        self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
+        self._max_priority = 1.0
+        self._rng = jax.random.PRNGKey(args.seed + 13)
+
+    # grant_actor_restart / _resume_pytree / save_resume / try_resume come
+    # from HostPlaneMixin (shared with the IMPALA thread plane)
+
+    # ------------------------------------------------------------------
+    def _insert_slots(self, n_slots: int) -> None:
+        """Drain slots and insert each env lane as one sequence."""
+        batch, idxs = self.queue.get_batch(n_slots)
+        # time-major [T1, B*] host arrays -> sequence-major [B*, T1, ...]
+        fields = {
+            "obs": np.moveaxis(batch["obs"], 0, 1),
+            "action": np.moveaxis(batch["action"], 0, 1),
+            "reward": np.moveaxis(batch["reward"], 0, 1),
+            "done": np.moveaxis(batch["done"], 0, 1),
+        }
+        core = tuple(
+            (batch[f"core_{i}_c"], batch[f"core_{i}_h"])
+            for i in range(len(self.spec.core_state_shapes))
+        )
+        self.queue.recycle(idxs)
+        B = fields["action"].shape[0]
+        prio = np.full(B, self._max_priority, np.float32)
+        self.replay = seq_add(self.replay, fields, core, jnp.asarray(prio))
+
+    def _learn_once(self) -> Dict[str, jnp.ndarray]:
+        self._rng, sub = jax.random.split(self._rng)
+        fields, core, idx, weights = seq_sample(
+            self.replay, sub, self.args.batch_size,
+            alpha=self.args.per_alpha, beta=self.args.per_beta,
+        )
+        metrics, prio = self.agent.learn_sequences(fields, core, weights)
+        self.replay = seq_update_priorities(self.replay, idx, prio)
+        self._max_priority = max(self._max_priority, float(jnp.max(prio)))
+        return metrics
+
+    # ------------------------------------------------------------------
+    def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
+        args = self.args
+        total_frames = total_frames or args.max_timesteps
+        if self.resuming:
+            self.try_resume()
+        actors = []
+        for i, fn in enumerate(self.env_fns):
+            envs = self._probe_env if i == 0 else fn()
+            actors.append(
+                _ActorThread(i, self, envs, policy=self.agent.actor_view(i))
+            )
+        self.actors = actors
+        for a in actors:
+            a.start()
+
+        start = time.time()
+        start_frames = self.env_frames
+        last_log_frames = start_frames
+        n_slots = max(args.batch_size // self.envs_per_actor, 1)
+        seqs_per_drain = n_slots * self.envs_per_actor
+        metrics: Dict = {}
+        inserted = 0
+        try:
+            while self.env_frames < total_frames and not self.stop_event.is_set():
+                self._insert_slots(n_slots)
+                inserted += seqs_per_drain
+                if inserted >= args.warmup_sequences:
+                    for _ in range(args.train_intensity):
+                        metrics = self._learn_once()
+                    # version bump for off-host pullers; thread actors read
+                    # the live params directly (central inference)
+                    self.param_server.push(self.agent.get_weights(), to_host=False)
+                if self.env_frames - last_log_frames >= args.logger_frequency:
+                    last_log_frames = self.env_frames
+                    sps = (self.env_frames - start_frames) / max(
+                        time.time() - start, 1e-8
+                    )
+                    rets = [
+                        r
+                        for m in self.episode_metrics
+                        for r in m.episode_returns[-20:]
+                    ]
+                    ret_mean = float(np.mean(rets)) if rets else float("nan")
+                    host_metrics = {k: float(v) for k, v in metrics.items()}
+                    info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
+                    self.logger.log_train_data(info, self.env_frames)
+                    if self.is_main_process:
+                        self.text_logger.info(
+                            f"frames {self.env_frames} | sps {sps:.0f} | "
+                            f"return {ret_mean:.1f} | "
+                            f"loss {host_metrics.get('total_loss', float('nan')):.3f}"
+                        )
+        finally:
+            self.stop_event.set()
+            self.queue.close()
+            for a in actors:
+                a.join(timeout=5.0)
+            for a in actors:
+                try:
+                    a.envs.close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        if args.save_model and not args.disable_checkpoint:
+            self.save_resume()
+        sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
+        rets = [r for m in self.episode_metrics for r in m.episode_returns]
+        return {
+            **{k: float(v) for k, v in metrics.items()},
+            "env_frames": float(self.env_frames),
+            "sps": float(sps),
+            "learn_steps": int(self.agent.state.step),
+            "return_mean": float(np.mean(rets[-100:])) if rets else float("nan"),
+            "episodes": float(len(rets)),
+        }

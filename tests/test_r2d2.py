@@ -1,0 +1,222 @@
+"""R2D2 tests: rescaling math, n-step targets, sequence replay, trainer.
+
+Beyond-parity family (the reference's DQN lineage is feed-forward only);
+test strategy follows SURVEY.md §4 — math against hand-computed fixtures,
+then integration through the public trainer, then a slow memory proof.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.r2d2 import (
+    R2D2Agent,
+    n_step_double_q_targets,
+    value_rescale,
+    value_rescale_inv,
+)
+from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.data.sequence_replay import (
+    seq_add,
+    seq_init,
+    seq_sample,
+    seq_update_priorities,
+)
+from scalerl_tpu.envs import make_vect_envs
+
+
+def _args(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        rollout_length=10,
+        burn_in=2,
+        n_steps=2,
+        batch_size=4,
+        num_actors=1,
+        num_buffers=8,
+        replay_capacity=64,
+        warmup_sequences=8,
+        use_lstm=True,
+        hidden_size=32,
+        logger_backend="none",
+        logger_frequency=10**9,
+        save_model=False,
+        learning_rate=1e-3,
+    )
+    base.update(kw)
+    return R2D2Arguments(**base)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+
+def test_value_rescale_roundtrip():
+    x = jnp.asarray([-300.0, -1.5, 0.0, 1e-4, 7.0, 2500.0])
+    np.testing.assert_allclose(
+        np.asarray(value_rescale_inv(value_rescale(x))), np.asarray(x),
+        rtol=1e-4, atol=1e-4,
+    )
+    # compresses: |h(x)| << |x| for large x
+    assert float(value_rescale(jnp.asarray(2500.0))) < 60.0
+
+
+def test_n_step_targets_hand_computed():
+    """T1=4, B=1, burn_in=0, n=1, gamma=0.5, rescaling disabled via eps-free
+    identity check on small values where h ~= identity is NOT assumed —
+    instead we hand-apply h to the expected target."""
+    A = 2
+    # q[t, 0, a] = distinct values; online == target nets for determinism
+    q = jnp.asarray(
+        [[[1.0, 2.0]], [[3.0, 0.5]], [[0.25, 0.75]], [[4.0, 5.0]]]
+    )  # [4, 1, 2]
+    action = jnp.asarray([[0], [1], [0], [1]])  # a leading to row t
+    reward = jnp.asarray([[0.0], [1.0], [2.0], [3.0]])
+    done = jnp.zeros((4, 1), bool)
+    td, qa = n_step_double_q_targets(
+        q, q, action, reward, done, burn_in=0, n_steps=1, gamma=0.5,
+        rescale_eps=1e-3,
+    )
+    # M = 4 - 0 - 1 = 3 rows; qa_g = q[g, action[g+1]]
+    np.testing.assert_allclose(
+        np.asarray(qa[:, 0]), [2.0, 3.0, 0.75], rtol=1e-6
+    )
+    # target_g = h(r_{g+1} + 0.5 * h^-1(q[g+1, argmax q[g+1]]))
+    h, hinv = value_rescale, value_rescale_inv
+    expected = [
+        float(h(1.0 + 0.5 * hinv(jnp.asarray(3.0)))),   # g=0: row1 max=a0
+        float(h(2.0 + 0.5 * hinv(jnp.asarray(0.75)))),  # g=1: row2 max=a1
+        float(h(3.0 + 0.5 * hinv(jnp.asarray(5.0)))),   # g=2: row3 max=a1
+    ]
+    np.testing.assert_allclose(
+        np.asarray((qa - td)[:, 0]), expected, rtol=1e-5
+    )
+
+
+def test_n_step_targets_done_masks_bootstrap():
+    """An episode boundary inside the window kills later rewards AND the
+    bootstrap."""
+    q = jnp.ones((4, 1, 2))
+    action = jnp.zeros((4, 1), jnp.int32)
+    reward = jnp.asarray([[0.0], [1.0], [10.0], [100.0]])
+    done = jnp.asarray([[False], [True], [False], [False]])  # row1 ends an ep
+    td, qa = n_step_double_q_targets(
+        q, q, action, reward, done, burn_in=0, n_steps=2, gamma=0.5,
+        rescale_eps=1e-3,
+    )
+    # g=0 window: r1 + gamma*live*r2 with live = (1-d1) = 0 -> target h(1.0)
+    target0 = float((qa - td)[0, 0])
+    np.testing.assert_allclose(target0, float(value_rescale(jnp.asarray(1.0))), rtol=1e-5)
+    # g=1 window: r2 + 0.5*r3*(1-d2) + bootstrap*(1-d2)(1-d3): d2=d3=False,
+    # all live -> sanity: strictly greater than the masked case
+    assert float((qa - td)[1, 0]) > target0
+
+
+# ---------------------------------------------------------------------------
+# sequence replay
+
+
+def test_sequence_replay_add_sample_update():
+    T1, dim = 5, 8
+    state = seq_init(
+        {"obs": ((T1, 3), np.float32), "action": ((T1,), np.int32)},
+        ((dim,),),
+        capacity=16,
+    )
+    B = 4
+    batch = {
+        "obs": jnp.arange(B * T1 * 3, dtype=jnp.float32).reshape(B, T1, 3),
+        "action": jnp.tile(jnp.arange(T1, dtype=jnp.int32), (B, 1)),
+    }
+    core = ((jnp.full((B, dim), 2.0), jnp.full((B, dim), 3.0)),)
+    state = seq_add(state, batch, core, jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    assert int(state.size) == 4 and int(state.pos) == 4
+
+    fields, score, idx, w = seq_sample(state, jax.random.PRNGKey(0), 8, alpha=1.0)
+    assert fields["obs"].shape == (8, T1, 3)
+    assert score[0][0].shape == (8, dim)
+    assert np.all(np.asarray(idx) < 4)  # only live slots sampled
+    assert np.all(np.asarray(w) > 0) and float(jnp.max(w)) == 1.0
+
+    # priority update shifts sampling mass
+    state = seq_update_priorities(
+        state, jnp.asarray([0, 1, 2, 3]), jnp.asarray([1e3, 1e-6, 1e-6, 1e-6])
+    )
+    _f, _c, idx2, _w = seq_sample(state, jax.random.PRNGKey(1), 32, alpha=1.0)
+    counts = np.bincount(np.asarray(idx2), minlength=4)
+    assert counts[0] >= 30  # ~all mass on slot 0
+
+    # ring wrap: 16 more inserts overwrite oldest
+    for i in range(4):
+        state = seq_add(state, batch, core, jnp.full(B, 0.5))
+    assert int(state.size) == 16
+
+
+# ---------------------------------------------------------------------------
+# agent + trainer
+
+
+def test_r2d2_agent_learn_step_and_target_sync():
+    args = _args(target_update_frequency=2)
+    agent = R2D2Agent(args, obs_shape=(4,), num_actions=2)
+    B, T1 = 4, args.rollout_length + 1
+    key = jax.random.PRNGKey(0)
+    fields = {
+        "obs": jax.random.normal(key, (B, T1, 4)),
+        "action": jnp.zeros((B, T1), jnp.int32),
+        "reward": jnp.ones((B, T1), jnp.float32),
+        "done": jnp.zeros((B, T1), bool),
+    }
+    core = tuple(
+        (jnp.zeros((B, c.shape[1])), jnp.zeros((B, h.shape[1])))
+        for c, h in agent.initial_state(B)
+    )
+    w = jnp.ones(B)
+    m1, p1 = agent.learn_sequences(fields, core, w)
+    assert np.isfinite(float(m1["total_loss"]))
+    assert p1.shape == (B,) and np.all(np.asarray(p1) >= 0)
+    before = jax.tree_util.tree_leaves(agent.state.target_params)[0]
+    m2, _ = agent.learn_sequences(fields, core, w)
+    after = jax.tree_util.tree_leaves(agent.state.target_params)[0]
+    # period 2: the second step syncs target <- online
+    online = jax.tree_util.tree_leaves(agent.state.params)[0]
+    np.testing.assert_array_equal(np.asarray(after), np.asarray(online))
+    assert int(agent.state.step) == 2
+
+
+@pytest.mark.slow
+def test_r2d2_memory_proof_delayed_recall():
+    """R2D2's reason to exist: the LSTM + stored-state + burn-in machinery
+    recalls a cue across a delay where a feed-forward policy is pinned at
+    chance.  Shared harness with the recorded curve
+    (``examples/learning_curves.py:run_r2d2_recall``).  Calibrated: LSTM
+    reaches 1.0 (perfect recall), feed-forward control 0.04, chance 0.0."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from examples.learning_curves import run_r2d2_recall
+
+    lstm = run_r2d2_recall(use_lstm=True)["return_mean"]
+    ff = run_r2d2_recall(use_lstm=False)["return_mean"]
+    assert lstm >= 0.6, lstm
+    assert ff <= 0.3, ff
+
+
+def test_r2d2_trainer_cartpole_smoke(tmp_path):
+    args = _args(work_dir=str(tmp_path), rollout_length=8, burn_in=2,
+                 n_steps=1, warmup_sequences=4, batch_size=4)
+    agent = R2D2Agent(args, obs_shape=(4,), num_actions=2)
+    env_fns = [
+        lambda: make_vect_envs("CartPole-v1", num_envs=4, seed=0, async_envs=False)
+    ]
+    from scalerl_tpu.trainer.r2d2 import R2D2Trainer
+
+    trainer = R2D2Trainer(args, agent, env_fns)
+    result = trainer.train(total_frames=512)
+    assert result["env_frames"] >= 512
+    assert result["learn_steps"] > 0
+    assert np.isfinite(result["total_loss"])
+    assert trainer.param_server.version > 0
+    trainer.close()
