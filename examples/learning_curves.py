@@ -929,6 +929,17 @@ def _write_markdown(results) -> None:
             f"while the V-trace arm reached {lag['final_return']}.  "
             "See `tests/test_offpolicy_lag.py`.",
         ]
+    r2d2 = next((r for r in results if r["experiment"] == "r2d2_recall"), None)
+    if r2d2 is not None:
+        lines += [
+            "",
+            "`r2d2_recall` is the recurrent OFF-POLICY proof: R2D2's",
+            "stored-state + burn-in machinery recalls the cue across the delay",
+            f"to {r2d2['final_return']} (optimal 1.0), while the identically-"
+            f"budgeted feed-forward control finished at "
+            f"{r2d2['ff_control_return']} (chance 0.0).",
+            "See `tests/test_r2d2.py` for the assertion form.",
+        ]
     if any(r["experiment"] == "impala_recall_lstm" for r in results):
         lines += [
             "",

@@ -235,7 +235,11 @@ def test_local_cluster_end_to_end():
     assert all(r["param_version"] == 1 for r in results)
     assert all(abs(r["reward"] - 3.0) < 1e-6 for r in results)
     worker_ids = {r["worker_id"] for r in results}
-    assert worker_ids <= set(range(4)) and len(worker_ids) >= 2
+    # ids must be valid, but NOT evenly spread: under a loaded single-core
+    # host one worker can legitimately race through every task before its
+    # siblings finish spawning (observed in full-suite runs), so demanding
+    # >= 2 distinct producers made this flaky
+    assert worker_ids <= set(range(4))
 
 
 def test_local_cluster_elastic_restart():

@@ -185,6 +185,41 @@ def test_r2d2_agent_learn_step_and_target_sync():
     assert int(agent.state.step) == 2
 
 
+def test_r2d2_trainer_resume_roundtrip(tmp_path):
+    """Kill-and-resume through the shared HostPlaneMixin: learner state and
+    the frame counter survive; the resumed run continues, not restarts."""
+    from scalerl_tpu.trainer.r2d2 import R2D2Trainer
+
+    args_a = _args(
+        work_dir=str(tmp_path), rollout_length=8, burn_in=2, n_steps=1,
+        warmup_sequences=4, batch_size=4, save_model=True, save_frequency=128,
+        logger_backend="tensorboard",
+    )
+    agent_a = R2D2Agent(args_a, obs_shape=(4,), num_actions=2)
+    env_fns = [
+        lambda: make_vect_envs("CartPole-v1", num_envs=4, seed=0, async_envs=False)
+    ]
+    tr_a = R2D2Trainer(args_a, agent_a, env_fns)
+    tr_a.train(total_frames=256)
+    frames_a = tr_a.env_frames
+    step_a = int(agent_a.state.step)
+    run_dir = tr_a.work_dir
+    tr_a.close()
+    assert frames_a >= 256 and step_a > 0
+
+    args_b = _args(
+        work_dir=str(tmp_path), rollout_length=8, burn_in=2, n_steps=1,
+        warmup_sequences=4, batch_size=4, save_model=True,
+        logger_backend="tensorboard", resume=str(run_dir),
+    )
+    agent_b = R2D2Agent(args_b, obs_shape=(4,), num_actions=2)
+    tr_b = R2D2Trainer(args_b, agent_b, env_fns)
+    assert tr_b.try_resume()
+    assert tr_b.env_frames == frames_a
+    assert int(agent_b.state.step) == step_a
+    tr_b.close()
+
+
 @pytest.mark.slow
 def test_r2d2_memory_proof_delayed_recall():
     """R2D2's reason to exist: the LSTM + stored-state + burn-in machinery
