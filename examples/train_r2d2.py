@@ -50,6 +50,10 @@ def main() -> None:
     agent = R2D2Agent(
         args, obs_shape=obs_shape, num_actions=num_actions, obs_dtype=obs_dtype
     )
+    if args.mesh_shape:
+        # DDP R2D2: sequence batch sharded over dp*fsdp, gradients
+        # all-reduced by GSPMD (numerically identical to single-device)
+        agent.enable_mesh(args.mesh_shape)
     trainer = R2D2Trainer(
         args, agent, [env_fn(i) for i in range(args.num_actors)]
     )

@@ -259,12 +259,17 @@ class R2D2Agent(BaseAgent):
         )
         self.state = R2D2TrainState(
             params=params,
-            target_params=params,
+            # a COPY, not an alias: the mesh learn step donates the state,
+            # and XLA refuses to donate the same buffer twice
+            target_params=jax.tree_util.tree_map(jnp.copy, params),
             opt_state=self.optimizer.init(params),
             step=jnp.zeros((), jnp.int32),
         )
-        self._learn = jax.jit(make_r2d2_learn_fn(self.model, self.optimizer, args))
+        self._learn_raw = make_r2d2_learn_fn(self.model, self.optimizer, args)
+        self._learn = jax.jit(self._learn_raw)
         self._act = jax.jit(self._act_impl)
+        self.mesh = None
+        self._learn_mesh = None
 
     # -- acting --------------------------------------------------------
     def _act_impl(self, params, obs, last_action, reward, done, core, eps, key):
@@ -321,10 +326,56 @@ class R2D2Agent(BaseAgent):
         return np.asarray(jnp.argmax(q, axis=-1))
 
     # -- learning ------------------------------------------------------
+    def enable_mesh(self, mesh_or_spec) -> None:
+        """Data-parallel R2D2 learner over a mesh (the DDP story every
+        other family has): the SEQUENCE batch dim shards over ``dp×fsdp``,
+        big params over ``fsdp/tp`` where divisible, GSPMD all-reduces
+        gradients over ICI, and the per-sequence priorities come back
+        replicated for the PER write-back.  Call once before training;
+        numerically identical to the single-device update at the same
+        global batch (asserted by test)."""
+        from scalerl_tpu.parallel import make_parallel_learn_fn, resolve_mesh
+
+        mesh = resolve_mesh(mesh_or_spec)
+        n_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
+        if self.args.batch_size % n_shards != 0:
+            raise ValueError(
+                f"batch_size ({self.args.batch_size}) must divide by the "
+                f"mesh's dp*fsdp extent ({n_shards}) to shard the sequence "
+                "batch"
+            )
+        raw = self._learn_raw  # the un-jitted fn kept from __init__
+
+        def two_out(state, batch):
+            state, metrics, prio = raw(
+                state, batch["fields"], batch["core"], batch["weights"]
+            )
+            return state, (metrics, prio)
+
+        plearn = make_parallel_learn_fn(
+            two_out, mesh, self.state,
+            batch_time_major=False,  # sequence batches are [B, T1, ...]
+            # NO donation: R2D2's actor threads read agent.state.params
+            # concurrently for central inference — a donating learn step
+            # would delete the buffers mid-read ("Array has been deleted")
+            donate_state=False,
+        )
+        self.mesh = mesh
+        self.state = plearn.shard_state(self.state)
+        self._learn_mesh = plearn
+
     def learn_sequences(self, fields, core, weights):
         """One update on a sampled sequence batch; returns (metrics,
         new_priorities) with the state updated in place."""
-        self.state, metrics, prio = self._learn(self.state, fields, core, weights)
+        if self._learn_mesh is not None:
+            batch = self._learn_mesh.shard_batch(
+                {"fields": dict(fields), "core": core, "weights": weights}
+            )
+            self.state, (metrics, prio) = self._learn_mesh(self.state, batch)
+        else:
+            self.state, metrics, prio = self._learn(
+                self.state, fields, core, weights
+            )
         return metrics, prio
 
     def learn(self, batch) -> Dict[str, float]:
