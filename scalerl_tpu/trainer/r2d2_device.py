@@ -1,0 +1,246 @@
+"""Device-native R2D2: on-device collection feeding on-device replay.
+
+The TPU-fast R2D2 topology, mirroring what ``runtime/device_loop.py``
+does for IMPALA: env stepping, recurrent-Q inference, and eps-greedy
+action selection run as ONE jitted collector over a ``JaxVecEnv``
+(``lax.scan`` over the unroll), the produced ``[B, T+1]`` sequences are
+inserted into the device-resident prioritized sequence replay with a
+batched dynamic-slice write, and the R2D2 learn step (burn-in + n-step
+double-Q + priority write-back) is the same single jitted program the
+host plane uses.  The host's whole duty per iteration is a handful of
+dispatches — no trajectory ever visits host memory.
+
+Off-policyness note: unlike the fused IMPALA loop (structurally
+on-policy), this loop is genuinely off-policy — replayed sequences were
+collected under OLD params and OLD (higher) epsilons, which is exactly
+the regime the stored-state + burn-in machinery exists for.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.agents.r2d2 import R2D2Agent
+from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.data.sequence_replay import (
+    seq_add,
+    seq_init,
+    seq_sample,
+    seq_update_priorities,
+)
+from scalerl_tpu.trainer.base import BaseTrainer
+
+
+class _CollectCarry(NamedTuple):
+    env_state: object
+    obs: jnp.ndarray  # [B, ...]
+    last_action: jnp.ndarray  # [B]
+    reward: jnp.ndarray  # [B]
+    done: jnp.ndarray  # [B]
+    core: tuple  # model recurrent state
+    return_sum: jnp.ndarray  # [B] completed-episode return accumulator
+    episode_return: jnp.ndarray  # [B] running
+    episode_count: jnp.ndarray  # [B]
+
+
+class DeviceR2D2Trainer(BaseTrainer):
+    """R2D2 over a device-native env (``envs/jax_envs``)."""
+
+    def __init__(
+        self,
+        args: R2D2Arguments,
+        agent: R2D2Agent,
+        venv,
+        run_name: Optional[str] = None,
+    ) -> None:
+        super().__init__(args, run_name=run_name)
+        self.agent = agent
+        self.venv = venv
+        B = venv.num_envs
+        T1 = args.rollout_length + 1
+        obs_shape = venv.env.observation_shape
+        obs_dtype = venv.env.observation_dtype
+        field_shapes = {
+            "obs": ((T1,) + tuple(obs_shape), obs_dtype),
+            "action": ((T1,), jnp.int32),
+            "reward": ((T1,), jnp.float32),
+            "done": ((T1,), bool),
+        }
+        core = agent.initial_state(1)
+        core_shapes = tuple(tuple(c.shape[1:]) for c, _ in core)
+        self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
+        self._collect = jax.jit(self._collect_impl, donate_argnums=(1,))
+        self._max_priority = 1.0
+        self.env_frames = 0
+
+    # ------------------------------------------------------------------
+    def init_carry(self, key: jax.Array) -> _CollectCarry:
+        B = self.venv.num_envs
+        env_state, obs = self.venv.reset(key)
+        return _CollectCarry(
+            env_state=env_state,
+            obs=obs,
+            last_action=jnp.zeros(B, jnp.int32),
+            reward=jnp.zeros(B, jnp.float32),
+            done=jnp.ones(B, jnp.bool_),
+            core=self.agent.initial_state(B),
+            return_sum=jnp.zeros(B, jnp.float32),
+            episode_return=jnp.zeros(B, jnp.float32),
+            episode_count=jnp.zeros(B, jnp.float32),
+        )
+
+    def _collect_impl(self, params, carry: _CollectCarry, eps, key):
+        """One [T+1, B] chunk under eps-greedy; returns the sequence batch
+        in replay layout ([B, T1, ...]) plus the ENTERING core state."""
+        model = self.agent.model
+        T = self.args.rollout_length
+        entry_core = carry.core
+
+        def step(c: _CollectCarry, k):
+            out, new_core = model.apply(
+                params, c.obs[None], c.last_action[None], c.reward[None],
+                c.done[None], c.core,
+            )
+            q = out.q_values[0]  # [B, A]
+            greedy = jnp.argmax(q, axis=-1).astype(jnp.int32)
+            k_eps, k_rand, k_env = jax.random.split(k, 3)
+            B = greedy.shape[0]
+            explore = jax.random.uniform(k_eps, (B,)) < eps
+            rand_a = jax.random.randint(k_rand, (B,), 0, q.shape[-1])
+            action = jnp.where(explore, rand_a, greedy)
+            env_state, next_obs, rew, done = self.venv.step(
+                c.env_state, action, k_env
+            )
+            row = (c.obs, c.last_action, c.reward, c.done)
+            ep_ret = c.episode_return + rew
+            new_c = _CollectCarry(
+                env_state=env_state,
+                obs=next_obs,
+                last_action=action,
+                reward=rew,
+                done=done,
+                core=new_core,
+                return_sum=c.return_sum + jnp.where(done, ep_ret, 0.0),
+                episode_return=jnp.where(done, 0.0, ep_ret),
+                episode_count=c.episode_count + done.astype(jnp.float32),
+            )
+            return new_c, row
+
+        keys = jax.random.split(key, T)
+        carry, rows = jax.lax.scan(step, carry, keys)
+        obs_r, act_r, rew_r, done_r = rows
+        # rows + the boundary row, then sequence-major for the replay
+        fields = {
+            "obs": jnp.moveaxis(
+                jnp.concatenate([obs_r, carry.obs[None]], axis=0), 0, 1
+            ),
+            "action": jnp.moveaxis(
+                jnp.concatenate([act_r, carry.last_action[None]], axis=0), 0, 1
+            ),
+            "reward": jnp.moveaxis(
+                jnp.concatenate([rew_r, carry.reward[None]], axis=0), 0, 1
+            ),
+            "done": jnp.moveaxis(
+                jnp.concatenate([done_r, carry.done[None]], axis=0), 0, 1
+            ),
+        }
+        return carry, fields, entry_core
+
+    # ------------------------------------------------------------------
+    def _eps(self, frames: int) -> float:
+        """Linear decay 1.0 -> eps_base over the first warmup*4 sequences'
+        worth of frames, then constant eps_base (single-stream schedule;
+        the actor-ladder eps_alpha applies to the host plane's many
+        actors, not this one synchronized batch)."""
+        horizon = max(
+            self.args.warmup_sequences * 4 * (self.args.rollout_length + 1), 1
+        )
+        frac = min(frames / horizon, 1.0)
+        return 1.0 + (self.args.eps_base - 1.0) * frac
+
+    def train(self, total_frames: Optional[int] = None) -> Dict[str, float]:
+        args = self.args
+        total_frames = total_frames or args.max_timesteps
+        B = self.venv.num_envs
+        frames_per_chunk = args.rollout_length * B
+        key = jax.random.PRNGKey(args.seed)
+        key, k_init = jax.random.split(key)
+        carry = self.init_carry(k_init)
+        inserted = 0
+        metrics: Dict = {}
+        start = time.time()
+        last_log = 0
+        prev_sum = prev_cnt = 0.0
+        windowed = float("nan")
+        # final-window mark, independent of logger_frequency: the summary's
+        # return_windowed covers the LAST quarter of training, never the
+        # lifetime mean (which drags the eps=1 random warmup along)
+        final_mark = None
+        while self.env_frames < total_frames:
+            key, k_c, k_s = jax.random.split(key, 3)
+            eps = self._eps(self.env_frames)
+            carry, fields, entry_core = self._collect(
+                self.agent.state.params, carry, eps, k_c
+            )
+            prio = jnp.full((B,), self._max_priority, jnp.float32)
+            self.replay = seq_add(self.replay, fields, entry_core, prio)
+            self.env_frames += frames_per_chunk
+            inserted += B
+            if inserted >= args.warmup_sequences:
+                for _ in range(args.train_intensity):
+                    key, k_l = jax.random.split(key)
+                    f, c, idx, w = seq_sample(
+                        self.replay, k_l, args.batch_size,
+                        alpha=args.per_alpha, beta=args.per_beta,
+                    )
+                    metrics, new_prio = self.agent.learn_sequences(f, c, w)
+                    self.replay = seq_update_priorities(self.replay, idx, new_prio)
+                    self._max_priority = max(
+                        self._max_priority, float(jnp.max(new_prio))
+                    )
+            if final_mark is None and self.env_frames >= 0.75 * total_frames:
+                final_mark = (
+                    float(jnp.sum(carry.return_sum)),
+                    float(jnp.sum(carry.episode_count)),
+                )
+            if self.env_frames - last_log >= args.logger_frequency:
+                last_log = self.env_frames
+                s = float(jnp.sum(carry.return_sum))
+                c = float(jnp.sum(carry.episode_count))
+                if c > prev_cnt:
+                    # windowed: episodes completed since the previous log —
+                    # the learning signal (the cumulative mean drags the
+                    # random-policy prefix along forever)
+                    windowed = (s - prev_sum) / (c - prev_cnt)
+                    prev_sum, prev_cnt = s, c
+                host = {k: float(v) for k, v in metrics.items()}
+                self.logger.log_train_data(
+                    {**host, "return_windowed": windowed, "eps": eps},
+                    self.env_frames,
+                )
+                if self.is_main_process:
+                    self.text_logger.info(
+                        f"frames {self.env_frames} | eps {eps:.2f} | "
+                        f"return {windowed:.2f}"
+                    )
+        s = float(jnp.sum(carry.return_sum))
+        c = float(jnp.sum(carry.episode_count))
+        mark_s, mark_c = final_mark if final_mark is not None else (0.0, 0.0)
+        if c > mark_c:
+            windowed = (s - mark_s) / (c - mark_c)
+        sps = self.env_frames / max(time.time() - start, 1e-8)
+        return {
+            **{k: float(v) for k, v in metrics.items()},
+            "env_frames": float(self.env_frames),
+            "sps": float(sps),
+            "learn_steps": int(self.agent.state.step),
+            "return_mean": s / max(c, 1.0),
+            "return_windowed": windowed,
+            "episodes": c,
+        }

@@ -257,6 +257,47 @@ def test_r2d2_trainer_resume_roundtrip(tmp_path):
     tr_b.close()
 
 
+def test_device_r2d2_trainer_smoke(tmp_path):
+    """The device-native loop (jitted collect -> device replay -> learn)
+    runs end to end and counts frames/learn steps correctly."""
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.envs.jax_envs.recall import JaxRecall
+    from scalerl_tpu.trainer.r2d2_device import DeviceR2D2Trainer
+
+    args = _args(
+        env_id="JaxRecall", rollout_length=8, burn_in=2, n_steps=1,
+        batch_size=8, replay_capacity=64, warmup_sequences=8,
+        hidden_size=32, work_dir=str(tmp_path),
+    )
+    env = JaxRecall(size=8, delay=2, num_cues=2)
+    venv = JaxVecEnv(env, num_envs=8)
+    agent = R2D2Agent(args, obs_shape=env.observation_shape, num_actions=2,
+                      obs_dtype=np.uint8)
+    trainer = DeviceR2D2Trainer(args, agent, venv)
+    result = trainer.train(total_frames=1024)
+    assert result["env_frames"] >= 1024
+    assert result["learn_steps"] > 0
+    assert np.isfinite(result["total_loss"])
+    trainer.close()
+
+
+@pytest.mark.slow
+def test_device_r2d2_memory_proof():
+    """Device-plane twin of the host memory proof: the jitted eps-greedy
+    collector + device sequence replay learn delayed recall with the LSTM
+    (calibrated windowed ~0.97) while feed-forward stays at chance."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from examples.learning_curves import run_r2d2_recall_device
+
+    lstm = run_r2d2_recall_device(use_lstm=True)["return_windowed"]
+    ff = run_r2d2_recall_device(use_lstm=False)["return_windowed"]
+    assert lstm >= 0.6, lstm
+    assert ff <= 0.3, ff
+
+
 @pytest.mark.slow
 def test_r2d2_memory_proof_delayed_recall():
     """R2D2's reason to exist: the LSTM + stored-state + burn-in machinery
