@@ -1,0 +1,59 @@
+"""SAC on Pendulum — continuous control (beyond-parity).
+
+The reference declares continuous-capable actor/critic MLPs
+(``scalerl/algorithms/utils/network.py:27-67``) but ships no algorithm
+that uses them; SAC completes that story on the same off-policy pipeline
+DQN rides (device replay, optional PER, OffPolicyTrainer).
+
+Usage::
+
+    python examples/train_sac.py --env-id Pendulum-v1 --max-timesteps 30000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import SACAgent
+from scalerl_tpu.config import SACArguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OffPolicyTrainer
+
+
+def main() -> None:
+    args = parse_args(SACArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    train_envs = make_vect_envs(args.env_id, num_envs=args.num_envs, seed=args.seed)
+    eval_envs = make_vect_envs(
+        args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False
+    )
+    space = train_envs.single_action_space
+    if not hasattr(space, "low"):
+        raise SystemExit(
+            f"SAC needs a continuous (Box) action space; {args.env_id} has "
+            f"{type(space).__name__} actions — use train_dqn/train_r2d2 for "
+            "discrete envs"
+        )
+    agent = SACAgent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        action_low=space.low,
+        action_high=space.high,
+    )
+    trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        train_envs.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

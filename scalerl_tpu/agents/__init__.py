@@ -4,3 +4,4 @@ from scalerl_tpu.agents.a3c import A3CAgent, A3CTrainState  # noqa: F401
 from scalerl_tpu.agents.impala import ImpalaAgent, ImpalaTrainState  # noqa: F401
 from scalerl_tpu.agents.ppo import PPOAgent, PPOTrainState  # noqa: F401
 from scalerl_tpu.agents.r2d2 import R2D2Agent, R2D2TrainState  # noqa: F401
+from scalerl_tpu.agents.sac import SACAgent, SACTrainState  # noqa: F401

@@ -1,0 +1,273 @@
+"""SAC: soft actor-critic for continuous control (beyond-parity).
+
+The reference's network zoo declares continuous-capable actor/critic MLPs
+(``scalerl/algorithms/utils/network.py:27-67``) but no algorithm ever
+uses them — its DQN/A3C/Ape-X/IMPALA families are all discrete.  SAC
+(Haarnoja et al. 2018) completes the story TPU-style: the entire update
+— squashed-Gaussian reparameterized actor, clipped double-Q critic
+targets with the entropy bonus, automatic temperature tuning toward
+``-action_dim``, and the polyak target update — is ONE jitted pure
+function over device-replay batches, riding the same ``OffPolicyTrainer``
+/ ``Sampler`` pipeline as DQN (including PER via the |TD| feedback).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.config import SACArguments
+from scalerl_tpu.models.mlp import TanhGaussianActor, TwinQNet
+from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def squash_log_prob(u: jnp.ndarray, log_std: jnp.ndarray, mean: jnp.ndarray,
+                    action_scale: jnp.ndarray) -> jnp.ndarray:
+    """log pi(a|s) for a = tanh(u) * scale, u ~ N(mean, std).
+
+    Uses the numerically stable tanh-correction
+    ``log(1 - tanh(u)^2) = 2*(log 2 - u - softplus(-2u))`` and the affine
+    |det| term ``-sum(log scale)``.
+    """
+    std = jnp.exp(log_std)
+    normal_logp = jnp.sum(
+        -0.5 * jnp.square((u - mean) / std) - log_std - 0.5 * jnp.log(2.0 * jnp.pi),
+        axis=-1,
+    )
+    tanh_corr = jnp.sum(
+        2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1
+    )
+    scale_corr = jnp.sum(jnp.log(action_scale))
+    return normal_logp - tanh_corr - scale_corr
+
+
+def squash(u: jnp.ndarray, action_scale, action_bias) -> jnp.ndarray:
+    """a = tanh(u) * scale + bias — THE squash transform; every sampler
+    (learn-side and act-side) must route through this one helper so the
+    bounds convention cannot diverge between them."""
+    return jnp.tanh(u) * action_scale + action_bias
+
+
+@struct.dataclass
+class SACTrainState:
+    actor_params: Any
+    critic_params: Any
+    target_critic_params: Any
+    log_alpha: jnp.ndarray
+    actor_opt: Any
+    critic_opt: Any
+    alpha_opt: Any
+    step: jnp.ndarray
+
+
+def make_sac_learn_fn(actor, critic, actor_tx, critic_tx, alpha_tx,
+                      args: SACArguments, action_scale, action_bias,
+                      target_entropy: float):
+    def sample_action(actor_params, obs, key):
+        mean, log_std = actor.apply(actor_params, obs)
+        u = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+        a = squash(u, action_scale, action_bias)
+        logp = squash_log_prob(u, log_std, mean, action_scale)
+        return a, logp
+
+    def learn(state: SACTrainState, batch: Mapping[str, jnp.ndarray], key):
+        obs = batch["obs"]
+        next_obs = batch["next_obs"]
+        action = batch["action"]
+        reward = batch["reward"]
+        done = batch["done"].astype(jnp.float32)
+        weights = batch.get("weights", jnp.ones_like(reward))
+        k_next, k_pi = jax.random.split(key)
+        alpha = jnp.exp(state.log_alpha)
+
+        # -- critics: clipped double-Q target with the entropy bonus.
+        # n-step samples discount by gamma^k with the REALIZED window length
+        # (the sampler folds rewards and bootstraps n steps ahead — same
+        # contract as agents/dqn.py)
+        n_steps = batch.get("n_steps")
+        if n_steps is None:
+            discount = (1.0 - done) * (args.gamma**args.n_steps)
+        else:
+            discount = (1.0 - done) * (args.gamma ** n_steps.astype(jnp.float32))
+        next_a, next_logp = sample_action(state.actor_params, next_obs, k_next)
+        tq1, tq2 = critic.apply(state.target_critic_params, next_obs, next_a)
+        target = reward + discount * (jnp.minimum(tq1, tq2) - alpha * next_logp)
+        target = jax.lax.stop_gradient(target)
+
+        def critic_loss_fn(cp):
+            q1, q2 = critic.apply(cp, obs, action)
+            l = jnp.mean(weights * (jnp.square(q1 - target) + jnp.square(q2 - target)))
+            return 0.5 * l, jnp.abs(q1 - target)
+
+        (c_loss, td_abs), c_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(state.critic_params)
+        c_updates, critic_opt = critic_tx.update(
+            c_grads, state.critic_opt, state.critic_params
+        )
+        critic_params = optax.apply_updates(state.critic_params, c_updates)
+
+        # -- actor: maximize E[min Q - alpha * logp] (reparameterized)
+        def actor_loss_fn(ap):
+            a, logp = sample_action(ap, obs, k_pi)
+            q1, q2 = critic.apply(critic_params, obs, a)
+            return jnp.mean(alpha * logp - jnp.minimum(q1, q2)), logp
+
+        (a_loss, logp), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(state.actor_params)
+        a_updates, actor_opt = actor_tx.update(
+            a_grads, state.actor_opt, state.actor_params
+        )
+        actor_params = optax.apply_updates(state.actor_params, a_updates)
+
+        # -- temperature: drive E[logp] toward -target_entropy
+        if args.auto_alpha:
+            def alpha_loss_fn(log_alpha):
+                return -jnp.mean(
+                    jnp.exp(log_alpha)
+                    * jax.lax.stop_gradient(logp + target_entropy)
+                )
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
+            al_updates, alpha_opt = alpha_tx.update(
+                al_grad, state.alpha_opt, state.log_alpha
+            )
+            log_alpha = optax.apply_updates(state.log_alpha, al_updates)
+        else:
+            al_loss = jnp.zeros(())
+            alpha_opt = state.alpha_opt
+            log_alpha = state.log_alpha
+
+        # -- polyak target update
+        tau = args.soft_update_tau
+        target_critic_params = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - tau) * t + tau * o,
+            state.target_critic_params,
+            critic_params,
+        )
+
+        new_state = SACTrainState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_critic_params=target_critic_params,
+            log_alpha=log_alpha,
+            actor_opt=actor_opt,
+            critic_opt=critic_opt,
+            alpha_opt=alpha_opt,
+            step=state.step + 1,
+        )
+        metrics = {
+            "loss": c_loss,  # "loss" key: OffPolicyTrainer's log line reads it
+            "critic_loss": c_loss,
+            "actor_loss": a_loss,
+            "alpha_loss": al_loss,
+            "alpha": jnp.exp(log_alpha),
+            "entropy": -jnp.mean(logp),
+            "mean_q_target": jnp.mean(target),
+        }
+        return new_state, metrics, td_abs
+
+    return learn
+
+
+class SACAgent(BaseAgent):
+    def __init__(
+        self,
+        args: SACArguments,
+        obs_shape: Tuple[int, ...],
+        action_low,
+        action_high,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        self.obs_shape = tuple(obs_shape)
+        low = np.asarray(action_low, np.float32)
+        high = np.asarray(action_high, np.float32)
+        if low.ndim != 1:
+            raise ValueError(
+                f"SACAgent expects a 1-D Box action space; got bounds of "
+                f"shape {low.shape} — flatten the env's action space (or "
+                "wrap it) before constructing the agent"
+            )
+        self.action_dim = int(low.shape[0])
+        self.action_scale = jnp.asarray((high - low) / 2.0)
+        self.action_bias = jnp.asarray((high + low) / 2.0)
+        self.actor = TanhGaussianActor(
+            action_dim=self.action_dim, hidden_sizes=args.hidden_sizes
+        )
+        self.critic = TwinQNet(hidden_sizes=args.hidden_sizes)
+        actor_tx = optax.adam(args.actor_learning_rate)
+        critic_tx = optax.adam(args.learning_rate)
+        alpha_tx = optax.adam(args.alpha_learning_rate)
+
+        key = key if key is not None else jax.random.PRNGKey(args.seed)
+        k_a, k_c, self._key = jax.random.split(key, 3)
+        dummy_obs = jnp.zeros((1,) + self.obs_shape, jnp.float32)
+        dummy_act = jnp.zeros((1, self.action_dim), jnp.float32)
+        actor_params = self.actor.init(k_a, dummy_obs)
+        critic_params = self.critic.init(k_c, dummy_obs, dummy_act)
+        log_alpha = jnp.asarray(np.log(args.init_alpha), jnp.float32)
+        self.state = SACTrainState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_critic_params=jax.tree_util.tree_map(jnp.copy, critic_params),
+            log_alpha=log_alpha,
+            actor_opt=actor_tx.init(actor_params),
+            critic_opt=critic_tx.init(critic_params),
+            alpha_opt=alpha_tx.init(log_alpha),
+            step=jnp.zeros((), jnp.int32),
+        )
+        target_entropy = -self.action_dim * args.target_entropy_scale
+        self._learn = jax.jit(
+            make_sac_learn_fn(
+                self.actor, self.critic, actor_tx, critic_tx, alpha_tx,
+                args, self.action_scale, self.action_bias, target_entropy,
+            )
+        )
+        self._sample = jax.jit(self._sample_impl)
+        self._mean_act = jax.jit(self._mean_act_impl)
+
+    # -- acting --------------------------------------------------------
+    def _sample_impl(self, actor_params, obs, key):
+        mean, log_std = self.actor.apply(actor_params, obs)
+        u = mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+        return squash(u, self.action_scale, self.action_bias)
+
+    def _mean_act_impl(self, actor_params, obs):
+        mean, _ = self.actor.apply(actor_params, obs)
+        return squash(mean, self.action_scale, self.action_bias)
+
+    def get_action(self, obs: np.ndarray) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(self._sample(self.state.actor_params, obs, sub))
+
+    def predict(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._mean_act(self.state.actor_params, obs))
+
+    # -- learning ------------------------------------------------------
+    def learn(self, batch: Mapping[str, Any]) -> Dict[str, Any]:
+        self._key, sub = jax.random.split(self._key)
+        self.state, metrics, td_abs = self._learn(self.state, dict(batch), sub)
+        out: Dict[str, Any] = {k: float(v) for k, v in metrics.items()}
+        out["td_abs"] = td_abs  # device array, PER priority feedback
+        return out
+
+    def get_weights(self):
+        return self.state.actor_params
+
+    def set_weights(self, weights) -> None:
+        self.state = self.state.replace(actor_params=weights)
+
+    def save_checkpoint(self, path: str) -> str:
+        return save_checkpoint(path, self.state)
+
+    def load_checkpoint(self, path: str) -> None:
+        self.state = load_checkpoint(path, self.state)

@@ -177,6 +177,49 @@ class A3CArguments(RLArguments):
 
 
 @dataclass
+class SACArguments(RLArguments):
+    """SAC options (beyond-parity: continuous control).
+
+    The reference declares continuous-capable actor/critic MLPs in its
+    network zoo (``network.py:27-67``) but ships no continuous-action
+    algorithm; SAC (Haarnoja et al. 2018) completes that story: squashed-
+    Gaussian actor, clipped double-Q critics, automatic entropy
+    temperature, soft target updates — the whole update one jitted program
+    over device-replay batches.
+    """
+
+    algo_name: str = "sac"
+    env_id: str = "Pendulum-v1"  # continuous algo -> continuous default env
+    hidden_sizes: str = "256,256"
+    # Soft target update
+    soft_update_tau: float = 0.005
+    # Entropy temperature: alpha auto-tunes toward target entropy
+    # (= -action_dim * target_entropy_scale)
+    auto_alpha: bool = True
+    init_alpha: float = 0.2
+    target_entropy_scale: float = 1.0
+    alpha_learning_rate: float = 3e-4
+    actor_learning_rate: float = 3e-4  # critics use the base learning_rate
+    # Replay (uniform or PER, sharing the DQN pipeline fields)
+    use_per: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_beta_final: float = 1.0
+    n_steps: int = 1
+
+    def validate(self) -> None:
+        super().validate()
+        if not 0.0 < self.soft_update_tau <= 1.0:
+            raise ValueError(
+                f"soft_update_tau must be in (0, 1], got {self.soft_update_tau}"
+            )
+        if self.init_alpha <= 0.0:
+            raise ValueError(f"init_alpha must be positive, got {self.init_alpha}")
+        if self.n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
+
+
+@dataclass
 class R2D2Arguments(RLArguments):
     """R2D2 options (beyond-parity: recurrent replay distributed DQN,
     Kapturowski et al. 2019 — the Ape-X lineage the reference's README

@@ -171,8 +171,13 @@ class PrioritizedReplayBuffer:
         gamma: float = 0.99,
         extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
         sample_method: str = "hierarchical",
+        action_shape: Tuple[int, ...] = (),
+        action_dtype: jnp.dtype = jnp.int32,
     ) -> None:
-        self.spec = dict(transition_spec(obs_shape, obs_dtype))
+        self.spec = dict(transition_spec(
+            obs_shape, obs_dtype, action_dtype=action_dtype,
+            action_shape=action_shape,
+        ))
         if extra_fields:
             self.spec.update(extra_fields)
         self.capacity = capacity

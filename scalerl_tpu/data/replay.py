@@ -208,8 +208,13 @@ class ReplayBuffer:
         n_step: int = 1,
         gamma: float = 0.99,
         device: Optional[jax.Device] = None,
+        action_shape: Tuple[int, ...] = (),
+        action_dtype: jnp.dtype = jnp.int32,
     ) -> None:
-        self.spec = transition_spec(obs_shape, obs_dtype)
+        self.spec = transition_spec(
+            obs_shape, obs_dtype, action_dtype=action_dtype,
+            action_shape=action_shape,
+        )
         self.capacity = capacity
         self.num_envs = num_envs
         self.n_step = n_step

@@ -31,6 +31,8 @@ class Sampler:
         per_alpha: float = 0.6,
         n_step: int = 1,
         gamma: float = 0.99,
+        action_shape=(),
+        action_dtype: jnp.dtype = jnp.int32,
     ) -> None:
         self.use_per = use_per
         self.n_step = n_step
@@ -43,6 +45,8 @@ class Sampler:
                 alpha=per_alpha,
                 n_step=n_step,
                 gamma=gamma,
+                action_shape=tuple(action_shape),
+                action_dtype=action_dtype,
             )
         else:
             self.buffer = ReplayBuffer(
@@ -52,6 +56,8 @@ class Sampler:
                 obs_dtype=obs_dtype,
                 n_step=n_step,
                 gamma=gamma,
+                action_shape=tuple(action_shape),
+                action_dtype=action_dtype,
             )
 
     def __len__(self) -> int:

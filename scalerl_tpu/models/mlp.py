@@ -202,3 +202,54 @@ class ActorCriticNet(nn.Module):
             logits = nn.Dense(self.action_dim)(x)
             value = nn.Dense(1)(x)
         return logits, value.squeeze(-1)
+
+
+class TanhGaussianActor(nn.Module):
+    """Squashed-Gaussian policy for continuous control (SAC).
+
+    Beyond-parity: the reference declares continuous-capable MLP heads in
+    its network zoo (``network.py:27-67``) but ships no continuous-action
+    algorithm; this head makes them load-bearing.  Returns
+    ``(mean_u, log_std)`` in pre-squash space; sampling/log-prob live in
+    ``agents/sac.py`` so the module stays a pure function of ``obs``.
+    """
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = obs.astype(jnp.float32)
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.Dense(self.action_dim, name="mean")(x)
+        log_std = nn.Dense(self.action_dim, name="log_std")(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mean, log_std
+
+
+class TwinQNet(nn.Module):
+    """Two independent Q(s, a) critics (SAC's clipped double-Q).
+
+    One module holding both parameter sets so a single optimizer state and
+    a single ``model.apply`` cover the ensemble; returns ``(q1, q2)``.
+    """
+
+    hidden_sizes: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(
+        self, obs: jnp.ndarray, action: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x0 = jnp.concatenate(
+            [obs.astype(jnp.float32), action.astype(jnp.float32)], axis=-1
+        )
+        qs = []
+        for i in range(2):
+            x = x0
+            for j, h in enumerate(_parse_hidden(self.hidden_sizes)):
+                x = nn.relu(nn.Dense(h, name=f"q{i}_dense{j}")(x))
+            qs.append(nn.Dense(1, name=f"q{i}_out")(x).squeeze(-1))
+        return qs[0], qs[1]

@@ -16,6 +16,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from scalerl_tpu.agents.dqn import DQNAgent
@@ -42,6 +43,11 @@ class OffPolicyTrainer(BaseTrainer):
         self.num_envs = getattr(train_envs, "num_envs", 1)
 
         obs_space = train_envs.single_observation_space
+        act_space = train_envs.single_action_space
+        if hasattr(act_space, "n"):  # Discrete
+            action_shape, action_dtype = (), jnp.int32
+        else:  # Box (continuous control: SAC)
+            action_shape, action_dtype = tuple(act_space.shape), jnp.float32
         self.sampler = Sampler(
             obs_shape=obs_space.shape,
             capacity=args.buffer_size,
@@ -50,6 +56,8 @@ class OffPolicyTrainer(BaseTrainer):
             per_alpha=args.per_alpha,
             n_step=args.n_steps,
             gamma=args.gamma,
+            action_shape=action_shape,
+            action_dtype=action_dtype,
         )
         self.per_beta = LinearDecayScheduler(
             args.per_beta, args.per_beta_final, args.max_timesteps
@@ -135,8 +143,9 @@ class OffPolicyTrainer(BaseTrainer):
         self.global_step = int(state["global_step"])
         self.learn_steps = int(state["learn_steps"])
         # fast-forward the exploration schedule to the restored step
-        self.agent.eps_scheduler.cur_step = self.global_step
-        self.agent.eps = self.agent.eps_scheduler.value(self.global_step)
+        if hasattr(self.agent, "eps_scheduler"):  # eps-greedy agents only
+            self.agent.eps_scheduler.cur_step = self.global_step
+            self.agent.eps = self.agent.eps_scheduler.value(self.global_step)
         if self.is_main_process:
             self.text_logger.info(
                 f"resumed from {self.resume_ckpt_path}: step {self.global_step}, "
@@ -163,7 +172,8 @@ class OffPolicyTrainer(BaseTrainer):
             self.metrics.step(reward, np.logical_or(term, trunc))
             obs = next_obs
             self.global_step += self.num_envs
-            self.agent.update_exploration(self.num_envs)
+            if hasattr(self.agent, "update_exploration"):
+                self.agent.update_exploration(self.num_envs)
 
             if (
                 len(self.sampler) >= args.warmup_learn_steps
@@ -189,7 +199,8 @@ class OffPolicyTrainer(BaseTrainer):
                     ret = summary.get("return_mean", float("nan"))
                     self.text_logger.info(
                         f"step {self.global_step} | fps {fps} | return {ret:.1f} "
-                        f"| eps {self.agent.eps:.3f} | loss {train_info.get('loss', float('nan')):.4f}"
+                        f"| eps {getattr(self.agent, 'eps', float('nan')):.3f} "
+                        f"| loss {train_info.get('loss', float('nan')):.4f}"
                     )
 
             if self.eval_envs is not None and self.global_step - last_eval >= args.eval_frequency:

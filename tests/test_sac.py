@@ -1,0 +1,164 @@
+"""SAC tests: squashed-Gaussian math, learn step, pipeline, learning proof.
+
+Beyond-parity family (the reference has no continuous-action algorithm;
+its network zoo's actor/critic MLPs were never used).  Strategy per
+SURVEY.md §4: math against an independent numerical check, integration
+through the shared OffPolicyTrainer pipeline, then a slow to-solved run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.sac import SACAgent, squash_log_prob
+from scalerl_tpu.config import SACArguments
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OffPolicyTrainer
+
+
+def _args(**kw):
+    base = dict(
+        env_id="Pendulum-v1",
+        num_envs=2,
+        buffer_size=4096,
+        batch_size=32,
+        warmup_learn_steps=64,
+        train_frequency=2,
+        max_timesteps=600,
+        logger_backend="none",
+        logger_frequency=10**9,
+        save_model=False,
+        eval_frequency=10**9,
+        hidden_sizes="32,32",
+    )
+    base.update(kw)
+    return SACArguments(**base)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+
+def test_squash_log_prob_matches_numerical_change_of_variables():
+    """log pi(a) from the stable formula == N(u) density minus the log
+    |det Jacobian| of a = tanh(u) * scale computed directly."""
+    rng = np.random.default_rng(0)
+    mean = jnp.asarray(rng.normal(size=(5, 3)), jnp.float32)
+    log_std = jnp.asarray(rng.uniform(-1.0, 0.5, size=(5, 3)), jnp.float32)
+    u = jnp.asarray(rng.normal(size=(5, 3)), jnp.float32)
+    scale = jnp.asarray([2.0, 0.5, 1.0])
+
+    got = squash_log_prob(u, log_std, mean, scale)
+
+    std = np.asarray(jnp.exp(log_std))
+    normal = np.sum(
+        -0.5 * ((np.asarray(u) - np.asarray(mean)) / std) ** 2
+        - np.log(std)
+        - 0.5 * np.log(2 * np.pi),
+        axis=-1,
+    )
+    # |da/du| = scale * (1 - tanh(u)^2), directly
+    jac = np.sum(
+        np.log(np.asarray(scale)[None, :] * (1.0 - np.tanh(np.asarray(u)) ** 2)),
+        axis=-1,
+    )
+    np.testing.assert_allclose(np.asarray(got), normal - jac, rtol=1e-4, atol=1e-4)
+
+
+def test_sac_learn_step_updates_all_parts():
+    args = _args()
+    agent = SACAgent(
+        args, obs_shape=(3,),
+        action_low=np.array([-2.0], np.float32),
+        action_high=np.array([2.0], np.float32),
+    )
+    B = 32
+    k = jax.random.PRNGKey(0)
+    batch = {
+        "obs": jax.random.normal(k, (B, 3)),
+        "next_obs": jax.random.normal(jax.random.PRNGKey(1), (B, 3)),
+        "action": jax.random.uniform(jax.random.PRNGKey(2), (B, 1), minval=-2, maxval=2),
+        "reward": jax.random.normal(jax.random.PRNGKey(3), (B,)),
+        "done": jnp.zeros((B,), bool),
+    }
+    a0 = jax.tree_util.tree_leaves(agent.state.actor_params)[0].copy()
+    c0 = jax.tree_util.tree_leaves(agent.state.critic_params)[0].copy()
+    t0 = jax.tree_util.tree_leaves(agent.state.target_critic_params)[0].copy()
+    alpha0 = float(jnp.exp(agent.state.log_alpha))
+    info = agent.learn(batch)
+    assert np.isfinite(info["loss"]) and np.isfinite(info["actor_loss"])
+    assert info["td_abs"].shape == (B,)
+    a1 = jax.tree_util.tree_leaves(agent.state.actor_params)[0]
+    c1 = jax.tree_util.tree_leaves(agent.state.critic_params)[0]
+    t1 = jax.tree_util.tree_leaves(agent.state.target_critic_params)[0]
+    assert not np.allclose(np.asarray(a0), np.asarray(a1))  # actor moved
+    assert not np.allclose(np.asarray(c0), np.asarray(c1))  # critics moved
+    # polyak: target moved a LITTLE toward the new critics (tau = 0.005)
+    np.testing.assert_allclose(
+        np.asarray(t1),
+        np.asarray((1 - 0.005) * t0 + 0.005 * c1),
+        rtol=1e-5, atol=1e-6,
+    )
+    assert float(jnp.exp(agent.state.log_alpha)) != alpha0  # temperature moved
+    assert int(agent.state.step) == 1
+
+
+def test_sac_actions_respect_bounds():
+    args = _args()
+    agent = SACAgent(
+        args, obs_shape=(3,),
+        action_low=np.array([-2.0], np.float32),
+        action_high=np.array([2.0], np.float32),
+    )
+    obs = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    a = agent.get_action(obs)
+    assert a.shape == (64, 1)
+    assert np.all(a >= -2.0) and np.all(a <= 2.0)
+    g = agent.predict(obs)
+    assert np.all(g >= -2.0) and np.all(g <= 2.0)
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+
+
+@pytest.mark.parametrize("use_per", [False, True])
+def test_sac_offpolicy_trainer_pipeline(tmp_path, use_per):
+    """SAC rides the DQN off-policy pipeline end to end — continuous
+    actions through the (plumbed) replay, PER priority feedback included."""
+    pytest.importorskip("gymnasium")
+    args = _args(work_dir=str(tmp_path), use_per=use_per)
+    envs = make_vect_envs("Pendulum-v1", num_envs=2, seed=0, async_envs=False)
+    space = envs.single_action_space
+    agent = SACAgent(
+        args, obs_shape=(3,), action_low=space.low, action_high=space.high
+    )
+    trainer = OffPolicyTrainer(args, agent, envs)
+    summary = trainer.run()
+    assert trainer.global_step >= args.max_timesteps
+    assert trainer.learn_steps > 0
+    # the stored actions round-trip as float vectors
+    batch = trainer.sampler.sample(8)
+    assert batch["action"].shape == (8, 1)
+    assert batch["action"].dtype == jnp.float32
+    trainer.close()
+    envs.close()
+
+
+# ---------------------------------------------------------------------------
+# learning proof
+
+
+@pytest.mark.slow
+def test_sac_solves_pendulum():
+    """SAC reaches a greedy eval far above random on Pendulum (calibrated:
+    ~-120 after 24k steps; random ~-1400; threshold at -400)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from examples.learning_curves import run_sac_pendulum
+
+    res = run_sac_pendulum()
+    assert res["eval_reward"] >= -400.0, res
