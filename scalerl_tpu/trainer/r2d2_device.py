@@ -58,8 +58,20 @@ class DeviceR2D2Trainer(BaseTrainer):
         agent: R2D2Agent,
         venv,
         run_name: Optional[str] = None,
+        fused: bool = True,
     ) -> None:
+        """``fused``: run each iteration (collect + insert + all learn
+        steps + priority write-back) as ONE jitted dispatch — the TPU-fast
+        default.  ``False`` keeps the piecewise path (one dispatch per
+        stage), useful for debugging stage boundaries."""
         super().__init__(args, run_name=run_name)
+        if fused and getattr(agent, "_learn_mesh", None) is not None:
+            raise ValueError(
+                "fused=True runs the raw single-device learn fn and would "
+                "silently bypass agent.enable_mesh's sharded learner; pass "
+                "fused=False to combine DeviceR2D2Trainer with a DDP agent"
+            )
+        self.fused = fused
         self.agent = agent
         self.venv = venv
         B = venv.num_envs
@@ -76,6 +88,14 @@ class DeviceR2D2Trainer(BaseTrainer):
         core_shapes = tuple(tuple(c.shape[1:]) for c, _ in core)
         self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
         self._collect = jax.jit(self._collect_impl, donate_argnums=(1,))
+        # fused iteration: collect + insert + train_intensity x
+        # (sample + learn + priority write-back) as ONE program — one host
+        # dispatch per iteration instead of ~3 + train_intensity (each
+        # dispatch costs ~50-100 ms under the axon tunnel)
+        self._fused_iter = jax.jit(self._fused_iter_impl, donate_argnums=(0, 1, 2))
+        self._collect_insert = jax.jit(
+            self._collect_insert_impl, donate_argnums=(1, 2)
+        )
         self._max_priority = 1.0
         self.env_frames = 0
 
@@ -153,6 +173,45 @@ class DeviceR2D2Trainer(BaseTrainer):
         return carry, fields, entry_core
 
     # ------------------------------------------------------------------
+    def _collect_insert_impl(self, params, replay, carry, max_prio, eps, key):
+        """Warmup phase fused step: collect one chunk and insert it."""
+        B = self.venv.num_envs
+        carry, fields, entry_core = self._collect_impl(params, carry, eps, key)
+        replay = seq_add(
+            replay, fields, entry_core, jnp.full((B,), max_prio, jnp.float32)
+        )
+        return replay, carry
+
+    def _fused_iter_impl(self, agent_state, replay, carry, max_prio, eps, key):
+        """One full R2D2 iteration as one XLA program.
+
+        ``max_prio`` rides the program as a traced scalar (the host keeps
+        no priority state), so consecutive fused calls chain without any
+        host-side reduction between them.
+        """
+        args = self.args
+        B = self.venv.num_envs
+        k_c, key = jax.random.split(key)
+        carry, fields, entry_core = self._collect_impl(
+            agent_state.params, carry, eps, k_c
+        )
+        replay = seq_add(
+            replay, fields, entry_core, jnp.full((B,), max_prio, jnp.float32)
+        )
+        metrics = {}
+        learn_raw = self.agent._learn_raw
+        for _ in range(args.train_intensity):  # static, small
+            key, k_s = jax.random.split(key)
+            f, c, idx, w = seq_sample(
+                replay, k_s, args.batch_size,
+                alpha=args.per_alpha, beta=args.per_beta,
+            )
+            agent_state, metrics, new_prio = learn_raw(agent_state, f, c, w)
+            replay = seq_update_priorities(replay, idx, new_prio)
+            max_prio = jnp.maximum(max_prio, jnp.max(new_prio))
+        return agent_state, replay, carry, max_prio, metrics
+
+    # ------------------------------------------------------------------
     def _eps(self, frames: int) -> float:
         """Linear decay 1.0 -> eps_base over the first warmup*4 sequences'
         worth of frames, then constant eps_base (single-stream schedule;
@@ -182,28 +241,51 @@ class DeviceR2D2Trainer(BaseTrainer):
         # return_windowed covers the LAST quarter of training, never the
         # lifetime mean (which drags the eps=1 random warmup along)
         final_mark = None
+        # in fused mode the running max priority lives ON DEVICE: it chains
+        # through consecutive fused calls without any host reduction
+        max_prio = jnp.asarray(self._max_priority, jnp.float32)
         while self.env_frames < total_frames:
             key, k_c, k_s = jax.random.split(key, 3)
             eps = self._eps(self.env_frames)
-            carry, fields, entry_core = self._collect(
-                self.agent.state.params, carry, eps, k_c
-            )
-            prio = jnp.full((B,), self._max_priority, jnp.float32)
-            self.replay = seq_add(self.replay, fields, entry_core, prio)
-            self.env_frames += frames_per_chunk
-            inserted += B
-            if inserted >= args.warmup_sequences:
-                for _ in range(args.train_intensity):
-                    key, k_l = jax.random.split(key)
-                    f, c, idx, w = seq_sample(
-                        self.replay, k_l, args.batch_size,
-                        alpha=args.per_alpha, beta=args.per_beta,
+            # count THIS iteration's insert: learning must start on the
+            # iteration that reaches warmup (the pre-fusion semantics)
+            warm = inserted + B >= args.warmup_sequences
+            if self.fused:
+                if warm:
+                    (
+                        self.agent.state, self.replay, carry, max_prio, metrics
+                    ) = self._fused_iter(
+                        self.agent.state, self.replay, carry, max_prio, eps, k_c
                     )
-                    metrics, new_prio = self.agent.learn_sequences(f, c, w)
-                    self.replay = seq_update_priorities(self.replay, idx, new_prio)
-                    self._max_priority = max(
-                        self._max_priority, float(jnp.max(new_prio))
+                else:
+                    self.replay, carry = self._collect_insert(
+                        self.agent.state.params, self.replay, carry,
+                        max_prio, eps, k_c,
                     )
+                self.env_frames += frames_per_chunk
+                inserted += B
+            else:
+                carry, fields, entry_core = self._collect(
+                    self.agent.state.params, carry, eps, k_c
+                )
+                prio = jnp.full((B,), self._max_priority, jnp.float32)
+                self.replay = seq_add(self.replay, fields, entry_core, prio)
+                self.env_frames += frames_per_chunk
+                inserted += B
+                if warm:
+                    for _ in range(args.train_intensity):
+                        key, k_l = jax.random.split(key)
+                        f, c, idx, w = seq_sample(
+                            self.replay, k_l, args.batch_size,
+                            alpha=args.per_alpha, beta=args.per_beta,
+                        )
+                        metrics, new_prio = self.agent.learn_sequences(f, c, w)
+                        self.replay = seq_update_priorities(
+                            self.replay, idx, new_prio
+                        )
+                        self._max_priority = max(
+                            self._max_priority, float(jnp.max(new_prio))
+                        )
             if final_mark is None and self.env_frames >= 0.75 * total_frames:
                 final_mark = (
                     float(jnp.sum(carry.return_sum)),
@@ -229,6 +311,11 @@ class DeviceR2D2Trainer(BaseTrainer):
                         f"frames {self.env_frames} | eps {eps:.2f} | "
                         f"return {windowed:.2f}"
                     )
+        if self.fused:
+            # persist the device-side running max across train() calls; in
+            # piecewise mode self._max_priority was maintained on the host
+            # (overwriting it here would reset it to the entry value)
+            self._max_priority = float(max_prio)
         s = float(jnp.sum(carry.return_sum))
         c = float(jnp.sum(carry.episode_count))
         mark_s, mark_c = final_mark if final_mark is not None else (0.0, 0.0)

@@ -257,9 +257,11 @@ def test_r2d2_trainer_resume_roundtrip(tmp_path):
     tr_b.close()
 
 
-def test_device_r2d2_trainer_smoke(tmp_path):
-    """The device-native loop (jitted collect -> device replay -> learn)
-    runs end to end and counts frames/learn steps correctly."""
+@pytest.mark.parametrize("fused", [True, False])
+def test_device_r2d2_trainer_smoke(tmp_path, fused):
+    """The device-native loop runs end to end and counts frames/learn
+    steps correctly — both as ONE fused dispatch per iteration (the TPU
+    default) and as the piecewise debugging path."""
     from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
     from scalerl_tpu.envs.jax_envs.recall import JaxRecall
     from scalerl_tpu.trainer.r2d2_device import DeviceR2D2Trainer
@@ -273,7 +275,7 @@ def test_device_r2d2_trainer_smoke(tmp_path):
     venv = JaxVecEnv(env, num_envs=8)
     agent = R2D2Agent(args, obs_shape=env.observation_shape, num_actions=2,
                       obs_dtype=np.uint8)
-    trainer = DeviceR2D2Trainer(args, agent, venv)
+    trainer = DeviceR2D2Trainer(args, agent, venv, fused=fused)
     result = trainer.train(total_frames=1024)
     assert result["env_frames"] >= 1024
     assert result["learn_steps"] > 0
