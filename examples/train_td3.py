@@ -1,0 +1,53 @@
+"""TD3 on Pendulum — continuous control (beyond-parity, companion to SAC).
+
+Usage::
+
+    python examples/train_td3.py --env-id Pendulum-v1 --max-timesteps 30000
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.agents import TD3Agent
+from scalerl_tpu.config import TD3Arguments, parse_args
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OffPolicyTrainer
+
+
+def main() -> None:
+    args = parse_args(TD3Arguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+    train_envs = make_vect_envs(args.env_id, num_envs=args.num_envs, seed=args.seed)
+    eval_envs = make_vect_envs(
+        args.env_id, num_envs=2, seed=args.seed + 1, async_envs=False
+    )
+    space = train_envs.single_action_space
+    if not hasattr(space, "low"):
+        raise SystemExit(
+            f"TD3 needs a continuous (Box) action space; {args.env_id} has "
+            f"{type(space).__name__} actions"
+        )
+    agent = TD3Agent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        action_low=space.low,
+        action_high=space.high,
+    )
+    trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs)
+    try:
+        summary = trainer.run()
+        print("final:", summary)
+        final_eval = trainer.run_evaluate_episodes()
+        print("eval:", final_eval)
+    finally:
+        trainer.close()
+        train_envs.close()
+        eval_envs.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -5,3 +5,4 @@ from scalerl_tpu.agents.impala import ImpalaAgent, ImpalaTrainState  # noqa: F40
 from scalerl_tpu.agents.ppo import PPOAgent, PPOTrainState  # noqa: F401
 from scalerl_tpu.agents.r2d2 import R2D2Agent, R2D2TrainState  # noqa: F401
 from scalerl_tpu.agents.sac import SACAgent, SACTrainState  # noqa: F401
+from scalerl_tpu.agents.td3 import TD3Agent, TD3TrainState  # noqa: F401

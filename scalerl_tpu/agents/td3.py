@@ -1,0 +1,237 @@
+"""TD3: twin-delayed DDPG for continuous control (beyond-parity).
+
+Companion to ``agents/sac.py`` on the same off-policy pipeline: a
+deterministic tanh actor with exploration noise, clipped double-Q
+critics, TARGET POLICY SMOOTHING (clipped Gaussian noise on the target
+action — the trick that distinguishes TD3 from DDPG), and DELAYED actor
++ target updates every ``policy_delay`` critic steps.  The whole update
+is one jitted pure function; the delay is a ``lax.cond``-free masked
+update (selective where over the actor/target trees), so the program
+stays a single static graph.
+
+Reference context: like SAC, this makes the reference's declared-but-
+unused continuous MLP heads (``network.py:27-67``) load-bearing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.config import TD3Arguments
+from scalerl_tpu.models.mlp import DeterministicActor, TwinQNet
+from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+@struct.dataclass
+class TD3TrainState:
+    actor_params: Any
+    target_actor_params: Any
+    critic_params: Any
+    target_critic_params: Any
+    actor_opt: Any
+    critic_opt: Any
+    step: jnp.ndarray
+
+
+def make_td3_learn_fn(actor, critic, actor_tx, critic_tx, args: TD3Arguments,
+                      action_scale, action_bias):
+    low = action_bias - action_scale
+    high = action_bias + action_scale
+
+    def learn(state: TD3TrainState, batch: Mapping[str, jnp.ndarray], key):
+        obs = batch["obs"]
+        next_obs = batch["next_obs"]
+        action = batch["action"]
+        reward = batch["reward"]
+        done = batch["done"].astype(jnp.float32)
+        weights = batch.get("weights", jnp.ones_like(reward))
+        n_steps = batch.get("n_steps")
+        if n_steps is None:
+            discount = (1.0 - done) * (args.gamma**args.n_steps)
+        else:
+            discount = (1.0 - done) * (args.gamma ** n_steps.astype(jnp.float32))
+
+        # -- target policy smoothing: clipped noise on the TARGET action
+        next_a = actor.apply(state.target_actor_params, next_obs)
+        next_a = next_a * action_scale + action_bias
+        noise = jnp.clip(
+            args.target_noise_std
+            * action_scale
+            * jax.random.normal(key, next_a.shape),
+            -args.target_noise_clip * action_scale,
+            args.target_noise_clip * action_scale,
+        )
+        next_a = jnp.clip(next_a + noise, low, high)
+        tq1, tq2 = critic.apply(state.target_critic_params, next_obs, next_a)
+        target = jax.lax.stop_gradient(
+            reward + discount * jnp.minimum(tq1, tq2)
+        )
+
+        def critic_loss_fn(cp):
+            q1, q2 = critic.apply(cp, obs, action)
+            l = jnp.mean(
+                weights * (jnp.square(q1 - target) + jnp.square(q2 - target))
+            )
+            return 0.5 * l, jnp.abs(q1 - target)
+
+        (c_loss, td_abs), c_grads = jax.value_and_grad(
+            critic_loss_fn, has_aux=True
+        )(state.critic_params)
+        c_updates, critic_opt = critic_tx.update(
+            c_grads, state.critic_opt, state.critic_params
+        )
+        critic_params = optax.apply_updates(state.critic_params, c_updates)
+
+        # -- delayed actor + target updates: compute always (static graph),
+        # apply only every policy_delay steps via a scalar mask
+        def actor_loss_fn(ap):
+            a = actor.apply(ap, obs) * action_scale + action_bias
+            q1, _ = critic.apply(critic_params, obs, a)
+            return -jnp.mean(q1)
+
+        a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+        a_updates, actor_opt_new = actor_tx.update(
+            a_grads, state.actor_opt, state.actor_params
+        )
+        actor_params_new = optax.apply_updates(state.actor_params, a_updates)
+
+        step = state.step + 1
+        apply_actor = step % args.policy_delay == 0  # bool scalar
+
+        def select(new, old):
+            # dtype-preserving (optimizer state carries integer counters —
+            # an arithmetic lerp would silently float-ify them)
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(apply_actor, n, o), new, old
+            )
+
+        actor_params = select(actor_params_new, state.actor_params)
+        actor_opt = select(actor_opt_new, state.actor_opt)
+        tau = args.soft_update_tau * apply_actor.astype(jnp.float32)
+
+        def polyak(t, o):
+            return jax.tree_util.tree_map(
+                lambda tv, ov: (1.0 - tau) * tv + tau * ov, t, o
+            )
+
+        target_actor_params = polyak(state.target_actor_params, actor_params)
+        target_critic_params = polyak(state.target_critic_params, critic_params)
+
+        new_state = TD3TrainState(
+            actor_params=actor_params,
+            target_actor_params=target_actor_params,
+            critic_params=critic_params,
+            target_critic_params=target_critic_params,
+            actor_opt=actor_opt,
+            critic_opt=critic_opt,
+            step=step,
+        )
+        metrics = {
+            "loss": c_loss,
+            "critic_loss": c_loss,
+            "actor_loss": a_loss,
+            "mean_q_target": jnp.mean(target),
+        }
+        return new_state, metrics, td_abs
+
+    return learn
+
+
+class TD3Agent(BaseAgent):
+    def __init__(
+        self,
+        args: TD3Arguments,
+        obs_shape: Tuple[int, ...],
+        action_low,
+        action_high,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        self.obs_shape = tuple(obs_shape)
+        low = np.asarray(action_low, np.float32)
+        high = np.asarray(action_high, np.float32)
+        if low.ndim != 1:
+            raise ValueError(
+                f"TD3Agent expects a 1-D Box action space; got bounds of "
+                f"shape {low.shape}"
+            )
+        self.action_dim = int(low.shape[0])
+        self.action_scale = jnp.asarray((high - low) / 2.0)
+        self.action_bias = jnp.asarray((high + low) / 2.0)
+        self._low = jnp.asarray(low)
+        self._high = jnp.asarray(high)
+        self.actor = DeterministicActor(
+            action_dim=self.action_dim, hidden_sizes=args.hidden_sizes
+        )
+        self.critic = TwinQNet(hidden_sizes=args.hidden_sizes)
+        actor_tx = optax.adam(args.actor_learning_rate)
+        critic_tx = optax.adam(args.learning_rate)
+
+        key = key if key is not None else jax.random.PRNGKey(args.seed)
+        k_a, k_c, self._key = jax.random.split(key, 3)
+        dummy_obs = jnp.zeros((1,) + self.obs_shape, jnp.float32)
+        dummy_act = jnp.zeros((1, self.action_dim), jnp.float32)
+        actor_params = self.actor.init(k_a, dummy_obs)
+        critic_params = self.critic.init(k_c, dummy_obs, dummy_act)
+        self.state = TD3TrainState(
+            actor_params=actor_params,
+            target_actor_params=jax.tree_util.tree_map(jnp.copy, actor_params),
+            critic_params=critic_params,
+            target_critic_params=jax.tree_util.tree_map(jnp.copy, critic_params),
+            actor_opt=actor_tx.init(actor_params),
+            critic_opt=critic_tx.init(critic_params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        self._learn = jax.jit(
+            make_td3_learn_fn(
+                self.actor, self.critic, actor_tx, critic_tx, args,
+                self.action_scale, self.action_bias,
+            )
+        )
+        self._act = jax.jit(self._act_impl)
+
+    def _act_impl(self, actor_params, obs, noise_std, key):
+        a = self.actor.apply(actor_params, obs)
+        a = a * self.action_scale + self.action_bias
+        noise = noise_std * self.action_scale * jax.random.normal(key, a.shape)
+        return jnp.clip(a + noise, self._low, self._high)
+
+    def get_action(self, obs: np.ndarray) -> np.ndarray:
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(
+            self._act(self.state.actor_params, obs, self.args.explore_noise_std, sub)
+        )
+
+    def predict(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self._act(
+                self.state.actor_params, obs, 0.0, jax.random.PRNGKey(0)
+            )
+        )
+
+    def learn(self, batch: Mapping[str, Any]) -> Dict[str, Any]:
+        self._key, sub = jax.random.split(self._key)
+        self.state, metrics, td_abs = self._learn(self.state, dict(batch), sub)
+        out: Dict[str, Any] = {k: float(v) for k, v in metrics.items()}
+        out["td_abs"] = td_abs
+        return out
+
+    def get_weights(self):
+        return self.state.actor_params
+
+    def set_weights(self, weights) -> None:
+        self.state = self.state.replace(actor_params=weights)
+
+    def save_checkpoint(self, path: str) -> str:
+        return save_checkpoint(path, self.state)
+
+    def load_checkpoint(self, path: str) -> None:
+        self.state = load_checkpoint(path, self.state)

@@ -220,6 +220,41 @@ class SACArguments(RLArguments):
 
 
 @dataclass
+class TD3Arguments(RLArguments):
+    """TD3 options (beyond-parity continuous control, companion to SAC):
+    deterministic tanh actor + exploration noise, clipped double-Q,
+    target policy smoothing, delayed actor/target updates."""
+
+    algo_name: str = "td3"
+    env_id: str = "Pendulum-v1"
+    hidden_sizes: str = "256,256"
+    soft_update_tau: float = 0.005
+    policy_delay: int = 2
+    explore_noise_std: float = 0.1  # fraction of action scale
+    target_noise_std: float = 0.2
+    target_noise_clip: float = 0.5
+    actor_learning_rate: float = 3e-4
+    use_per: bool = False
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    per_beta_final: float = 1.0
+    n_steps: int = 1
+
+    def validate(self) -> None:
+        super().validate()
+        if self.policy_delay < 1:
+            raise ValueError(
+                f"policy_delay must be >= 1, got {self.policy_delay}"
+            )
+        if not 0.0 < self.soft_update_tau <= 1.0:
+            raise ValueError(
+                f"soft_update_tau must be in (0, 1], got {self.soft_update_tau}"
+            )
+        if self.n_steps < 1:
+            raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
+
+
+@dataclass
 class R2D2Arguments(RLArguments):
     """R2D2 options (beyond-parity: recurrent replay distributed DQN,
     Kapturowski et al. 2019 — the Ape-X lineage the reference's README

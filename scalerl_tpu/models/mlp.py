@@ -230,6 +230,20 @@ class TanhGaussianActor(nn.Module):
         return mean, log_std
 
 
+class DeterministicActor(nn.Module):
+    """MLP -> tanh action in [-1, 1]^d, scaled by the caller (TD3/DDPG)."""
+
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256)
+
+    @nn.compact
+    def __call__(self, obs: jnp.ndarray) -> jnp.ndarray:
+        x = obs.astype(jnp.float32)
+        for h in _parse_hidden(self.hidden_sizes):
+            x = nn.relu(nn.Dense(h)(x))
+        return jnp.tanh(nn.Dense(self.action_dim)(x))
+
+
 class TwinQNet(nn.Module):
     """Two independent Q(s, a) critics (SAC's clipped double-Q).
 

@@ -1,0 +1,120 @@
+"""TD3 tests: delayed updates, smoothing bounds, pipeline, learning proof."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.td3 import TD3Agent
+from scalerl_tpu.config import TD3Arguments
+from scalerl_tpu.envs import make_vect_envs
+from scalerl_tpu.trainer import OffPolicyTrainer
+
+
+def _args(**kw):
+    base = dict(
+        env_id="Pendulum-v1",
+        num_envs=2,
+        buffer_size=4096,
+        batch_size=32,
+        warmup_learn_steps=64,
+        train_frequency=2,
+        max_timesteps=600,
+        logger_backend="none",
+        logger_frequency=10**9,
+        save_model=False,
+        eval_frequency=10**9,
+        hidden_sizes="32,32",
+    )
+    base.update(kw)
+    return TD3Arguments(**base)
+
+
+def _agent(args):
+    return TD3Agent(
+        args, obs_shape=(3,),
+        action_low=np.array([-2.0], np.float32),
+        action_high=np.array([2.0], np.float32),
+    )
+
+
+def _batch(B=32):
+    return {
+        "obs": jax.random.normal(jax.random.PRNGKey(0), (B, 3)),
+        "next_obs": jax.random.normal(jax.random.PRNGKey(1), (B, 3)),
+        "action": jax.random.uniform(
+            jax.random.PRNGKey(2), (B, 1), minval=-2, maxval=2
+        ),
+        "reward": jax.random.normal(jax.random.PRNGKey(3), (B,)),
+        "done": jnp.zeros((B,), bool),
+    }
+
+
+def test_td3_delayed_actor_update():
+    """With policy_delay=2 the actor (and both targets) move only on even
+    steps; the critics move every step; optimizer counters stay integer."""
+    agent = _agent(_args(policy_delay=2))
+    batch = _batch()
+    a0 = jax.tree_util.tree_leaves(agent.state.actor_params)[0].copy()
+    t0 = jax.tree_util.tree_leaves(agent.state.target_critic_params)[0].copy()
+    c0 = jax.tree_util.tree_leaves(agent.state.critic_params)[0].copy()
+    agent.learn(batch)  # step 1: odd -> actor/targets frozen
+    a1 = jax.tree_util.tree_leaves(agent.state.actor_params)[0]
+    t1 = jax.tree_util.tree_leaves(agent.state.target_critic_params)[0]
+    c1 = jax.tree_util.tree_leaves(agent.state.critic_params)[0]
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+    np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+    assert not np.allclose(np.asarray(c0), np.asarray(c1))
+    agent.learn(batch)  # step 2: even -> actor + targets update
+    a2 = jax.tree_util.tree_leaves(agent.state.actor_params)[0]
+    t2 = jax.tree_util.tree_leaves(agent.state.target_critic_params)[0]
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+    # adam counters survived the masked update as integers
+    counts = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(agent.state.actor_opt)
+        if np.asarray(leaf).dtype.kind == "i"
+    ]
+    assert counts, "optimizer integer counters lost their dtype"
+
+
+def test_td3_actions_respect_bounds():
+    agent = _agent(_args(explore_noise_std=0.5))
+    obs = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    a = agent.get_action(obs)
+    assert np.all(a >= -2.0) and np.all(a <= 2.0)
+    g = agent.predict(obs)
+    assert np.all(g >= -2.0) and np.all(g <= 2.0)
+    # deterministic eval: same obs -> same action
+    np.testing.assert_array_equal(g, agent.predict(obs))
+
+
+def test_td3_offpolicy_trainer_pipeline(tmp_path):
+    pytest.importorskip("gymnasium")
+    args = _args(work_dir=str(tmp_path))
+    envs = make_vect_envs("Pendulum-v1", num_envs=2, seed=0, async_envs=False)
+    space = envs.single_action_space
+    agent = TD3Agent(
+        args, obs_shape=(3,), action_low=space.low, action_high=space.high
+    )
+    trainer = OffPolicyTrainer(args, agent, envs)
+    trainer.run()
+    assert trainer.global_step >= args.max_timesteps
+    assert trainer.learn_steps > 0
+    trainer.close()
+    envs.close()
+
+
+@pytest.mark.slow
+def test_td3_solves_pendulum():
+    """TD3 reaches a greedy eval far above random on Pendulum (same
+    calibrated threshold as the SAC proof)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from examples.learning_curves import run_td3_pendulum
+
+    res = run_td3_pendulum()
+    assert res["eval_reward"] >= -400.0, res
