@@ -15,16 +15,70 @@ from typing import Any, Dict, Mapping
 import numpy as np
 
 
+class RecurrentEvalState:
+    """Persistent recurrent carry behind the host ``get_action``/``predict``
+    API (one slot per mode, so exploration and greedy eval don't clobber
+    each other's memory).
+
+    The signatures are per-call, but a recurrent agent needs its core to
+    survive across calls: rows reset where the caller's ``done`` flag is
+    True, everything rebuilds on a batch-size change, and with ``done=None``
+    on a fresh slot the whole batch resets (the post-env-reset case).
+    Rewards are not part of this host API, so the reward input is zero —
+    exact recurrent rollouts go through ``actor_view``/``act`` with a
+    caller-held core.
+    """
+
+    def __init__(self, initial_state_fn) -> None:
+        self._initial_state_fn = initial_state_fn
+        self._modes: Dict[str, Dict[str, Any]] = {}
+
+    def step_inputs(self, mode: str, batch_size: int, done):
+        st = self._modes.get(mode)
+        if st is None or st["batch"] != batch_size:
+            st = {
+                "batch": batch_size,
+                "core": self._initial_state_fn(batch_size),
+                "prev_action": np.zeros(batch_size, np.int32),
+            }
+            self._modes[mode] = st
+            done_in = np.ones(batch_size, bool)
+        elif done is None:
+            done_in = np.zeros(batch_size, bool)
+        else:
+            done_in = np.asarray(done, bool)
+        # fresh episodes start with a zero last-action input (matching the
+        # core reset the model applies on done rows)
+        prev_action = np.where(done_in, 0, st["prev_action"]).astype(np.int32)
+        reward = np.zeros(batch_size, np.float32)
+        return st["core"], prev_action, reward, done_in
+
+    def update(self, mode: str, action, core) -> None:
+        st = self._modes[mode]
+        st["prev_action"] = np.asarray(action, np.int32)
+        st["core"] = core
+
+    def reset(self) -> None:
+        """Drop all carried cores (e.g. after loading new weights)."""
+        self._modes.clear()
+
+
 class BaseAgent(ABC):
     """Algorithm-agnostic agent API consumed by the trainers."""
 
     @abstractmethod
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
-        """Sample actions with exploration (host entry point for actors)."""
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Sample actions with exploration (host entry point for actors).
+
+        ``done`` is the previous step's episode-boundary flag
+        (``term | trunc``) per env lane. Recurrent agents use it to reset
+        rows of their persistent core; stateless agents ignore it. Pass
+        all-ones on the first step after an env reset.
+        """
 
     @abstractmethod
-    def predict(self, obs: np.ndarray) -> np.ndarray:
-        """Greedy/argmax actions (evaluation)."""
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Greedy/argmax actions (evaluation). ``done`` as in get_action."""
 
     @abstractmethod
     def learn(self, batch: Mapping[str, Any]) -> Dict[str, float]:

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.agents.base import BaseAgent, RecurrentEvalState
 from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
 
 
@@ -72,11 +72,15 @@ class PolicyValueAgent(BaseAgent):
             return action, logits, new_core
 
         self._act = jax.jit(act)
-        self._act_greedy = jax.jit(
-            lambda params, obs, last_action, reward, done, core_state: model.apply(
+
+        def act_greedy(params, obs, last_action, reward, done, core_state):
+            out, new_core = model.apply(
                 params, obs[None], last_action[None], reward[None], done[None], core_state
-            )[0].policy_logits[0].argmax(-1)
-        )
+            )
+            return out.policy_logits[0].argmax(-1), new_core
+
+        self._act_greedy = jax.jit(act_greedy)
+        self._eval_state = RecurrentEvalState(self.initial_state)
 
     # ------------------------------------------------------------------
     def initial_state(self, batch_size: int):
@@ -101,29 +105,29 @@ class PolicyValueAgent(BaseAgent):
             self._next_key(),
         )
 
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Sampled actions with a persistent recurrent core (rows reset
+        where the previous step's ``done`` flag is True)."""
         B = np.asarray(obs).shape[0]
-        a, _, _ = self.act(
-            obs,
-            np.zeros(B, np.int32),
-            np.zeros(B, np.float32),
-            np.zeros(B, bool),
-            self.initial_state(B),
-        )
+        core, prev_a, prev_r, done_in = self._eval_state.step_inputs("explore", B, done)
+        a, _, new_core = self.act(obs, prev_a, prev_r, done_in, core)
+        self._eval_state.update("explore", a, new_core)
         return np.asarray(a)
 
-    def predict(self, obs: np.ndarray) -> np.ndarray:
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Greedy actions, same persistent-core contract as get_action."""
         B = np.asarray(obs).shape[0]
-        return np.asarray(
-            self._act_greedy(
-                self.state.params,
-                jnp.asarray(obs),
-                jnp.zeros(B, jnp.int32),
-                jnp.zeros(B, jnp.float32),
-                jnp.zeros(B, bool),
-                self.initial_state(B),
-            )
+        core, prev_a, prev_r, done_in = self._eval_state.step_inputs("greedy", B, done)
+        a, new_core = self._act_greedy(
+            self.state.params,
+            jnp.asarray(obs),
+            jnp.asarray(prev_a, jnp.int32),
+            jnp.asarray(prev_r, jnp.float32),
+            jnp.asarray(done_in, jnp.bool_),
+            core,
         )
+        self._eval_state.update("greedy", a, new_core)
+        return np.asarray(a)
 
     def enable_mesh(self, mesh_or_spec, batch_example=None) -> None:
         """Shard the learn step over a device mesh (the ``--mesh-shape``
@@ -162,12 +166,15 @@ class PolicyValueAgent(BaseAgent):
 
     def set_weights(self, weights) -> None:
         self.state = self.state.replace(params=weights)
+        # a carried eval core was produced by the OLD weights; drop it
+        self._eval_state.reset()
 
     def save_checkpoint(self, path: str) -> str:
         return save_checkpoint(path, self.state)
 
     def load_checkpoint(self, path: str) -> None:
         self.state = load_checkpoint(path, self.state)
+        self._eval_state.reset()
 
 
 def frames_counter() -> jnp.ndarray:

@@ -62,6 +62,7 @@ def ppo_loss(
     value_loss_coef: float,
     entropy_coef: float,
     normalize_advantage: bool,
+    loss_reduction: str = "sum",
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped surrogate + (optionally clipped) value loss + entropy bonus
     over one lane minibatch (full sequences, ``[T+1, b]`` rows).
@@ -73,12 +74,13 @@ def ppo_loss(
     ``mean_*`` for diagnostics — the metric-name contract of
     ``agents/impala.py``.
 
-    NOTE on learning rates: the sum convention means the gradient scale
-    grows with ``rollout_length`` x lanes-per-minibatch, unlike SB3/
+    NOTE on learning rates: the default sum convention means the gradient
+    scale grows with ``rollout_length`` x lanes-per-minibatch, unlike SB3/
     baselines PPO which averages over the minibatch.  Published PPO
-    learning rates (e.g. 3e-4) do not transfer directly — scale lr down
-    by roughly the minibatch element count, or retune per batch shape
-    (see PPOArguments).
+    learning rates (e.g. 3e-4) do not transfer directly under "sum" —
+    pass ``loss_reduction="mean"`` (divides every term by the [T, b]
+    element count, making gradients batch-shape invariant and published
+    lrs usable as-is), or retune per batch shape (see PPOArguments).
     """
     out, _ = model.apply(
         params, mb["obs"], mb["action"], mb["reward"], mb["done"], mb["core_state"]
@@ -109,6 +111,10 @@ def ppo_loss(
         vl = 0.5 * jnp.sum(jnp.square(values_new - vs))
     vl = value_loss_coef * vl
     ent = entropy_coef * entropy_loss(logits)
+
+    if loss_reduction == "mean":
+        scale = 1.0 / (values_new.shape[0] * values_new.shape[1])  # [T, b] count
+        pg, vl, ent = pg * scale, vl * scale, ent * scale
 
     total = pg + vl + ent
     metrics = {
@@ -196,6 +202,7 @@ def make_ppo_learn_fn(
                 value_loss_coef=args.value_loss_coef,
                 entropy_coef=args.entropy_coef,
                 normalize_advantage=args.normalize_advantage,
+                loss_reduction=args.loss_reduction,
             )
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)

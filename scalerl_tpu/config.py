@@ -347,6 +347,9 @@ class PPOArguments(RLArguments):
     value_loss_coef: float = 0.5
     entropy_coef: float = 0.01
     normalize_advantage: bool = True
+    # "sum" (repo convention, gradient scale grows with minibatch elements)
+    # or "mean" (SB3/baselines convention: published lrs transfer as-is)
+    loss_reduction: str = "sum"
     # Model (same zoo as A3C: MLP for flat obs, conv[+LSTM] for pixels)
     hidden_sizes: str = "128,128"
     use_lstm: bool = False
@@ -367,6 +370,10 @@ class PPOArguments(RLArguments):
                 "minibatches split over env lanes (full sequences, so LSTM "
                 f"carries stay valid): num_workers ({self.num_workers}) must "
                 f"divide by num_minibatches ({self.num_minibatches})"
+            )
+        if self.loss_reduction not in ("sum", "mean"):
+            raise ValueError(
+                f"loss_reduction must be 'sum' or 'mean', got {self.loss_reduction!r}"
             )
         if self.ppo_epochs <= 0:
             raise ValueError(f"ppo_epochs must be positive, got {self.ppo_epochs}")

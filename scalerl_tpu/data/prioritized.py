@@ -176,7 +176,7 @@ class PrioritizedReplayBuffer:
     ) -> None:
         self.spec = dict(transition_spec(
             obs_shape, obs_dtype, action_dtype=action_dtype,
-            action_shape=action_shape,
+            action_shape=action_shape, include_boundary=n_step > 1,
         ))
         if extra_fields:
             self.spec.update(extra_fields)
@@ -201,19 +201,21 @@ class PrioritizedReplayBuffer:
 
     def _coerce_step(self, step: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         step = {k: jnp.asarray(v) for k, v in step.items()}
+        if "boundary" in self.spec:
+            step.setdefault("boundary", step["done"])
+        else:
+            step.pop("boundary", None)  # inert at n_step=1; spec has no plane
         for k, v in step.items():
             want = (self.num_envs,) + tuple(self.spec[k][0])
             if v.shape != want:
                 step[k] = v.reshape(want)
         return step
 
-    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
-        self.state = self._add(
-            self.state,
-            self._coerce_step(
-                {"obs": obs, "next_obs": next_obs, "action": action, "reward": reward, "done": done}
-            ),
-        )
+    def save_to_memory(self, obs, next_obs, action, reward, done, boundary=None) -> None:
+        step = {"obs": obs, "next_obs": next_obs, "action": action, "reward": reward, "done": done}
+        if boundary is not None:
+            step["boundary"] = boundary
+        self.state = self._add(self.state, self._coerce_step(step))
 
     def add_with_priorities(self, step: Dict[str, jnp.ndarray], priorities) -> None:
         """Add one vector step (any spec fields) with actor-computed

@@ -37,15 +37,31 @@ def transition_spec(
     obs_dtype: jnp.dtype = jnp.float32,
     action_dtype: jnp.dtype = jnp.int32,
     action_shape: Tuple[int, ...] = (),
+    include_boundary: bool = False,
 ) -> Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]:
-    """The standard (obs, next_obs, action, reward, done) transition layout."""
-    return {
+    """The standard (obs, next_obs, action, reward, done) transition layout.
+
+    ``done`` is the bootstrap mask: TERMINATIONS only (a truncated episode
+    still bootstraps from its last next_obs).
+
+    ``include_boundary`` adds an episode-boundary plane (term | trunc) that
+    stops the n-step reward fold so a window never folds rewards across a
+    TimeLimit reset (advisor r3: truncation-ended envs like Pendulum would
+    otherwise leak returns across episodes at n_steps > 1). Buffers enable
+    it iff n_step > 1 — at n_step = 1 the single-row window makes boundary
+    information inert, so storing it would duplicate ``done``. Writers that
+    don't supply it get boundary = done (exact for termination-only envs).
+    """
+    spec = {
         "obs": (tuple(obs_shape), obs_dtype),
         "next_obs": (tuple(obs_shape), obs_dtype),
         "action": (tuple(action_shape), action_dtype),
         "reward": ((), jnp.float32),
         "done": ((), jnp.bool_),
     }
+    if include_boundary:
+        spec["boundary"] = ((), jnp.bool_)
+    return spec
 
 
 @struct.dataclass
@@ -105,27 +121,43 @@ def _gather_window(
 
 def n_step_fold(
     rewards: jnp.ndarray,  # [B, n]
-    dones: jnp.ndarray,  # [B, n] bool
+    dones: jnp.ndarray,  # [B, n] bool: terminations (bootstrap mask)
     gamma: float,
+    boundaries: jnp.ndarray | None = None,  # [B, n] bool: term | trunc
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Fold an n-step window into (reward, done, last_index).
 
-    The reward at the first done step is included; steps after it are masked
-    (exactly ``MultiStepReplayBuffer._get_n_step_info``,
+    The reward at the first episode boundary is included; steps after it are
+    masked (exactly ``MultiStepReplayBuffer._get_n_step_info``,
     ``replay_buffer.py:230-273``).  ``last_index`` is the offset whose
-    ``next_obs`` bootstraps the return (first done, else n-1).
+    ``next_obs`` bootstraps the return (first boundary, else n-1).
+
+    ``boundaries`` (term | trunc) bounds the fold window; ``dones``
+    (terminations only) decides whether the realized window's end kills the
+    bootstrap. With ``boundaries=None`` the two coincide — correct when every
+    episode ends by termination.
     """
     n = rewards.shape[1]
-    donesf = dones.astype(rewards.dtype)
+    if boundaries is None:
+        boundaries = dones
+    else:
+        # a termination is always an episode boundary; OR-ing here makes the
+        # boundary ⊇ done invariant unbreakable by writers that store only
+        # the truncation flag
+        boundaries = boundaries | dones
+    boundsf = boundaries.astype(rewards.dtype)
     # alive[:, k] = survived steps 0..k-1
-    alive = jnp.cumprod(1.0 - donesf, axis=1)
+    alive = jnp.cumprod(1.0 - boundsf, axis=1)
     alive = jnp.concatenate([jnp.ones_like(alive[:, :1]), alive[:, :-1]], axis=1)
     gammas = gamma ** jnp.arange(n, dtype=rewards.dtype)
     reward = jnp.sum(rewards * alive * gammas[None, :], axis=1)
-    any_done = jnp.any(dones, axis=1)
-    first_done = jnp.argmax(dones, axis=1)
-    last_index = jnp.where(any_done, first_done, n - 1)
-    return reward, any_done, last_index
+    any_bound = jnp.any(boundaries, axis=1)
+    first_bound = jnp.argmax(boundaries, axis=1)
+    last_index = jnp.where(any_bound, first_bound, n - 1)
+    # termination iff the realized window ends on a terminal row (a window
+    # cut by truncation keeps its bootstrap)
+    done = jnp.take_along_axis(dones, last_index[:, None], axis=1)[:, 0] & any_bound
+    return reward, done, last_index
 
 
 def gather_transitions(
@@ -142,7 +174,12 @@ def gather_transitions(
     rows = (start + logical[:, None] + offs[None, :]) % capacity  # [B, n]
     rewards = _gather_window(state.storage["reward"], rows, envs[:, None])
     dones = _gather_window(state.storage["done"], rows, envs[:, None])
-    reward_n, done_n, last_idx = n_step_fold(rewards, dones, gamma)
+    bounds = (
+        _gather_window(state.storage["boundary"], rows, envs[:, None])
+        if "boundary" in state.storage
+        else None
+    )
+    reward_n, done_n, last_idx = n_step_fold(rewards, dones, gamma, bounds)
 
     row0 = rows[:, 0]
     row_last = jnp.take_along_axis(rows, last_idx[:, None], axis=1)[:, 0]
@@ -163,7 +200,7 @@ def gather_transitions(
     # at the window head; a stored field may override a computed key — e.g.
     # Ape-X actors store pre-folded transitions whose realized ``n_steps``
     # must survive sampling (the buffer then runs with n_step=1).
-    standard = {"obs", "next_obs", "action", "reward", "done"}
+    standard = {"obs", "next_obs", "action", "reward", "done", "boundary"}
     for name, arr in state.storage.items():
         if name not in standard:
             batch[name] = arr[row0, envs]
@@ -213,7 +250,7 @@ class ReplayBuffer:
     ) -> None:
         self.spec = transition_spec(
             obs_shape, obs_dtype, action_dtype=action_dtype,
-            action_shape=action_shape,
+            action_shape=action_shape, include_boundary=n_step > 1,
         )
         self.capacity = capacity
         self.num_envs = num_envs
@@ -235,8 +272,12 @@ class ReplayBuffer:
     def num_transitions(self) -> int:
         return len(self)
 
-    def save_to_memory(self, obs, next_obs, action, reward, done) -> None:
-        """Add one vector step (accepts numpy or jax arrays; [num_envs, ...])."""
+    def save_to_memory(self, obs, next_obs, action, reward, done, boundary=None) -> None:
+        """Add one vector step (accepts numpy or jax arrays; [num_envs, ...]).
+
+        ``boundary`` is the episode-boundary flag (term | trunc) bounding the
+        n-step fold; defaults to ``done`` (exact for termination-only envs).
+        """
         step = {
             "obs": jnp.atleast_1d(jnp.asarray(obs)),
             "next_obs": jnp.atleast_1d(jnp.asarray(next_obs)),
@@ -244,6 +285,10 @@ class ReplayBuffer:
             "reward": jnp.atleast_1d(jnp.asarray(reward)),
             "done": jnp.atleast_1d(jnp.asarray(done)),
         }
+        if "boundary" in self.spec:
+            step["boundary"] = jnp.atleast_1d(
+                jnp.asarray(done if boundary is None else boundary)
+            )
         # allow single-env calls without the env axis
         for k, v in step.items():
             want = (self.num_envs,) + tuple(self.spec[k][0])
@@ -259,6 +304,10 @@ class ReplayBuffer:
         once; varying T recompiles per length.
         """
         step = {k: jnp.asarray(v) for k, v in chunk.items()}
+        if "boundary" in self.spec:
+            step.setdefault("boundary", step["done"])
+        else:
+            step.pop("boundary", None)  # inert at n_step=1; spec has no plane
         T = next(iter(step.values())).shape[0]
         for k, v in step.items():
             want = (T, self.num_envs) + tuple(self.spec[k][0])

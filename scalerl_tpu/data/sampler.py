@@ -63,8 +63,8 @@ class Sampler:
     def __len__(self) -> int:
         return len(self.buffer)
 
-    def add(self, obs, next_obs, action, reward, done) -> None:
-        self.buffer.save_to_memory(obs, next_obs, action, reward, done)
+    def add(self, obs, next_obs, action, reward, done, boundary=None) -> None:
+        self.buffer.save_to_memory(obs, next_obs, action, reward, done, boundary=boundary)
 
     def sample(
         self,

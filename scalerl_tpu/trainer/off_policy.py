@@ -68,16 +68,25 @@ class OffPolicyTrainer(BaseTrainer):
         self.metrics = EpisodeMetrics(self.num_envs)
 
     # ------------------------------------------------------------------
-    def store_experience(self, obs, next_obs, action, reward, terminated, infos) -> None:
+    def store_experience(
+        self, obs, next_obs, action, reward, terminated, infos, truncated=None
+    ) -> None:
         """Store one vector step; on done, ``next_obs`` is the true terminal
-        obs from ``infos['final_obs']`` (SAME_STEP autoreset semantics)."""
+        obs from ``infos['final_obs']`` (SAME_STEP autoreset semantics).
+
+        ``terminated`` alone is the bootstrap mask; ``terminated | truncated``
+        bounds the n-step fold so windows never cross a TimeLimit reset.
+        """
         real_next = np.asarray(next_obs).copy()
         final_obs = infos.get("final_obs") if isinstance(infos, dict) else None
         if final_obs is not None:
             mask = infos.get("_final_obs")
             for i in np.nonzero(mask)[0]:
                 real_next[i] = final_obs[i]
-        self.sampler.add(obs, real_next, action, reward, terminated)
+        boundary = (
+            np.logical_or(terminated, truncated) if truncated is not None else None
+        )
+        self.sampler.add(obs, real_next, action, reward, terminated, boundary=boundary)
 
     def train_step(self) -> Dict[str, float]:
         beta = self.per_beta.value(self.global_step)
@@ -99,12 +108,14 @@ class OffPolicyTrainer(BaseTrainer):
         returns: list = []
         ep_ret = np.zeros(num_envs)
         ep_len = np.zeros(num_envs, int)
+        prev_done = np.ones(num_envs, bool)
         while len(returns) < n_episodes:
-            actions = self.agent.predict(obs)
+            actions = self.agent.predict(obs, done=prev_done)
             obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
             ep_ret += reward
             ep_len += 1
             done = np.logical_or(term, trunc)
+            prev_done = done
             for i in np.nonzero(done)[0]:
                 returns.append((ep_ret[i], ep_len[i]))
                 ep_ret[i] = 0.0
@@ -165,11 +176,13 @@ class OffPolicyTrainer(BaseTrainer):
         last_save = self.global_step
         train_info: Dict[str, float] = {}
 
+        prev_done = np.ones(self.num_envs, bool)
         while self.global_step < args.max_timesteps:
-            actions = self.agent.get_action(obs)
+            actions = self.agent.get_action(obs, done=prev_done)
             next_obs, reward, term, trunc, infos = self.train_envs.step(np.asarray(actions))
-            self.store_experience(obs, next_obs, actions, reward, term, infos)
-            self.metrics.step(reward, np.logical_or(term, trunc))
+            self.store_experience(obs, next_obs, actions, reward, term, infos, trunc)
+            prev_done = np.logical_or(term, trunc)
+            self.metrics.step(reward, prev_done)
             obs = next_obs
             self.global_step += self.num_envs
             if hasattr(self.agent, "update_exploration"):

@@ -102,12 +102,14 @@ class OnPolicyTrainer(BaseTrainer):
         returns: list = []
         ep_ret = np.zeros(num_envs)
         ep_len = np.zeros(num_envs, int)
+        prev_done = np.ones(num_envs, bool)
         while len(returns) < n_episodes:
-            actions = self.agent.predict(obs)
+            actions = self.agent.predict(obs, done=prev_done)
             obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
             ep_ret += reward
             ep_len += 1
             done = np.logical_or(term, trunc)
+            prev_done = done
             for i in np.nonzero(done)[0]:
                 returns.append((ep_ret[i], ep_len[i]))
                 ep_ret[i] = 0.0

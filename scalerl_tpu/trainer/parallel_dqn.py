@@ -94,14 +94,18 @@ def _actor_main(conn: PipeConnection, cfg: _ActorConfig, ring: ShmRolloutRing) -
                     q = mlp_qnet_forward(weights, obs[None], cfg.dueling)
                     a = int(np.argmax(q[0]))
                 nxt, r, term, trunc, _ = env.step(a)
+                ep_ret += float(r)
+                ep_len += 1
+                ep_end = bool(term or trunc or ep_len >= cfg.max_episode_steps)
                 slot["obs"][t] = obs
                 slot["action"][t] = a
                 slot["reward"][t] = r
                 slot["next_obs"][t] = nxt
                 slot["done"][t] = term
-                ep_ret += float(r)
-                ep_len += 1
-                if term or trunc or ep_len >= cfg.max_episode_steps:
+                # episode boundary incl. truncation/step-cap: bounds the
+                # n-step fold so windows never cross this actor's resets
+                slot["boundary"][t] = ep_end
+                if ep_end:
                     returns.append(ep_ret)
                     ep_ret, ep_len = 0.0, 0
                     obs, _ = env.reset()
@@ -157,6 +161,7 @@ class ParallelDQNTrainer(BaseTrainer):
             "reward": ((T,), np.float32),
             "next_obs": ((T,) + tuple(obs_shape), np.float32),
             "done": ((T,), np.bool_),
+            "boundary": ((T,), np.bool_),  # term | trunc | step-cap
             "meta": ((2,), np.int64),  # actor_id, weight version
         })
         self.ring = ShmRolloutRing(spec, num_slots=num_slots)
@@ -279,6 +284,7 @@ class ParallelDQNTrainer(BaseTrainer):
                     reward=slab["reward"][0, :, None],
                     next_obs=slab["next_obs"][0, :, None],
                     done=slab["done"][0, :, None],
+                    boundary=slab["boundary"][0, :, None],
                 )
             self.env_steps += self.args.rollout_length
             drained += 1
@@ -293,6 +299,7 @@ class ParallelDQNTrainer(BaseTrainer):
                 action=slab["action"][0, t][None],
                 reward=slab["reward"][0, t][None],
                 done=slab["done"][0, t][None],
+                boundary=slab["boundary"][0, t][None],
             )
 
     def train(self, total_steps: Optional[int] = None) -> Dict[str, float]:

@@ -75,7 +75,7 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             batch_size=self.envs_per_actor,
             obs_shape=obs_shape,
             num_actions=num_actions,
-            obs_dtype=jnp.float32 if len(obs_shape) == 1 else jnp.uint8,
+            obs_dtype=jnp.uint8 if len(obs_shape) == 3 else jnp.float32,
             core_state_shapes=tuple(tuple(c.shape) for c, _ in core),
         )
         self.queue = RolloutQueue(self.spec, num_slots=args.num_buffers)

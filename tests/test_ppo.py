@@ -117,6 +117,55 @@ def test_ppo_learn_step_updates_state():
     assert m2["mean_approx_kl"] >= 0.0
 
 
+def test_ppo_mean_reduction_scales_sum_gradients():
+    """loss_reduction="mean" == "sum" gradients divided by the static
+    minibatch element count T * (B / num_minibatches) — the SB3 lr
+    convention with no other behavior change."""
+    from scalerl_tpu.agents.ppo import ppo_loss
+    from scalerl_tpu.agents.a3c import build_model
+
+    args = _args(ppo_epochs=1, num_minibatches=1, normalize_advantage=False)
+    agent = PPOAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    T, B = args.rollout_length, args.num_workers
+    traj = _random_traj(jax.random.PRNGKey(7), T, B, 2)
+    values = jnp.zeros((T, B))
+    mb = {
+        "obs": traj.obs, "action": traj.action, "reward": traj.reward,
+        "done": traj.done, "core_state": traj.core_state,
+        "advantages": jax.random.normal(jax.random.PRNGKey(8), (T, B)),
+        "value_targets": jax.random.normal(jax.random.PRNGKey(9), (T, B)),
+        "behavior_logp": -jnp.ones((T, B)),
+        "old_values": values,
+    }
+
+    def grads(reduction):
+        (_, _), g = jax.value_and_grad(ppo_loss, has_aux=True)(
+            agent.state.params, agent.model, mb,
+            clip_range=args.clip_range, clip_range_vf=0.0,
+            value_loss_coef=args.value_loss_coef,
+            entropy_coef=args.entropy_coef,
+            normalize_advantage=False, loss_reduction=reduction,
+        )
+        return g
+
+    g_sum, g_mean = grads("sum"), grads("mean")
+    scale = 1.0 / (T * B)
+    for a, b in zip(jax.tree_util.tree_leaves(g_sum), jax.tree_util.tree_leaves(g_mean)):
+        np.testing.assert_allclose(
+            np.asarray(a) * scale, np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+    # config surface: bad value rejected, good value runs end to end
+    with pytest.raises(ValueError):
+        _args(loss_reduction="median").validate()
+    agent_m = PPOAgent(
+        _args(loss_reduction="mean"), obs_shape=(4,), num_actions=2,
+        obs_dtype=jnp.float32,
+    )
+    m = agent_m.learn(traj)
+    assert np.isfinite(m["total_loss"])
+
+
 def test_ppo_gradient_direction():
     """Positive-advantage actions get their probability pushed up."""
     args = _args(
