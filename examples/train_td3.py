@@ -37,6 +37,10 @@ def main() -> None:
         action_low=space.low,
         action_high=space.high,
     )
+    if args.mesh_shape:
+        # DDP over a device mesh: batch sharded dp x fsdp, gradients
+        # all-reduced by GSPMD (same one-call form as every other family)
+        agent.enable_mesh(args.mesh_shape)
     trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs)
     try:
         summary = trainer.run()

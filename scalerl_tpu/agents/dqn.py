@@ -356,7 +356,7 @@ class DQNAgent(BaseAgent):
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         obs = jnp.asarray(obs, jnp.float32)
         squeeze = obs.ndim == len(self.obs_shape)
         if squeeze:
@@ -365,7 +365,7 @@ class DQNAgent(BaseAgent):
         out = np.asarray(actions)
         return out[0] if squeeze else out
 
-    def predict(self, obs: np.ndarray) -> np.ndarray:
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         obs = jnp.asarray(obs, jnp.float32)
         squeeze = obs.ndim == len(self.obs_shape)
         if squeeze:
@@ -387,35 +387,9 @@ class DQNAgent(BaseAgent):
         comes back replicated for PER priority feedback.  Call once before
         training; numerically identical to the single-device update at the
         same global batch (asserted by test)."""
-        from scalerl_tpu.parallel import make_parallel_learn_fn, resolve_mesh
+        from scalerl_tpu.parallel import enable_offpolicy_mesh
 
-        mesh = resolve_mesh(mesh_or_spec)
-        n_batch_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
-        if self.args.batch_size % n_batch_shards != 0:
-            raise ValueError(
-                f"batch_size ({self.args.batch_size}) must divide by the "
-                f"mesh's dp*fsdp extent ({n_batch_shards}) to shard the "
-                "replay batch"
-            )
-        raw = self._learn_raw
-
-        def two_out(state, batch):
-            # make_parallel_learn_fn expects (state, batch) -> (state, aux);
-            # fold the per-sample |TD| into the aux pytree
-            state, metrics, td_abs = raw(state, batch)
-            return state, (metrics, td_abs)
-
-        plearn = make_parallel_learn_fn(
-            two_out,
-            mesh,
-            self.state,
-            batch_time_major=False,  # replay batches are [B, ...]
-            donate_state=self._donate_state,
-        )
-        self.mesh = mesh
-        self.state = plearn.shard_state(self.state)
-        self._shard_batch = plearn.shard_batch
-        self._learn_mesh = plearn
+        enable_offpolicy_mesh(self, mesh_or_spec, donate_state=self._donate_state)
 
     def learn(self, batch: Mapping[str, Any]) -> Dict[str, float]:
         if self._learn_mesh is not None:

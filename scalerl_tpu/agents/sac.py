@@ -76,7 +76,11 @@ def make_sac_learn_fn(actor, critic, actor_tx, critic_tx, alpha_tx,
         logp = squash_log_prob(u, log_std, mean, action_scale)
         return a, logp
 
-    def learn(state: SACTrainState, batch: Mapping[str, jnp.ndarray], key):
+    def learn(state: SACTrainState, batch: Mapping[str, jnp.ndarray]):
+        # pure fn of (state, batch): the per-step RNG folds out of the step
+        # counter (the PPO fold_in pattern), so the update is resumable and
+        # mesh-shardable with no key plumbed through the batch
+        key = jax.random.fold_in(jax.random.PRNGKey(args.seed + 0x5AC), state.step)
         obs = batch["obs"]
         next_obs = batch["next_obs"]
         action = batch["action"]
@@ -226,14 +230,16 @@ class SACAgent(BaseAgent):
             step=jnp.zeros((), jnp.int32),
         )
         target_entropy = -self.action_dim * args.target_entropy_scale
-        self._learn = jax.jit(
-            make_sac_learn_fn(
-                self.actor, self.critic, actor_tx, critic_tx, alpha_tx,
-                args, self.action_scale, self.action_bias, target_entropy,
-            )
+        self._learn_raw = make_sac_learn_fn(
+            self.actor, self.critic, actor_tx, critic_tx, alpha_tx,
+            args, self.action_scale, self.action_bias, target_entropy,
         )
+        self._learn = jax.jit(self._learn_raw)
         self._sample = jax.jit(self._sample_impl)
         self._mean_act = jax.jit(self._mean_act_impl)
+        self.mesh = None
+        self._learn_mesh = None
+        self._shard_batch = None
 
     # -- acting --------------------------------------------------------
     def _sample_impl(self, actor_params, obs, key):
@@ -245,17 +251,33 @@ class SACAgent(BaseAgent):
         mean, _ = self.actor.apply(actor_params, obs)
         return squash(mean, self.action_scale, self.action_bias)
 
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         self._key, sub = jax.random.split(self._key)
         return np.asarray(self._sample(self.state.actor_params, obs, sub))
 
-    def predict(self, obs: np.ndarray) -> np.ndarray:
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         return np.asarray(self._mean_act(self.state.actor_params, obs))
 
     # -- learning ------------------------------------------------------
+    def enable_mesh(self, mesh_or_spec) -> None:
+        """Data-parallel SAC over a mesh (the DDP story every other agent
+        family has, ``docs/MIGRATION.md`` DQN row): the replay batch dim
+        shards over ``dp×fsdp``, big params over ``fsdp/tp`` where
+        divisible, GSPMD all-reduces gradients over ICI, and the
+        per-sample |TD| vector comes back replicated for PER feedback.
+        Call once before training; numerically identical to the
+        single-device update at the same global batch (asserted by
+        test)."""
+        from scalerl_tpu.parallel import enable_offpolicy_mesh
+
+        enable_offpolicy_mesh(self, mesh_or_spec)
+
     def learn(self, batch: Mapping[str, Any]) -> Dict[str, Any]:
-        self._key, sub = jax.random.split(self._key)
-        self.state, metrics, td_abs = self._learn(self.state, dict(batch), sub)
+        if self._learn_mesh is not None:
+            sharded = self._shard_batch(dict(batch))
+            self.state, (metrics, td_abs) = self._learn_mesh(self.state, sharded)
+        else:
+            self.state, metrics, td_abs = self._learn(self.state, dict(batch))
         out: Dict[str, Any] = {k: float(v) for k, v in metrics.items()}
         out["td_abs"] = td_abs  # device array, PER priority feedback
         return out

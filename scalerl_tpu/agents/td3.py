@@ -45,7 +45,11 @@ def make_td3_learn_fn(actor, critic, actor_tx, critic_tx, args: TD3Arguments,
     low = action_bias - action_scale
     high = action_bias + action_scale
 
-    def learn(state: TD3TrainState, batch: Mapping[str, jnp.ndarray], key):
+    def learn(state: TD3TrainState, batch: Mapping[str, jnp.ndarray]):
+        # pure fn of (state, batch): target-smoothing noise folds out of the
+        # step counter (the PPO fold_in pattern) — resumable and mesh-
+        # shardable with no key plumbed through the batch
+        key = jax.random.fold_in(jax.random.PRNGKey(args.seed + 0x7D3), state.step)
         obs = batch["obs"]
         next_obs = batch["next_obs"]
         action = batch["action"]
@@ -190,13 +194,15 @@ class TD3Agent(BaseAgent):
             critic_opt=critic_tx.init(critic_params),
             step=jnp.zeros((), jnp.int32),
         )
-        self._learn = jax.jit(
-            make_td3_learn_fn(
-                self.actor, self.critic, actor_tx, critic_tx, args,
-                self.action_scale, self.action_bias,
-            )
+        self._learn_raw = make_td3_learn_fn(
+            self.actor, self.critic, actor_tx, critic_tx, args,
+            self.action_scale, self.action_bias,
         )
+        self._learn = jax.jit(self._learn_raw)
         self._act = jax.jit(self._act_impl)
+        self.mesh = None
+        self._learn_mesh = None
+        self._shard_batch = None
 
     def _act_impl(self, actor_params, obs, noise_std, key):
         a = self.actor.apply(actor_params, obs)
@@ -204,22 +210,35 @@ class TD3Agent(BaseAgent):
         noise = noise_std * self.action_scale * jax.random.normal(key, a.shape)
         return jnp.clip(a + noise, self._low, self._high)
 
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         self._key, sub = jax.random.split(self._key)
         return np.asarray(
             self._act(self.state.actor_params, obs, self.args.explore_noise_std, sub)
         )
 
-    def predict(self, obs: np.ndarray) -> np.ndarray:
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
         return np.asarray(
             self._act(
                 self.state.actor_params, obs, 0.0, jax.random.PRNGKey(0)
             )
         )
 
+    def enable_mesh(self, mesh_or_spec) -> None:
+        """Data-parallel TD3 over a mesh — same contract as
+        ``SACAgent.enable_mesh`` (batch over ``dp×fsdp``, params over
+        ``fsdp/tp`` where divisible, gradient psum by GSPMD, replicated
+        |TD| for PER).  Numerically identical to the single-device update
+        at the same global batch (asserted by test)."""
+        from scalerl_tpu.parallel import enable_offpolicy_mesh
+
+        enable_offpolicy_mesh(self, mesh_or_spec)
+
     def learn(self, batch: Mapping[str, Any]) -> Dict[str, Any]:
-        self._key, sub = jax.random.split(self._key)
-        self.state, metrics, td_abs = self._learn(self.state, dict(batch), sub)
+        if self._learn_mesh is not None:
+            sharded = self._shard_batch(dict(batch))
+            self.state, (metrics, td_abs) = self._learn_mesh(self.state, sharded)
+        else:
+            self.state, metrics, td_abs = self._learn(self.state, dict(batch))
         out: Dict[str, Any] = {k: float(v) for k, v in metrics.items()}
         out["td_abs"] = td_abs
         return out

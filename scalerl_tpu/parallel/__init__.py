@@ -38,6 +38,7 @@ from scalerl_tpu.parallel.sharding import (  # noqa: F401
     trajectory_sharding,
 )
 from scalerl_tpu.parallel.train_step import (  # noqa: F401
+    enable_offpolicy_mesh,
     make_parallel_act_fn,
     make_parallel_learn_fn,
 )

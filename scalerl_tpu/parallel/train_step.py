@@ -117,6 +117,50 @@ def make_parallel_learn_fn(
     return jitted
 
 
+def enable_offpolicy_mesh(agent, mesh_or_spec, donate_state: bool = True) -> None:
+    """One-call DDP wiring shared by the off-policy agent families.
+
+    The agent contract: ``args.batch_size``, ``state``, and a raw
+    ``_learn_raw(state, batch) -> (state, metrics, td_abs)`` pure update
+    (DQN/SAC/TD3 all match).  Shards the replay batch dim over ``dp×fsdp``,
+    big params over ``fsdp/tp`` where divisible, lets GSPMD all-reduce
+    gradients over ICI, and returns the per-sample |TD| replicated for PER
+    feedback.  Sets ``agent.mesh`` / ``agent._learn_mesh`` /
+    ``agent._shard_batch`` and re-lays-out ``agent.state``; the agents'
+    ``learn`` dispatches through ``_learn_mesh`` when present.
+
+    ``donate_state=False`` keeps the pre-update state buffers alive — required
+    when actor threads read ``state.params`` concurrently (``ApexTrainer``).
+    """
+    from scalerl_tpu.parallel.mesh import resolve_mesh
+
+    mesh = resolve_mesh(mesh_or_spec)
+    n_batch_shards = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if agent.args.batch_size % n_batch_shards != 0:
+        raise ValueError(
+            f"batch_size ({agent.args.batch_size}) must divide by the "
+            f"mesh's dp*fsdp extent ({n_batch_shards}) to shard the "
+            "replay batch"
+        )
+    raw = agent._learn_raw
+
+    def two_out(state, batch):
+        # make_parallel_learn_fn expects (state, batch) -> (state, aux);
+        # fold the per-sample |TD| into the aux pytree
+        state, metrics, td_abs = raw(state, batch)
+        return state, (metrics, td_abs)
+
+    plearn = make_parallel_learn_fn(
+        two_out, mesh, agent.state,
+        batch_time_major=False,  # replay batches are [B, ...]
+        donate_state=donate_state,
+    )
+    agent.mesh = mesh
+    agent.state = plearn.shard_state(agent.state)
+    agent._shard_batch = plearn.shard_batch
+    agent._learn_mesh = plearn
+
+
 def make_parallel_act_fn(
     act_fn: Callable[..., Any],
     mesh,

@@ -104,6 +104,49 @@ def test_sac_learn_step_updates_all_parts():
     assert int(agent.state.step) == 1
 
 
+def test_sac_enable_mesh_matches_unsharded():
+    """DDP SAC: dp×fsdp-sharded learn == single-device learn at the same
+    global batch (every agent family is one call from DDP)."""
+    args = _args()
+    kw = dict(
+        obs_shape=(3,),
+        action_low=np.array([-2.0], np.float32),
+        action_high=np.array([2.0], np.float32),
+    )
+    plain = SACAgent(args, **kw)
+    meshed = SACAgent(args, **kw)
+    meshed.enable_mesh("dp=4,fsdp=2")
+    B = args.batch_size
+    batch = {
+        "obs": jax.random.normal(jax.random.PRNGKey(0), (B, 3)),
+        "next_obs": jax.random.normal(jax.random.PRNGKey(1), (B, 3)),
+        "action": jax.random.uniform(jax.random.PRNGKey(2), (B, 1), minval=-2, maxval=2),
+        "reward": jax.random.normal(jax.random.PRNGKey(3), (B,)),
+        "done": jnp.zeros((B,), bool),
+    }
+    m_plain = plain.learn(dict(batch))
+    m_mesh = meshed.learn(dict(batch))
+    assert abs(m_plain["loss"] - m_mesh["loss"]) < 1e-4
+    np.testing.assert_allclose(
+        np.asarray(m_plain["td_abs"]), np.asarray(m_mesh["td_abs"]),
+        rtol=1e-4, atol=1e-5,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.state.actor_params),
+        jax.tree_util.tree_leaves(meshed.state.actor_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(plain.state.critic_params),
+        jax.tree_util.tree_leaves(meshed.state.critic_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    # divisibility enforced up front
+    bad = SACAgent(_args(batch_size=30), **kw)
+    with pytest.raises(ValueError):
+        bad.enable_mesh("dp=4,fsdp=2")
+
+
 def test_sac_actions_respect_bounds():
     args = _args()
     agent = SACAgent(

@@ -50,6 +50,34 @@ def _batch(B=32):
     }
 
 
+def test_td3_enable_mesh_matches_unsharded():
+    """DDP TD3: dp×fsdp-sharded learn == single-device learn at the same
+    global batch, including the masked delayed-actor update."""
+    import pytest
+
+    plain = _agent(_args())
+    meshed = _agent(_args())
+    meshed.enable_mesh("dp=4,fsdp=2")
+    batch = _batch()
+    for _ in range(2):  # covers a delayed-actor step (policy_delay=2 default)
+        m_plain = plain.learn(dict(batch))
+        m_mesh = meshed.learn(dict(batch))
+    assert abs(m_plain["loss"] - m_mesh["loss"]) < 1e-4
+    np.testing.assert_allclose(
+        np.asarray(m_plain["td_abs"]), np.asarray(m_mesh["td_abs"]),
+        rtol=1e-4, atol=1e-5,
+    )
+    for name in ("actor_params", "critic_params", "target_actor_params"):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(getattr(plain.state, name)),
+            jax.tree_util.tree_leaves(getattr(meshed.state, name)),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+    bad = _agent(_args(batch_size=30))
+    with pytest.raises(ValueError):
+        bad.enable_mesh("dp=4,fsdp=2")
+
+
 def test_td3_delayed_actor_update():
     """With policy_delay=2 the actor (and both targets) move only on even
     steps; the critics move every step; optimizer counters stay integer."""
