@@ -28,7 +28,7 @@ import numpy as np
 import optax
 from flax import struct
 
-from scalerl_tpu.agents.base import BaseAgent
+from scalerl_tpu.agents.base import BaseAgent, RecurrentEvalState
 from scalerl_tpu.config import R2D2Arguments
 from scalerl_tpu.models.recurrent_q import RecurrentQNet
 
@@ -119,9 +119,21 @@ def n_step_double_q_targets(
     return td, qa
 
 
-def make_r2d2_learn_fn(model: RecurrentQNet, optimizer, args: R2D2Arguments):
+def make_r2d2_learn_fn(
+    model: RecurrentQNet, optimizer, args: R2D2Arguments,
+    grad_axis: Optional[str] = None,
+):
     """Pure (state, fields[B,T1,...], core, is_weights) ->
-    (state, metrics, new_priorities)."""
+    (state, metrics, new_priorities).
+
+    ``grad_axis``: when the step runs INSIDE ``shard_map`` with the sequence
+    batch sharded over a mesh axis (the fused multi-device R2D2 loop,
+    ``trainer/r2d2_device.py``), gradients ``psum`` over that axis before
+    the optimizer update — same contract as ``make_impala_learn_fn``:
+    sum-convention losses psum, ``mean_*`` metrics pmean, so dp=N at global
+    batch B matches a single device at batch B.  ``new_priorities`` stay
+    LOCAL (each shard scatters into its own replay block).
+    """
     b = args.burn_in
 
     def unroll(params, obs, action, reward, done, core):
@@ -180,6 +192,14 @@ def make_r2d2_learn_fn(model: RecurrentQNet, optimizer, args: R2D2Arguments):
         (loss, (metrics, new_prio)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.target_params, fields, core, weights)
+        if grad_axis is not None:
+            grads = jax.lax.psum(grads, grad_axis)
+            metrics = {
+                k: jax.lax.pmean(v, grad_axis)
+                if k.startswith("mean_")
+                else jax.lax.psum(v, grad_axis)
+                for k, v in metrics.items()
+            }
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         step = state.step + 1
@@ -268,6 +288,7 @@ class R2D2Agent(BaseAgent):
         self._learn_raw = make_r2d2_learn_fn(self.model, self.optimizer, args)
         self._learn = jax.jit(self._learn_raw)
         self._act = jax.jit(self._act_impl)
+        self._eval_state = RecurrentEvalState(self.model.initial_state)
         self.mesh = None
         self._learn_mesh = None
 
@@ -299,16 +320,14 @@ class R2D2Agent(BaseAgent):
     def initial_state(self, batch_size: int):
         return self.model.initial_state(batch_size)
 
-    def get_action(self, obs: np.ndarray) -> np.ndarray:
+    def get_action(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Eps-greedy actions with a persistent LSTM carry: the core
+        survives across calls, rows resetting where ``done`` (the previous
+        step's ``term | trunc``) is True."""
         B = obs.shape[0]
-        view = self._default_view()
-        a, _q, view_core = view.act(
-            obs,
-            np.zeros(B, np.int32),
-            np.zeros(B, np.float32),
-            np.ones(B, bool),
-            self.model.initial_state(B),
-        )
+        core, prev_a, prev_r, done_in = self._eval_state.step_inputs("explore", B, done)
+        a, _q, new_core = self._default_view().act(obs, prev_a, prev_r, done_in, core)
+        self._eval_state.update("explore", a, new_core)
         return np.asarray(a)
 
     def _default_view(self) -> _EpsGreedyActorView:
@@ -316,14 +335,16 @@ class R2D2Agent(BaseAgent):
             self._dview = self.actor_view(0)
         return self._dview
 
-    def predict(self, obs: np.ndarray) -> np.ndarray:
+    def predict(self, obs: np.ndarray, *, done: np.ndarray | None = None) -> np.ndarray:
+        """Greedy actions, same persistent-core contract as get_action."""
         B = obs.shape[0]
-        _a, q, _c = self._act(
-            self.state.params, obs, np.zeros(B, np.int32),
-            np.zeros(B, np.float32), np.ones(B, bool),
-            self.model.initial_state(B), 0.0, jax.random.PRNGKey(0),
+        core, prev_a, prev_r, done_in = self._eval_state.step_inputs("greedy", B, done)
+        a, _q, new_core = self._act(
+            self.state.params, obs, prev_a, prev_r, done_in,
+            core, 0.0, jax.random.PRNGKey(0),
         )
-        return np.asarray(jnp.argmax(q, axis=-1))
+        self._eval_state.update("greedy", a, new_core)
+        return np.asarray(a)
 
     # -- learning ------------------------------------------------------
     def enable_mesh(self, mesh_or_spec) -> None:
@@ -389,3 +410,5 @@ class R2D2Agent(BaseAgent):
 
     def set_weights(self, weights) -> None:
         self.state = self.state.replace(params=weights)
+        # a carried eval core was produced by the OLD weights; drop it
+        self._eval_state.reset()

@@ -235,7 +235,7 @@ class ApexTrainer(BaseTrainer):
         # buffer row width is one slab, so capacity (in transitions) converts
         # to rows.  n_step=1: windows never span interleaved actor slabs.
         slab_width = (args.rollout_length - args.n_steps + 1) * self.envs_per_actor
-        self.buffer = PrioritizedReplayBuffer(
+        buffer_kw = dict(
             obs_shape=obs_space.shape,
             capacity=max(args.buffer_size // slab_width, 2),
             num_envs=slab_width,
@@ -244,6 +244,27 @@ class ApexTrainer(BaseTrainer):
             gamma=args.gamma,
             extra_fields={"n_steps": ((), jnp.int32)},
         )
+        mesh = getattr(agent, "mesh", None)
+        if mesh is not None:
+            # pod-scale Ape-X (the BASELINE "replay sharded across TPU HBM"
+            # row): the PER planes shard over the learner's dp/fsdp axes and
+            # the per-shard stratified sample lands already laid out for the
+            # mesh learn step — agent._shard_batch's device_put is a no-op
+            from scalerl_tpu.data.sharded_replay import ShardedPrioritizedReplay
+
+            if getattr(agent, "_donate_state", False):
+                # the mesh learn step donates the train state by default,
+                # but actor threads read state.params concurrently (the
+                # same hazard the no-donation re-jit of agent._learn below
+                # guards) — rebuild the pjit'd learner without donation
+                from scalerl_tpu.parallel import enable_offpolicy_mesh
+
+                agent._donate_state = False
+                enable_offpolicy_mesh(agent, mesh, donate_state=False)
+
+            self.buffer = ShardedPrioritizedReplay(mesh=mesh, **buffer_kw)
+        else:
+            self.buffer = PrioritizedReplayBuffer(**buffer_kw)
         self._priority = jax.jit(
             make_dqn_priority_fn(agent.network, args.gamma, args.double_dqn)
         )
@@ -320,11 +341,13 @@ class ApexTrainer(BaseTrainer):
         obs, _ = envs.reset(seed=self.args.seed + 100)
         returns: list = []
         ep_ret = np.zeros(num_envs)
+        prev_done = np.ones(num_envs, bool)
         while len(returns) < n_episodes:
-            actions = self.agent.predict(obs)
+            actions = self.agent.predict(obs, done=prev_done)
             obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
             ep_ret += reward
             done = np.logical_or(term, trunc)
+            prev_done = done
             for i in np.nonzero(done)[0]:
                 returns.append(ep_ret[i])
                 ep_ret[i] = 0.0

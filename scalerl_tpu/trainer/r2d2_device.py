@@ -59,19 +59,45 @@ class DeviceR2D2Trainer(BaseTrainer):
         venv,
         run_name: Optional[str] = None,
         fused: bool = True,
+        mesh=None,
+        axis_name: str = "dp",
     ) -> None:
         """``fused``: run each iteration (collect + insert + all learn
         steps + priority write-back) as ONE jitted dispatch — the TPU-fast
         default.  ``False`` keeps the piecewise path (one dispatch per
-        stage), useful for debugging stage boundaries."""
+        stage), useful for debugging stage boundaries.
+
+        ``mesh``: run the FUSED iteration data-parallel over a device mesh
+        (the Anakin treatment ``runtime/device_loop.py`` gives IMPALA): env
+        lanes, collector carry, and the sequence-replay ring all shard over
+        ``axis_name`` — each shard keeps an independent local ring fed by
+        its own lanes (zero insert comms) — while the learn step psums
+        gradients so params stay replicated.  Sampling draws
+        ``batch_size/S`` per shard with globally-normalized IS weights
+        (``data/sharded_replay.seq_sample_sharded_local``).  Requires
+        ``fused=True`` and a plain (non-``enable_mesh``) agent: the mesh
+        treatment here subsumes the agent-side DDP form.
+        """
         super().__init__(args, run_name=run_name)
-        if fused and getattr(agent, "_learn_mesh", None) is not None:
-            raise ValueError(
-                "fused=True runs the raw single-device learn fn and would "
-                "silently bypass agent.enable_mesh's sharded learner; pass "
-                "fused=False to combine DeviceR2D2Trainer with a DDP agent"
-            )
+        if getattr(agent, "_learn_mesh", None) is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "pass EITHER DeviceR2D2Trainer(mesh=...) (fused sharded "
+                    "loop, replay included) OR agent.enable_mesh (DDP learn "
+                    "step only, piecewise loop) — not both"
+                )
+            if fused:
+                raise ValueError(
+                    "fused=True runs the raw single-device learn fn and would "
+                    "silently bypass agent.enable_mesh's sharded learner; use "
+                    "DeviceR2D2Trainer(mesh=...) for the fused sharded loop, "
+                    "or fused=False for the piecewise DDP combination"
+                )
+        if mesh is not None and not fused:
+            raise ValueError("mesh= requires fused=True (the sharded fused loop)")
         self.fused = fused
+        self.mesh = mesh
+        self.axis_name = axis_name
         self.agent = agent
         self.venv = venv
         B = venv.num_envs
@@ -88,14 +114,36 @@ class DeviceR2D2Trainer(BaseTrainer):
         core_shapes = tuple(tuple(c.shape[1:]) for c, _ in core)
         self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
         self._collect = jax.jit(self._collect_impl, donate_argnums=(1,))
-        # fused iteration: collect + insert + train_intensity x
-        # (sample + learn + priority write-back) as ONE program — one host
-        # dispatch per iteration instead of ~3 + train_intensity (each
-        # dispatch costs ~50-100 ms under the axon tunnel)
-        self._fused_iter = jax.jit(self._fused_iter_impl, donate_argnums=(0, 1, 2))
-        self._collect_insert = jax.jit(
-            self._collect_insert_impl, donate_argnums=(1, 2)
-        )
+        if mesh is None:
+            # fused iteration: collect + insert + train_intensity x
+            # (sample + learn + priority write-back) as ONE program — one
+            # host dispatch per iteration instead of ~3 + train_intensity
+            # (each dispatch costs ~50-100 ms under the axon tunnel)
+            self._fused_iter = jax.jit(
+                self._fused_iter_impl, donate_argnums=(0, 1, 2)
+            )
+            self._collect_insert = jax.jit(
+                self._collect_insert_impl, donate_argnums=(1, 2)
+            )
+        else:
+            n = mesh.shape[axis_name]
+            for what, val in (
+                ("venv.num_envs", B),
+                ("replay_capacity", args.replay_capacity),
+                ("batch_size", args.batch_size),
+            ):
+                if val % n != 0:
+                    raise ValueError(
+                        f"{what} ({val}) must divide by mesh axis "
+                        f"{axis_name!r} size ({n}) for the fused sharded loop"
+                    )
+            from scalerl_tpu.agents.r2d2 import make_r2d2_learn_fn
+
+            self._learn_shard = make_r2d2_learn_fn(
+                agent.model, agent.optimizer, args, grad_axis=axis_name
+            )
+            self._fused_iter = None  # built lazily (needs pytree structure)
+            self._collect_insert = None
         self._max_priority = 1.0
         self.env_frames = 0
 
@@ -212,14 +260,109 @@ class DeviceR2D2Trainer(BaseTrainer):
         return agent_state, replay, carry, max_prio, metrics
 
     # ------------------------------------------------------------------
-    def _eps(self, frames: int) -> float:
-        """Linear decay 1.0 -> eps_base over the first warmup*4 sequences'
-        worth of frames, then constant eps_base (single-stream schedule;
-        the actor-ladder eps_alpha applies to the host plane's many
-        actors, not this one synchronized batch)."""
-        horizon = max(
-            self.args.warmup_sequences * 4 * (self.args.rollout_length + 1), 1
+    # mesh-fused path: per-shard bodies + lazy shard_map builder
+
+    def _fused_iter_local(self, agent_state, replay, carry, max_prio, eps, key):
+        """Per-shard body of the mesh-fused iteration (inside shard_map).
+
+        ``replay`` is this shard's INDEPENDENT local ring (capacity/S
+        slots) fed by its own env lanes — inserts need no communication;
+        the learn step psums gradients over ``axis_name`` so the replicated
+        ``agent_state`` stays bit-identical across shards."""
+        from scalerl_tpu.data.sharded_replay import seq_sample_sharded_local
+
+        args = self.args
+        axis = self.axis_name
+        n = self.mesh.shape[axis]
+        shard = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(key, shard)
+        k_c, key = jax.random.split(key)
+        carry, fields, entry_core = self._collect_impl(
+            agent_state.params, carry, eps, k_c
         )
+        B_l = fields["action"].shape[0]
+        replay = seq_add(
+            replay, fields, entry_core, jnp.full((B_l,), max_prio, jnp.float32)
+        )
+        local_cap = replay.priorities.shape[0]
+        gsize = jax.lax.psum(replay.size, axis)
+        metrics = {}
+        for _ in range(args.train_intensity):  # static, small
+            key, k_s = jax.random.split(key)
+            f, c, idx, w = seq_sample_sharded_local(
+                replay, k_s, args.batch_size // n,
+                axes=(axis,), n_shards=n, local_capacity=local_cap,
+                alpha=args.per_alpha, beta=args.per_beta, global_size=gsize,
+            )
+            agent_state, metrics, new_prio = self._learn_shard(
+                agent_state, f, c, w
+            )
+            replay = seq_update_priorities(
+                replay, idx - shard * local_cap, new_prio
+            )
+            max_prio = jnp.maximum(
+                max_prio, jax.lax.pmax(jnp.max(new_prio), axis)
+            )
+        return agent_state, replay, carry, max_prio, metrics
+
+    def _collect_insert_local(self, params, replay, carry, max_prio, eps, key):
+        """Per-shard warmup body: collect a chunk, insert into the local ring."""
+        key = jax.random.fold_in(key, jax.lax.axis_index(self.axis_name))
+        carry, fields, entry_core = self._collect_impl(params, carry, eps, key)
+        B_l = fields["action"].shape[0]
+        replay = seq_add(
+            replay, fields, entry_core, jnp.full((B_l,), max_prio, jnp.float32)
+        )
+        return replay, carry
+
+    def _build_sharded_fns(self, carry) -> None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis_name
+
+        def leaf_spec(x):
+            if getattr(x, "ndim", 0) >= 1:
+                return P(axis, *([None] * (x.ndim - 1)))
+            return P()  # replay cursors (pos/size) replicate
+
+        replay_spec = jax.tree_util.tree_map(leaf_spec, self.replay)
+        carry_spec = jax.tree_util.tree_map(leaf_spec, carry)
+        # agent state / params / scalars / metrics: replicated (P() prefix)
+        self._fused_iter = jax.jit(
+            shard_map(
+                self._fused_iter_local,
+                mesh=self.mesh,
+                in_specs=(P(), replay_spec, carry_spec, P(), P(), P()),
+                out_specs=(P(), replay_spec, carry_spec, P(), P()),
+                check_rep=False,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._collect_insert = jax.jit(
+            shard_map(
+                self._collect_insert_local,
+                mesh=self.mesh,
+                in_specs=(P(), replay_spec, carry_spec, P(), P(), P()),
+                out_specs=(replay_spec, carry_spec),
+                check_rep=False,
+            ),
+            donate_argnums=(1, 2),
+        )
+
+    # ------------------------------------------------------------------
+    def _eps(self, frames: int) -> float:
+        """Linear decay 1.0 -> eps_base over the first 4x``warmup_sequences``
+        INSERTED sequences, then constant eps_base (single-stream schedule;
+        the actor-ladder eps_alpha applies to the host plane's many
+        actors, not this one synchronized batch).
+
+        Expressed in the same unit ``frames`` accrues in: each chunk adds
+        ``rollout_length * num_envs`` frames and ``num_envs`` sequences, so
+        one inserted sequence == ``rollout_length`` accrued frames and the
+        horizon is exact for any ``num_envs`` (advisor r3).
+        """
+        horizon = max(self.args.warmup_sequences * 4 * self.args.rollout_length, 1)
         frac = min(frames / horizon, 1.0)
         return 1.0 + (self.args.eps_base - 1.0) * frac
 
@@ -231,6 +374,8 @@ class DeviceR2D2Trainer(BaseTrainer):
         key = jax.random.PRNGKey(args.seed)
         key, k_init = jax.random.split(key)
         carry = self.init_carry(k_init)
+        if self.mesh is not None and self._fused_iter is None:
+            self._build_sharded_fns(carry)
         inserted = 0
         metrics: Dict = {}
         start = time.time()

@@ -185,6 +185,33 @@ def test_r2d2_agent_learn_step_and_target_sync():
     assert int(agent.state.step) == 2
 
 
+def test_r2d2_eval_api_keeps_recurrent_state():
+    """predict/get_action carry the LSTM core across calls (advisor r3:
+    the generic eval API was memoryless), and done=ones restores the
+    fresh-episode behavior exactly."""
+    agent = R2D2Agent(_args(), obs_shape=(4,), num_actions=2)
+    obs = np.full((3, 4), 0.5, np.float32)
+    a1 = agent.predict(obs)  # fresh slot: full reset
+    agent.predict(obs)
+    agent.predict(obs)
+    st = agent._eval_state._modes["greedy"]
+    fresh = agent.initial_state(3)
+    carried = any(
+        not np.array_equal(np.asarray(c), np.asarray(f))
+        for (c, _), (f, _) in zip(st["core"], fresh)
+    ) or any(
+        not np.array_equal(np.asarray(h), np.asarray(fh))
+        for (_, h), (_, fh) in zip(st["core"], fresh)
+    )
+    assert carried, "eval core never left the initial state"
+    # an all-done step == a fresh episode: deterministic greedy must repeat a1
+    a_reset = agent.predict(obs, done=np.ones(3, bool))
+    np.testing.assert_array_equal(np.asarray(a_reset), np.asarray(a1))
+    # explore and greedy modes hold separate slots
+    agent.get_action(obs)
+    assert set(agent._eval_state._modes) == {"greedy", "explore"}
+
+
 def test_r2d2_enable_mesh_matches_unsharded():
     """DDP R2D2: the dp/fsdp-sharded learn step is numerically identical to
     the single-device update at the same global sequence batch, and the
@@ -281,6 +308,47 @@ def test_device_r2d2_trainer_smoke(tmp_path, fused):
     assert result["learn_steps"] > 0
     assert np.isfinite(result["total_loss"])
     trainer.close()
+
+
+def test_device_r2d2_fused_mesh(tmp_path):
+    """The fused iteration sharded over dp=8: per-shard local replay
+    rings, psum'd gradients (params stay replicated), pod-shape R2D2 in
+    one dispatch per iteration (VERDICT r3 #6: fused x mesh)."""
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.envs.jax_envs.recall import JaxRecall
+    from scalerl_tpu.parallel import make_mesh
+    from scalerl_tpu.trainer.r2d2_device import DeviceR2D2Trainer
+
+    args = _args(
+        env_id="JaxRecall", rollout_length=8, burn_in=2, n_steps=1,
+        batch_size=16, replay_capacity=64, warmup_sequences=16,
+        hidden_size=32, work_dir=str(tmp_path),
+    )
+    env = JaxRecall(size=8, delay=2, num_cues=2)
+    venv = JaxVecEnv(env, num_envs=16)
+    agent = R2D2Agent(args, obs_shape=env.observation_shape, num_actions=2,
+                      obs_dtype=np.uint8)
+    mesh = make_mesh("dp=8")
+    trainer = DeviceR2D2Trainer(args, agent, venv, mesh=mesh)
+    result = trainer.train(total_frames=2048)
+    assert result["env_frames"] >= 2048
+    assert result["learn_steps"] > 0
+    assert np.isfinite(result["total_loss"])
+    # params must be replicated (all shards identical after psum'd grads)
+    leaf = jax.tree_util.tree_leaves(trainer.agent.state.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    # each shard's ring actually received sequences
+    prios = np.asarray(trainer.replay.priorities).reshape(8, -1)
+    assert (prios.max(axis=1) > 0).all()
+    trainer.close()
+
+    # combination rules: mesh= forbids an enable_mesh'd agent and fused=False
+    agent2 = R2D2Agent(args, obs_shape=env.observation_shape, num_actions=2,
+                       obs_dtype=np.uint8)
+    with pytest.raises(ValueError):
+        DeviceR2D2Trainer(args, agent2, venv, mesh=mesh, fused=False)
 
 
 @pytest.mark.slow

@@ -1,0 +1,107 @@
+"""TPU tunnel watcher (round 4).
+
+The axon TPU tunnel is intermittent (rounds 1-3: it answered once in round
+1, then hung ``jax.devices()`` for entire driver windows). This watcher
+probes the backend once a minute and writes every attempt - timestamp,
+outcome, latency - to the committed probe log ``TPU_PROBELOG.md`` so the
+round artifact proves the tunnel was down rather than asserts it
+(VERDICT r3, next-round item #1a).
+
+On first contact it runs, in order (VERDICT r3 #1b):
+  1. ``bench.py`` (bf16 headline + MFU; appends TPU successes to
+     ``BENCH_TPU.md`` itself),
+  2. ``bench.py --mesh dp=8`` if the tunnel exposes >1 chip (aggregate
+     north-star shape),
+  3. ``pytest tests_tpu`` (compiled Pallas-kernel legality),
+  4. ``examples/profile_fused_loop.py`` (idle fraction),
+then commits the artifacts immediately.
+
+Run: ``nohup python tools/tpu_watch.py >/tmp/tpu_watch_r4.out 2>&1 &``
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBELOG = os.path.join(REPO, "TPU_PROBELOG.md")
+PAYLOG = "/tmp/tpu_autobench_r4.log"
+
+PROBE = (
+    "import jax; print('backend:', jax.default_backend());"
+    " print('kind:', jax.devices()[0].device_kind);"
+    " print('n:', jax.device_count())"
+)
+
+
+def log_probe(line: str) -> None:
+    with open(PROBELOG, "a", buffering=1) as f:
+        f.write(line + "\n")
+
+
+def ensure_header() -> None:
+    if not os.path.exists(PROBELOG) or os.path.getsize(PROBELOG) == 0:
+        with open(PROBELOG, "w") as f:
+            f.write(
+                "# TPU tunnel probe log (round 4)\n\n"
+                "One line per probe attempt by `tools/tpu_watch.py`: UTC time, "
+                "outcome, latency. A `backend: tpu` line means contact; the "
+                "watcher then runs the full bench payload and commits. "
+                "Timeout lines are the committed evidence that the axon "
+                "tunnel was down during this round (VERDICT r3 item #1).\n\n"
+                "```\n"
+            )
+
+
+def run_payload() -> None:
+    env = dict(os.environ, BENCH_BUDGET_S="900")
+    steps = [
+        ("bench", [sys.executable, "bench.py"], 1500),
+        ("bench-mesh", [sys.executable, "bench.py", "--mesh", "dp=8"], 1500),
+        ("tests_tpu", [sys.executable, "-m", "pytest", "tests_tpu", "-q"], 1800),
+        ("profile", [sys.executable, "examples/profile_fused_loop.py"], 1200),
+    ]
+    with open(PAYLOG, "a", buffering=1) as bl:
+        for name, cmd, tmo in steps:
+            bl.write(f"=== {name} {time.strftime('%H:%M:%S')} ===\n")
+            try:
+                subprocess.run(cmd, env=env, stdout=bl, stderr=bl, timeout=tmo, cwd=REPO)
+            except Exception as e:  # noqa: BLE001 - watcher must survive anything
+                bl.write(f"[watcher] {name} failed: {e}\n")
+    log_probe(f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done (see BENCH_TPU.md)")
+    try:
+        subprocess.run(["git", "add", "BENCH_TPU.md", "TPU_PROBELOG.md"], cwd=REPO)
+        subprocess.run(
+            ["git", "commit", "-m", "Record witnessed TPU bench artifacts"], cwd=REPO
+        )
+    except Exception as e:  # noqa: BLE001
+        log_probe(f"[watcher] auto-commit failed: {e}")
+
+
+def main() -> None:
+    ensure_header()
+    ran_payload = False
+    while True:
+        t0 = time.time()
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c", PROBE], timeout=300, capture_output=True, text=True
+            )
+            dt = time.time() - t0
+            out = (p.stdout or "").strip().replace("\n", " | ")
+            log_probe(f"{stamp} rc={p.returncode} dt={dt:.0f}s [{out}]")
+            if "backend: tpu" in out and not ran_payload:
+                ran_payload = True
+                log_probe(f"{stamp} TPU CONTACT - running payload")
+                run_payload()
+        except subprocess.TimeoutExpired:
+            log_probe(f"{stamp} TIMEOUT after {time.time() - t0:.0f}s")
+        except Exception as e:  # noqa: BLE001
+            log_probe(f"{stamp} watcher error: {e}")
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()
