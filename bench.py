@@ -89,12 +89,18 @@ def _cost_analysis_flops(compiled) -> float | None:
     return float(flops)
 
 
-def _run_measurement() -> None:
+def _run_measurement(mesh_spec: str | None = None) -> None:
     """Child mode: probe + measure in one process.
 
     Prints ``backend: X`` the moment the backend answers (the parent's
     probe deadline watches for this line), then runs the measurement and
     prints the JSON line.
+
+    ``mesh_spec`` (e.g. ``"dp=8"``): run the fused loop data-parallel over
+    a device mesh (the Anakin dp scaling the 8-device dryrun validates) and
+    report AGGREGATE env-frames/sec plus per-chip — the north-star-shaped
+    number for the day multi-chip hardware answers (BASELINE v5e-16 row).
+    Per-chip batch is held constant, so this measures weak scaling.
     """
     import jax
     import jax.numpy as jnp  # noqa: F401
@@ -117,9 +123,26 @@ def _run_measurement() -> None:
     # by ~21% — bigger batches keep the MXU busy between infeed boundaries);
     # CPU fallback shrinks to stay quick
     on_accel = platform in ("tpu", "gpu")
-    B = 512 if on_accel else 8
+    mesh = None
+    n_dev = 1
+    if mesh_spec:
+        from scalerl_tpu.parallel import make_mesh
+
+        mesh = make_mesh(mesh_spec)
+        n_dev = mesh.devices.size
+        if mesh.shape["dp"] != n_dev:
+            raise ValueError(
+                f"--mesh {mesh_spec!r}: the fused loop shards env lanes over "
+                "dp only; use a pure-dp spec (dp=N)"
+            )
+    # CPU-fallback mesh runs exist to prove the code path, not to measure
+    # (8 virtual devices on one core): shrink so they finish in the
+    # parent's give-up window
+    B_chip = 512 if on_accel else (8 if mesh is None else 4)
+    B = B_chip * (n_dev if mesh is not None else 1)
     T = 20
     iters_per_call = 5 if on_accel else 1
+    min_iters = 3 if (on_accel or mesh is None) else 1
 
     args = ImpalaArguments(
         use_lstm=False,
@@ -136,13 +159,14 @@ def _run_measurement() -> None:
     env = SyntheticPixelEnv()
     venv = JaxVecEnv(env, num_envs=B)
     agent = ImpalaAgent(args, obs_shape=env.observation_shape, num_actions=env.num_actions)
-    learn = agent.make_learn_fn()
+    learn = agent.make_learn_fn(grad_axis="dp" if mesh is not None else None)
     loop = DeviceActorLearnerLoop(
         model=agent.model,
         venv=venv,
         learn_fn=learn,
         unroll_length=T,
         iters_per_call=iters_per_call,
+        mesh=mesh,
     )
 
     key = jax.random.PRNGKey(0)
@@ -156,12 +180,17 @@ def _run_measurement() -> None:
     # second compile of an identical program eating the attempt window.
     flops_per_call = None
     run_fn = loop._train_many
-    try:
-        compiled = loop._train_many.lower(state, carry, jax.random.PRNGKey(1)).compile()
-        flops_per_call = _cost_analysis_flops(compiled)
-        run_fn = compiled
-    except Exception:  # noqa: BLE001 — fall back to the jit path, no MFU
-        pass
+    if mesh is None:
+        try:
+            compiled = loop._train_many.lower(
+                state, carry, jax.random.PRNGKey(1)
+            ).compile()
+            flops_per_call = _cost_analysis_flops(compiled)
+            run_fn = compiled
+        except Exception:  # noqa: BLE001 — fall back to the jit path, no MFU
+            pass
+    # mesh mode: _train_many builds its shard_map program lazily on first
+    # call; MFU comes from the single-chip bench, this mode measures scaling
 
     # warmup: one full call.  Synchronize by *fetching a scalar*: under the
     # axon tunnel block_until_ready can return before the program finishes,
@@ -179,11 +208,28 @@ def _run_measurement() -> None:
         i += 1
         frames += frames_per_call
         float(metrics["total_loss"])
-        if time.perf_counter() - t0 >= target_s and i >= 3:
+        if time.perf_counter() - t0 >= target_s and i >= min_iters:
             break
     elapsed = time.perf_counter() - t0
 
     fps = frames / elapsed
+    if mesh is not None:
+        # aggregate number, shaped like the BASELINE north star (>=100k
+        # aggregate env-frames/sec on a v5e-16)
+        result = {
+            "metric": "impala_atari_env_frames_per_sec_aggregate",
+            "value": round(fps, 1),
+            "unit": f"frames/sec aggregate ({platform} x{n_dev})",
+            "vs_baseline": round(fps / 100_000, 3),
+            "per_chip": round(fps / n_dev, 1),
+            "mesh": mesh_spec,
+            "device_kind": device_kind,
+            "batch": B,
+            "unroll": T,
+            "measured_s": round(elapsed, 1),
+        }
+        print(json.dumps(result))
+        return
     result = {
         "metric": "impala_atari_env_frames_per_sec_per_chip",
         "value": round(fps, 1),
@@ -204,18 +250,30 @@ def _run_measurement() -> None:
     print(json.dumps(result))
 
 
+def _mesh_device_total(mesh_spec: str) -> int:
+    import re as _re
+
+    total = 1
+    for n in _re.findall(r"\d+", mesh_spec):
+        total *= int(n)
+    return max(total, 1)
+
+
 class _Child:
     """A supervised measurement subprocess with line-buffered stdout."""
 
-    def __init__(self, cpu: bool) -> None:
+    def __init__(self, cpu: bool, mesh_spec: str | None = None) -> None:
         env = dict(os.environ)
         cmd = [sys.executable, str(Path(__file__).resolve()), "--run"]
+        if mesh_spec:
+            cmd += ["--mesh", mesh_spec]
         if cpu:
             env["JAX_PLATFORMS"] = "cpu"
             flags = env.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
+                n = _mesh_device_total(mesh_spec) if mesh_spec else 1
                 env["XLA_FLAGS"] = (
-                    flags + " --xla_force_host_platform_device_count=1"
+                    flags + f" --xla_force_host_platform_device_count={n}"
                 ).strip()
             cmd.append("--cpu")
         self.proc = subprocess.Popen(
@@ -302,13 +360,13 @@ def _log_tpu_success(line: str) -> None:
         pass
 
 
-def main() -> None:
+def main(mesh_spec: str | None = None) -> None:
     deadline = time.monotonic() + BUDGET_S
     errors: list[str] = []
 
     # CPU fallback starts now, in parallel — pinned to cpu so it never
     # touches the tunnel; result is banked for the give-up path.
-    cpu_child = _Child(cpu=True)
+    cpu_child = _Child(cpu=True, mesh_spec=mesh_spec)
 
     # If the DRIVER's own timeout kills this process before the budget
     # elapses, still emit the one promised JSON line: print whatever the
@@ -362,7 +420,7 @@ def main() -> None:
         probe_s = PROBE_SCHEDULE_S[min(probe_idx, len(PROBE_SCHEDULE_S) - 1)]
         probe_idx += 1
         probe_s = min(probe_s, max(deadline - time.monotonic() - 10, 15))
-        child = _Child(cpu=False)
+        child = _Child(cpu=False, mesh_spec=mesh_spec)
         live_children.append(child)
         backend_line = child.wait_for(lambda l: l.startswith("backend:"), probe_s)
         if backend_line is None:
@@ -429,6 +487,16 @@ def main() -> None:
     )
 
 
+def _argv_mesh() -> str | None:
+    argv = sys.argv[1:]
+    if "--mesh" in argv:
+        i = argv.index("--mesh")
+        if i + 1 >= len(argv):
+            raise SystemExit("--mesh requires a spec argument, e.g. --mesh dp=8")
+        return argv[i + 1]
+    return None
+
+
 if __name__ == "__main__":
     if "--probe" in sys.argv[1:]:  # kept for manual tunnel checks
         import jax
@@ -440,7 +508,7 @@ if __name__ == "__main__":
 
             jax.config.update("jax_platforms", "cpu")
         try:
-            _run_measurement()
+            _run_measurement(_argv_mesh())
         except Exception:  # noqa: BLE001 — parent needs the traceback on stderr
             import traceback
 
@@ -448,7 +516,7 @@ if __name__ == "__main__":
             sys.exit(1)
     else:
         try:
-            main()
+            main(_argv_mesh())
         except Exception as e:  # noqa: BLE001 — must always print one JSON line
             print(
                 json.dumps(

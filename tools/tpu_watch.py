@@ -20,6 +20,7 @@ Run: ``nohup python tools/tpu_watch.py >/tmp/tpu_watch_r4.out 2>&1 &``
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
@@ -54,14 +55,22 @@ def ensure_header() -> None:
             )
 
 
-def run_payload() -> None:
+def run_payload(n_devices: int = 1) -> None:
     env = dict(os.environ, BENCH_BUDGET_S="900")
     steps = [
         ("bench", [sys.executable, "bench.py"], 1500),
-        ("bench-mesh", [sys.executable, "bench.py", "--mesh", "dp=8"], 1500),
         ("tests_tpu", [sys.executable, "-m", "pytest", "tests_tpu", "-q"], 1800),
         ("profile", [sys.executable, "examples/profile_fused_loop.py"], 1200),
     ]
+    if n_devices > 1:  # aggregate north-star shape, only when multi-chip
+        steps.insert(
+            1,
+            (
+                "bench-mesh",
+                [sys.executable, "bench.py", "--mesh", f"dp={n_devices}"],
+                1500,
+            ),
+        )
     with open(PAYLOG, "a", buffering=1) as bl:
         for name, cmd, tmo in steps:
             bl.write(f"=== {name} {time.strftime('%H:%M:%S')} ===\n")
@@ -95,7 +104,8 @@ def main() -> None:
             if "backend: tpu" in out and not ran_payload:
                 ran_payload = True
                 log_probe(f"{stamp} TPU CONTACT - running payload")
-                run_payload()
+                m = re.search(r"n: (\d+)", out)
+                run_payload(int(m.group(1)) if m else 1)
         except subprocess.TimeoutExpired:
             log_probe(f"{stamp} TIMEOUT after {time.time() - t0:.0f}s")
         except Exception as e:  # noqa: BLE001
