@@ -125,3 +125,22 @@ def seq_update_priorities(
     return state.replace(
         priorities=state.priorities.at[idx].set(jnp.maximum(priorities, 1e-6))
     )
+
+
+def seq_update_priorities_keep_empty(
+    state: SequenceReplayState, idx: jnp.ndarray, priorities: jnp.ndarray
+) -> SequenceReplayState:
+    """Priority write-back that cannot resurrect empty slots.
+
+    ``priorities == 0`` marks a never-written slot (the ``seq_init``
+    contract). Sharded sampling can draw such a slot before its ring block
+    fills and zero-weights it so the loss ignores it — but a plain
+    ``seq_update_priorities`` would then floor the slot's priority at 1e-6,
+    pulling the all-zeros garbage sequence INTO the distribution for every
+    later sample. Used by both the sharded replay class and the mesh-fused
+    R2D2 iteration (not jitted here: callers embed it in their own jit/
+    shard_map programs).
+    """
+    live = state.priorities[idx] > 0
+    eff = jnp.where(live, jnp.maximum(priorities, 1e-6), 0.0)
+    return state.replace(priorities=state.priorities.at[idx].set(eff))

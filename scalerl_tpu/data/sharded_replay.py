@@ -32,7 +32,6 @@ bias correction done exactly instead of ignored.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -54,6 +53,7 @@ from scalerl_tpu.data.sequence_replay import (
     SequenceReplayState,
     seq_add,
     seq_init,
+    seq_update_priorities_keep_empty,
 )
 from scalerl_tpu.ops.pallas_per import hierarchical_sample, proportional_sample
 
@@ -391,16 +391,9 @@ class ShardedSequenceReplay:
         self.state = jax.device_put(state, self._state_sh)
         # global programs over sharded state (see module docstring)
         self._add = jax.jit(seq_add, donate_argnums=0)
-
-        def update_keep_empty(st: SequenceReplayState, idx, prios):
-            # priorities==0 marks an empty slot (seq_init contract); a
-            # write-back for a zero-weight garbage draw from an unreached
-            # shard block must not resurrect the slot into the distribution
-            live = st.priorities[idx] > 0
-            prios = jnp.where(live, jnp.maximum(prios, 1e-6), 0.0)
-            return st.replace(priorities=st.priorities.at[idx].set(prios))
-
-        self._update = jax.jit(update_keep_empty, donate_argnums=0)
+        # keep-empty write-back: zero-weight garbage draws from unreached
+        # shard blocks must not resurrect empty slots into the distribution
+        self._update = jax.jit(seq_update_priorities_keep_empty, donate_argnums=0)
         self._sample_cache: Dict[int, Any] = {}
 
     def __len__(self) -> int:
@@ -433,9 +426,6 @@ class ShardedSequenceReplay:
                 axes=axes, n_shards=n_shards, local_capacity=local_capacity,
                 alpha=alpha, beta=beta,
             )
-
-        def out_spec(x):
-            return P(axes, *([None] * (max(getattr(x, "ndim", 1), 1) - 1)))
 
         # fields/core: [b_local, T1/dim, ...] -> sharded dim 0; idx/weights 1-D
         fields_spec = {

@@ -92,7 +92,21 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             "done": ((T1,), bool),
         }
         core_shapes = tuple(tuple(c.shape[1:]) for c, _ in core)
-        self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
+        if getattr(agent, "mesh", None) is not None:
+            # pod-scale sequence memory (BASELINE "replay sharded across TPU
+            # HBM"): the ring's capacity axis shards over the DDP agent's
+            # mesh, per-shard stratified sampling lands already laid out for
+            # the sharded learn step
+            from scalerl_tpu.data.sharded_replay import ShardedSequenceReplay
+
+            self._sharded_replay = ShardedSequenceReplay(
+                field_shapes, core_shapes, args.replay_capacity, agent.mesh,
+                alpha=args.per_alpha, beta=args.per_beta,
+            )
+            self.replay = None
+        else:
+            self._sharded_replay = None
+            self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
         self._max_priority = 1.0
         self._rng = jax.random.PRNGKey(args.seed + 13)
 
@@ -117,16 +131,26 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         self.queue.recycle(idxs)
         B = fields["action"].shape[0]
         prio = np.full(B, self._max_priority, np.float32)
-        self.replay = seq_add(self.replay, fields, core, jnp.asarray(prio))
+        if self._sharded_replay is not None:
+            self._sharded_replay.add(fields, core, prio)
+        else:
+            self.replay = seq_add(self.replay, fields, core, jnp.asarray(prio))
 
     def _learn_once(self) -> Dict[str, jnp.ndarray]:
         self._rng, sub = jax.random.split(self._rng)
-        fields, core, idx, weights = seq_sample(
-            self.replay, sub, self.args.batch_size,
-            alpha=self.args.per_alpha, beta=self.args.per_beta,
-        )
-        metrics, prio = self.agent.learn_sequences(fields, core, weights)
-        self.replay = seq_update_priorities(self.replay, idx, prio)
+        if self._sharded_replay is not None:
+            fields, core, idx, weights = self._sharded_replay.sample(
+                self.args.batch_size, key=sub
+            )
+            metrics, prio = self.agent.learn_sequences(fields, core, weights)
+            self._sharded_replay.update_priorities(idx, prio)
+        else:
+            fields, core, idx, weights = seq_sample(
+                self.replay, sub, self.args.batch_size,
+                alpha=self.args.per_alpha, beta=self.args.per_beta,
+            )
+            metrics, prio = self.agent.learn_sequences(fields, core, weights)
+            self.replay = seq_update_priorities(self.replay, idx, prio)
         self._max_priority = max(self._max_priority, float(jnp.max(prio)))
         return metrics
 

@@ -33,6 +33,7 @@ from scalerl_tpu.data.sequence_replay import (
     seq_init,
     seq_sample,
     seq_update_priorities,
+    seq_update_priorities_keep_empty,
 )
 from scalerl_tpu.trainer.base import BaseTrainer
 
@@ -297,7 +298,9 @@ class DeviceR2D2Trainer(BaseTrainer):
             agent_state, metrics, new_prio = self._learn_shard(
                 agent_state, f, c, w
             )
-            replay = seq_update_priorities(
+            # keep-empty form: a zero-weighted draw from a not-yet-filled
+            # slot must not enter the distribution via its |TD| write-back
+            replay = seq_update_priorities_keep_empty(
                 replay, idx - shard * local_cap, new_prio
             )
             max_prio = jnp.maximum(

@@ -387,6 +387,32 @@ def test_r2d2_memory_proof_delayed_recall():
     assert ff <= 0.3, ff
 
 
+def test_r2d2_trainer_sharded_replay(tmp_path):
+    """Host R2D2 with a DDP agent: the sequence ring shards over the
+    agent's mesh (capacity axis), per-shard sampling feeds the sharded
+    learn step, priorities write back at global slots."""
+    from scalerl_tpu.data.sharded_replay import ShardedSequenceReplay
+    from scalerl_tpu.trainer.r2d2 import R2D2Trainer
+
+    args = _args(work_dir=str(tmp_path), rollout_length=8, burn_in=2,
+                 n_steps=1, warmup_sequences=8, batch_size=8,
+                 replay_capacity=64)
+    agent = R2D2Agent(args, obs_shape=(4,), num_actions=2)
+    agent.enable_mesh("dp=8")
+    env_fns = [
+        lambda: make_vect_envs("CartPole-v1", num_envs=4, seed=0, async_envs=False)
+    ]
+    trainer = R2D2Trainer(args, agent, env_fns)
+    assert isinstance(trainer._sharded_replay, ShardedSequenceReplay)
+    result = trainer.train(total_frames=768)
+    assert result["env_frames"] >= 768
+    assert result["learn_steps"] > 0
+    assert np.isfinite(result["total_loss"])
+    prios = np.asarray(trainer._sharded_replay.state.priorities)
+    assert np.isfinite(prios).all() and prios.max() > 0
+    trainer.close()
+
+
 def test_r2d2_trainer_cartpole_smoke(tmp_path):
     args = _args(work_dir=str(tmp_path), rollout_length=8, burn_in=2,
                  n_steps=1, warmup_sequences=4, batch_size=4)
