@@ -1,0 +1,82 @@
+"""DQN curve: off-policy trainer on CartPole."""
+
+from __future__ import annotations
+
+import time
+
+from curves.common import OUT_DIR, _first_crossing
+
+
+def dqn_cartpole(
+    num_envs: int = 4,
+    max_frames: int = 300_000,
+    threshold: float = 450.0,
+    seed: int = 3,
+):
+    """Double+dueling+3-step DQN through the off-policy trainer; final
+    greedy eval over 10 episodes must beat the threshold (CartPole-v1
+    'solved' is 475).  Hard target updates every 500 learn steps: per-step
+    soft updates let the target chase the online net and CartPole DQN then
+    collapses from ~250 into a ~135 plateau (observed with tau=0.005)."""
+    from scalerl_tpu.agents import DQNAgent
+    from scalerl_tpu.config import DQNArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer import OffPolicyTrainer
+
+    args = DQNArguments(
+        env_id="CartPole-v1",
+        num_envs=num_envs,
+        buffer_size=50_000,
+        batch_size=128,
+        max_timesteps=max_frames,
+        warmup_learn_steps=1_000,
+        train_frequency=4,
+        learning_rate=5e-4,
+        double_dqn=True,
+        dueling_dqn=True,
+        n_steps=3,
+        use_soft_update=False,
+        target_update_frequency=500,
+        lr_scheduler="linear",
+        min_learning_rate=5e-5,
+        exploration_fraction=0.25,
+        eps_greedy_end=0.02,
+        eval_frequency=25_000,
+        eval_episodes=5,
+        logger_frequency=2_000,
+        save_frequency=10**9,
+        seed=seed,
+        work_dir=str(OUT_DIR),
+        project="",
+        logger_backend="tensorboard",
+        save_model=False,
+    )
+    args.validate()
+    train_envs = make_vect_envs(args.env_id, num_envs=num_envs, seed=seed, async_envs=False)
+    eval_envs = make_vect_envs(args.env_id, num_envs=4, seed=seed + 99, async_envs=False)
+    agent = DQNAgent(
+        args,
+        obs_shape=train_envs.single_observation_space.shape,
+        action_dim=train_envs.single_action_space.n,
+    )
+    trainer = OffPolicyTrainer(args, agent, train_envs, eval_envs, run_name="dqn_cartpole")
+    t0 = time.time()
+    trainer.run()
+    ev = trainer.run_evaluate_episodes(n_episodes=10)
+    wall = time.time() - t0
+    hit = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
+    trainer.close()
+    train_envs.close()
+    eval_envs.close()
+    return {
+        "experiment": "dqn_cartpole",
+        "env": "CartPole-v1",
+        "algo": "double+dueling 3-step DQN (off-policy trainer)",
+        "threshold": threshold,
+        "final_return": round(ev["reward_mean"], 2),
+        "frames": trainer.global_step,
+        "frames_to_threshold": hit,
+        "wall_s": round(wall, 1),
+        "fps": round(trainer.global_step / wall, 1),
+        "passed": ev["reward_mean"] >= threshold,
+    }

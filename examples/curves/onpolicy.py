@@ -1,0 +1,204 @@
+"""On-policy family curves: A3C/A2C and PPO."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from curves.common import OUT_DIR, _first_crossing, _tb_logger
+
+
+def a3c_cartpole(
+    num_envs: int = 8,
+    max_frames: int = 300_000,
+    threshold: float = 400.0,
+    seed: int = 1,
+):
+    """On-policy A2C runtime to a CartPole eval threshold."""
+    from scalerl_tpu.agents.a3c import A3CAgent
+    from scalerl_tpu.config import A3CArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer import OnPolicyTrainer
+
+    args = A3CArguments(
+        env_id="CartPole-v1",
+        rollout_length=16,
+        num_workers=num_envs,
+        hidden_sizes="64,64",
+        learning_rate=1e-3,
+        entropy_coef=0.01,
+        gae_lambda=0.95,
+        gamma=0.99,
+        seed=seed,
+        max_timesteps=max_frames,
+        eval_frequency=10**9,
+        logger_frequency=2_000,
+        logger_backend="tensorboard",
+        work_dir=str(OUT_DIR),
+        project="",
+        save_model=False,
+        normalize_obs=False,
+    )
+    train_envs = make_vect_envs(
+        "CartPole-v1", num_envs=num_envs, seed=seed, async_envs=False
+    )
+    eval_envs = make_vect_envs("CartPole-v1", num_envs=4, seed=seed + 99, async_envs=False)
+    agent = A3CAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=np.float32)
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs, run_name="a3c_cartpole")
+    t0 = time.time()
+    trainer.run()
+    ev = trainer.run_evaluate_episodes(n_episodes=10)
+    wall = time.time() - t0
+    hit = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
+    trainer.close()
+    train_envs.close()
+    eval_envs.close()
+    return {
+        "experiment": "a3c_cartpole",
+        "env": "CartPole-v1",
+        "algo": "A3C (sync-batched A2C runtime)",
+        "threshold": threshold,
+        "final_return": round(ev["reward_mean"], 2),
+        "frames": trainer.global_step,
+        "frames_to_threshold": hit,
+        "wall_s": round(wall, 1),
+        "fps": round(trainer.global_step / wall, 1),
+        "passed": ev["reward_mean"] >= threshold,
+    }
+
+
+# ----------------------------------------------------------------------
+
+
+def ppo_recall_lstm(
+    size: int = 16,
+    delay: int = 6,
+    max_frames: int = 200_000,
+    threshold: float = 0.8,
+    seed: int = 0,
+):
+    """Recurrent PPO to convergence: the PPO learn fn inside the fused
+    device loop (Anakin/Brax shape) with an LSTM torso on delayed recall.
+
+    Complements ``impala_recall_lstm``: same memory-required task, second
+    algorithm family — and PPO's epoch reuse is markedly more
+    sample-efficient here (the recorded run crosses the threshold in ~19k
+    frames vs IMPALA's ~120k)."""
+    from scalerl_tpu.agents.ppo import PPOAgent
+    from scalerl_tpu.envs import JaxRecall
+    from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+    from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+
+    from scalerl_tpu.config import PPOArguments
+
+    env = JaxRecall(size=size, delay=delay, num_cues=4)
+    B, T, I = 32, 8, 2
+    args = PPOArguments(
+        use_lstm=True, hidden_size=64, rollout_length=T, num_workers=B,
+        num_minibatches=2, ppo_epochs=2, max_timesteps=0,
+        learning_rate=1e-3, entropy_coef=0.02, gae_lambda=0.95,
+    )
+    venv = JaxVecEnv(env, B)
+    agent = PPOAgent(
+        args, obs_shape=env.observation_shape, num_actions=env.num_actions,
+        obs_dtype=jax.numpy.uint8,
+    )
+    loop = DeviceActorLearnerLoop(
+        agent.model, venv, agent.make_learn_fn(), T, iters_per_call=I
+    )
+    logger = _tb_logger("ppo_recall_lstm")
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    carry = loop.init_carry(k1)
+    t0 = time.time()
+
+    def on_metrics(frames, windowed, m):
+        logger.log_train_data(
+            {"return_windowed": windowed, "total_loss": m["total_loss"]}, frames
+        )
+
+    _, _, summary = loop.run_until(
+        agent.state, carry, k2, threshold=threshold,
+        max_calls=max_frames // (B * T * I), on_metrics=on_metrics,
+    )
+    wall = time.time() - t0
+    logger.close()
+    frames = int(summary["frames"])
+    return {
+        "experiment": "ppo_recall_lstm",
+        "env": f"JaxRecall({size}x{size}, delay={delay}, device-native)",
+        "algo": "PPO conv+LSTM (fused device loop, epoch reuse)",
+        "threshold": threshold,
+        "final_return": round(summary["windowed_return"], 3),
+        "frames": frames,
+        "frames_to_threshold": frames if summary["hit"] else None,
+        "wall_s": round(wall, 1),
+        "fps": round(frames / max(wall, 1e-8), 1),
+        "passed": bool(summary["hit"]),
+    }
+
+
+# ----------------------------------------------------------------------
+def ppo_cartpole(
+    num_envs: int = 8,
+    max_frames: int = 300_000,
+    threshold: float = 400.0,
+    seed: int = 5,
+):
+    """PPO (fused epochs x minibatch clipped surrogate) on the same
+    on-policy runtime as A3C, to a CartPole eval threshold."""
+    from scalerl_tpu.agents.ppo import PPOAgent
+    from scalerl_tpu.config import PPOArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer import OnPolicyTrainer
+
+    args = PPOArguments(
+        env_id="CartPole-v1",
+        rollout_length=32,
+        num_workers=num_envs,
+        num_minibatches=4,
+        ppo_epochs=4,
+        hidden_sizes="64,64",
+        learning_rate=3e-4,
+        entropy_coef=0.01,
+        gae_lambda=0.95,
+        gamma=0.99,
+        seed=seed,
+        max_timesteps=max_frames,
+        eval_frequency=10**9,
+        logger_frequency=2_000,
+        logger_backend="tensorboard",
+        work_dir=str(OUT_DIR),
+        project="",
+        save_model=False,
+        normalize_obs=False,
+    )
+    train_envs = make_vect_envs(
+        "CartPole-v1", num_envs=num_envs, seed=seed, async_envs=False
+    )
+    eval_envs = make_vect_envs("CartPole-v1", num_envs=4, seed=seed + 99, async_envs=False)
+    agent = PPOAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=np.float32)
+    trainer = OnPolicyTrainer(args, agent, train_envs, eval_envs, run_name="ppo_cartpole")
+    t0 = time.time()
+    trainer.run()
+    ev = trainer.run_evaluate_episodes(n_episodes=10)
+    wall = time.time() - t0
+    hit = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
+    trainer.close()
+    train_envs.close()
+    eval_envs.close()
+    return {
+        "experiment": "ppo_cartpole",
+        "env": "CartPole-v1",
+        "algo": "PPO (fused minibatch epochs, on-policy runtime)",
+        "threshold": threshold,
+        "final_return": round(ev["reward_mean"], 2),
+        "frames": trainer.global_step,
+        "frames_to_threshold": hit,
+        "wall_s": round(wall, 1),
+        "fps": round(trainer.global_step / wall, 1),
+        "passed": ev["reward_mean"] >= threshold,
+    }
+
+
+# ----------------------------------------------------------------------

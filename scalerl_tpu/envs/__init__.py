@@ -11,6 +11,7 @@ from scalerl_tpu.envs.gym_env import (  # noqa: F401
 )
 from scalerl_tpu.envs.jax_envs import (  # noqa: F401
     JaxCartPole,
+    JaxBreakout,
     JaxCatch,
     JaxRecall,
     JaxVecEnv,

@@ -1,5 +1,6 @@
 from scalerl_tpu.envs.jax_envs.base import JaxEnv, JaxVecEnv, make_jax_vec_env  # noqa: F401
 from scalerl_tpu.envs.jax_envs.cartpole import JaxCartPole  # noqa: F401
+from scalerl_tpu.envs.jax_envs.breakout import JaxBreakout  # noqa: F401
 from scalerl_tpu.envs.jax_envs.catch import JaxCatch  # noqa: F401
 from scalerl_tpu.envs.jax_envs.recall import JaxRecall  # noqa: F401
 from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv  # noqa: F401

@@ -81,6 +81,7 @@ class JaxVecEnv:
 
 
 def make_jax_vec_env(env_id: str, num_envs: int, **kwargs) -> JaxVecEnv:
+    from scalerl_tpu.envs.jax_envs.breakout import JaxBreakout
     from scalerl_tpu.envs.jax_envs.cartpole import JaxCartPole
     from scalerl_tpu.envs.jax_envs.catch import JaxCatch
     from scalerl_tpu.envs.jax_envs.recall import JaxRecall
@@ -92,6 +93,7 @@ def make_jax_vec_env(env_id: str, num_envs: int, **kwargs) -> JaxVecEnv:
         "SyntheticPixel-v0": lambda: SyntheticPixelEnv(**kwargs),
         "Catch-v0": lambda: JaxCatch(**kwargs),
         "Recall-v0": lambda: JaxRecall(**kwargs),
+        "Breakout-v0": lambda: JaxBreakout(**kwargs),
     }
     if env_id not in registry:
         raise KeyError(

@@ -151,6 +151,102 @@ class RecallGymEnv(gym.Env):
         pass
 
 
+class BreakoutGymEnv(gym.Env):
+    """Numpy/gym twin of ``envs/jax_envs/breakout.py:JaxBreakout`` — the
+    flagship pixel-control task for the HOST actor plane (CPU envs feeding
+    central batched inference), dynamics formula-identical to the device
+    env: diagonal unit-velocity ball, 3-wide paddle, +1 per brick, miss
+    terminates, cleared wall respawns, time cap truncates."""
+
+    metadata: dict = {"render_modes": []}
+
+    def __init__(
+        self,
+        size: int = 10,
+        stack: int = 1,
+        brick_rows: int = 3,
+        brick_top: int = 2,
+        max_steps: int = 500,
+        render_mode=None,
+    ) -> None:
+        self.render_mode = render_mode
+        self.size = size
+        self.stack = stack
+        self.brick_rows = brick_rows
+        self.brick_top = brick_top
+        self.max_steps = max_steps
+        self.observation_space = gym.spaces.Box(0, 255, (size, size, stack), np.uint8)
+        self.action_space = gym.spaces.Discrete(3)
+        self._rng = np.random.default_rng(0)
+        self._spawn()
+
+    def _spawn(self) -> None:
+        self._ball_x = int(self._rng.integers(self.size))
+        self._ball_y = self.brick_top + self.brick_rows
+        self._dx = 1 if self._rng.random() < 0.5 else -1
+        self._dy = 1
+        self._paddle_x = self.size // 2
+        self._bricks = np.ones((self.brick_rows, self.size), bool)
+        self._t = 0
+
+    def _render_frame(self) -> np.ndarray:
+        frame = np.zeros((self.size, self.size), np.uint8)
+        band = slice(self.brick_top, self.brick_top + self.brick_rows)
+        frame[band][self._bricks] = 128
+        frame[self.size - 1, max(self._paddle_x - 1, 0) : self._paddle_x + 2] = 255
+        frame[self._ball_y, self._ball_x] = 255
+        return np.broadcast_to(
+            frame[:, :, None], (self.size, self.size, self.stack)
+        ).copy()
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._spawn()
+        return self._render_frame(), {}
+
+    def step(self, action):
+        W = self.size
+        self._paddle_x = int(np.clip(self._paddle_x + int(action) - 1, 1, W - 2))
+
+        nx = self._ball_x + self._dx
+        if nx < 0 or nx >= W:
+            self._dx = -self._dx
+            nx = int(np.clip(nx, 0, W - 1))
+        ny = self._ball_y + self._dy
+        if ny < 0:
+            self._dy = 1
+            ny = 1
+
+        reward = 0.0
+        brow = ny - self.brick_top
+        if 0 <= brow < self.brick_rows and self._bricks[brow, nx]:
+            self._bricks[brow, nx] = False
+            reward = 1.0
+            ny = self._ball_y  # reflect back to the previous row
+            self._dy = -self._dy
+
+        term = False
+        if ny >= W - 1:
+            if abs(nx - self._paddle_x) <= 1:
+                ny = W - 2
+                self._dy = -1
+            else:
+                term = True
+        if not self._bricks.any():
+            self._bricks[:] = True
+
+        self._ball_x, self._ball_y = nx, ny
+        self._t += 1
+        trunc = not term and self._t >= self.max_steps
+        if term or trunc:
+            self._spawn()
+        return self._render_frame(), reward, term, trunc, {}
+
+    def close(self):
+        pass
+
+
 def register_synthetic_envs() -> None:
     """Idempotently register the synthetic envs with gymnasium."""
     import gymnasium as gym
@@ -165,5 +261,11 @@ def register_synthetic_envs() -> None:
         gym.register(
             id="RecallGym-v0",
             entry_point="scalerl_tpu.envs.synthetic_gym:RecallGymEnv",
+            disable_env_checker=True,
+        )
+    if "BreakoutGym-v0" not in gym.registry:
+        gym.register(
+            id="BreakoutGym-v0",
+            entry_point="scalerl_tpu.envs.synthetic_gym:BreakoutGymEnv",
             disable_env_checker=True,
         )

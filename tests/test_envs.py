@@ -237,6 +237,129 @@ def test_jax_catch_env():
     assert total == -1.0
 
 
+def test_jax_breakout_mechanics():
+    """Hand-driven physics: brick hit pays +1 and reflects, paddle catch
+    reflects, miss ends the episode (auto-reset), wall respawns on clear."""
+    from scalerl_tpu.envs import JaxBreakout
+
+    env = JaxBreakout(size=10, brick_rows=3, brick_top=2, max_steps=500)
+    key = jax.random.PRNGKey(0)
+    state, obs = env.reset(key)
+    assert obs.shape == (10, 10, 1) and obs.dtype == jnp.uint8
+    assert bool(state.bricks.all())
+    # bricks render at 128, ball/paddle at 255
+    assert int(obs.max()) == 255
+    assert (np.asarray(obs[2:5]) == 128).all()
+
+    # place the ball heading up into the brick band: row 5 -> hits row 4
+    s = state._replace(
+        ball_x=jnp.asarray(4, jnp.int32), ball_y=jnp.asarray(5, jnp.int32),
+        dx=jnp.asarray(1, jnp.int32), dy=jnp.asarray(-1, jnp.int32),
+    )
+    s2, _, r, d = env.step(s, jnp.asarray(1, jnp.int32), jax.random.PRNGKey(1))
+    assert float(r) == 1.0 and not bool(d)
+    assert not bool(s2.bricks[2, 5])  # brick row 4 = band row 2, col 4+1
+    assert int(s2.ball_y) == 5 and int(s2.dy) == 1  # reflected down
+
+    # paddle catch: ball at row 8 heading down onto the paddle center
+    s = state._replace(
+        ball_x=jnp.asarray(5, jnp.int32), ball_y=jnp.asarray(8, jnp.int32),
+        dx=jnp.asarray(1, jnp.int32), dy=jnp.asarray(1, jnp.int32),
+        paddle_x=jnp.asarray(6, jnp.int32),
+    )
+    s2, _, r, d = env.step(s, jnp.asarray(1, jnp.int32), jax.random.PRNGKey(2))
+    assert not bool(d) and int(s2.dy) == -1 and int(s2.ball_y) == 8
+
+    # miss: paddle far away -> done, auto-reset spawns a full wall
+    s = s._replace(paddle_x=jnp.asarray(1, jnp.int32))
+    holes = s.bricks.at[0, 0].set(False)
+    s = s._replace(bricks=holes)
+    s2, _, r, d = env.step(s, jnp.asarray(1, jnp.int32), jax.random.PRNGKey(3))
+    assert bool(d) and float(r) == 0.0
+    assert bool(s2.bricks.all())  # fresh episode, fresh wall
+
+    # clearing the last brick respawns the wall mid-episode
+    one_left = jnp.zeros((3, 10), bool).at[2, 5].set(True)
+    s = state._replace(
+        ball_x=jnp.asarray(4, jnp.int32), ball_y=jnp.asarray(5, jnp.int32),
+        dx=jnp.asarray(1, jnp.int32), dy=jnp.asarray(-1, jnp.int32),
+        bricks=one_left,
+    )
+    s2, _, r, d = env.step(s, jnp.asarray(1, jnp.int32), jax.random.PRNGKey(4))
+    assert float(r) == 1.0 and not bool(d)
+    assert bool(s2.bricks.all())
+
+
+def test_jax_breakout_tracker_beats_random():
+    """A hand-coded ball-tracking policy far outscores random play — the
+    env rewards *control*, which is what makes it the flagship stand-in
+    for the ALE row (VERDICT r3 missing #3)."""
+    from scalerl_tpu.envs import JaxBreakout, JaxVecEnv
+
+    # wider field than default: random's fluke catches get rarer, so the
+    # control signal dominates the score separation
+    env = JaxBreakout(size=16, max_steps=200)
+    venv = JaxVecEnv(env, num_envs=16)
+
+    def rollout(policy, key, steps=400):
+        key, k0 = jax.random.split(key)
+        state, obs = venv.reset(k0)
+        total = 0.0
+        for t in range(steps):
+            key, ka, ks = jax.random.split(key, 3)
+            a = policy(state, ka)
+            state, obs, r, d = venv.step(state, a, ks)
+            total += float(r.sum())
+        return total / 16
+
+    def tracker(state, key):
+        return (jnp.sign(state.ball_x - state.paddle_x) + 1).astype(jnp.int32)
+
+    def random_policy(state, key):
+        return jax.random.randint(key, (16,), 0, 3)
+
+    score_t = rollout(tracker, jax.random.PRNGKey(0))
+    score_r = rollout(random_policy, jax.random.PRNGKey(1))
+    assert score_t > 3 * max(score_r, 0.5), (score_t, score_r)
+
+
+def test_breakout_gym_twin_matches_jax_env():
+    """The numpy host-plane twin and the device env, forced into the same
+    state, produce identical frames/rewards/termination under the same
+    action stream (until an episode boundary re-randomizes spawns)."""
+    from scalerl_tpu.envs import JaxBreakout
+    from scalerl_tpu.envs.synthetic_gym import BreakoutGymEnv
+
+    jenv = JaxBreakout(size=10, max_steps=500)
+    genv = BreakoutGymEnv(size=10, max_steps=500)
+    genv.reset(seed=0)
+    state, _ = jenv.reset(jax.random.PRNGKey(0))
+
+    # force both to one mid-episode state
+    genv._ball_x, genv._ball_y = 3, 6
+    genv._dx, genv._dy = 1, -1
+    genv._paddle_x = 4
+    genv._bricks[:] = True
+    genv._t = 0
+    state = state._replace(
+        ball_x=jnp.asarray(3, jnp.int32), ball_y=jnp.asarray(6, jnp.int32),
+        dx=jnp.asarray(1, jnp.int32), dy=jnp.asarray(-1, jnp.int32),
+        paddle_x=jnp.asarray(4, jnp.int32),
+        bricks=jnp.ones((3, 10), bool), t=jnp.asarray(0, jnp.int32),
+    )
+    actions = [0, 1, 2, 1, 1, 0, 2, 1, 1, 1, 2, 0, 1, 1, 1, 2, 1, 0]
+    for i, a in enumerate(actions):
+        gobs, gr, gterm, gtrunc, _ = genv.step(a)
+        state, jobs, jr, jd = jenv.step(
+            state, jnp.asarray(a, jnp.int32), jax.random.PRNGKey(100 + i)
+        )
+        assert float(jr) == gr, f"step {i}"
+        assert bool(jd) == (gterm or gtrunc), f"step {i}"
+        if gterm or gtrunc:
+            break  # auto-reset diverges (independent RNGs)
+        np.testing.assert_array_equal(np.asarray(jobs), gobs, err_msg=f"step {i}")
+
+
 def test_atari_wrappers_on_fake_env():
     """Drive WarpFrame/ClipReward/FrameStack/MaxAndSkip on a synthetic RGB env
     (no ALE in this image, SURVEY.md env notes)."""
