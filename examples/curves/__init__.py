@@ -24,7 +24,6 @@ from curves.marl import marl_pursuit_iql
 from curves.onpolicy import a3c_cartpole, ppo_cartpole, ppo_recall_lstm
 from curves.r2d2 import r2d2_recall, r2d2_recall_device
 from curves.transformer import transformer_recall
-from curves.report import _write_markdown
 
 EXPERIMENTS = {
     "impala_synthetic": impala_synthetic,

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import time
 
+import jax
+
 from curves.common import _tb_logger
 
 

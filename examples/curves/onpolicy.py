@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import time
 
+import jax
 import numpy as np
 
 from curves.common import OUT_DIR, _first_crossing, _tb_logger

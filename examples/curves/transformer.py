@@ -137,11 +137,15 @@ def transformer_recall(
     arm at chance (-0.5 for 4 cues)."""
     logger = _tb_logger("transformer_recall")
     t0 = time.time()
+    crossing = {"frames": None}
+
+    def on_window(frames, w):
+        if crossing["frames"] is None and w >= threshold:
+            crossing["frames"] = frames
+        logger.log_train_data({"return_windowed": w}, frames)
+
     final = run_transformer_recall(
-        delay=delay, iters=iters, seed=seed,
-        on_window=lambda f, w: logger.log_train_data(
-            {"return_windowed": w}, f
-        ),
+        delay=delay, iters=iters, seed=seed, on_window=on_window,
     )
     control = run_transformer_recall(
         delay=delay, iters=iters, seed=seed, blank_cue=True,
@@ -160,7 +164,7 @@ def transformer_recall(
         "optimal_return": 1.0,
         "final_return": round(final, 3),
         "frames": frames,
-        "frames_to_threshold": frames // 2 if final >= threshold else None,
+        "frames_to_threshold": crossing["frames"],
         "wall_s": round(wall, 1),
         "fps": round(frames / wall, 1),
         # the proof needs BOTH arms: crossing AND a chance-pinned control

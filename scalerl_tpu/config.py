@@ -434,12 +434,23 @@ class ImpalaArguments(RLArguments):
 
     def validate(self) -> None:
         super().validate()
-        if self.num_buffers < max(2 * self.batch_size, self.num_actors):
+        # num_buffers counts SLOTS (each slot holds one actor's vector-env
+        # lanes) while batch_size counts LANES; the reference's constructor
+        # check (impala_atari.py:74-77, num_buffers >= 2*batch_size) compares
+        # like units because monobeast's batch_size counts rollouts/slots.
+        # Porting that formula verbatim here silently forced queues ~16x
+        # deeper than needed (32 slots for a 2-slot learn batch), and queue
+        # depth IS worst-case policy lag — the host plane's Breakout arm
+        # stalled on exactly this.  The slot-aware floor
+        # (num_buffers >= max(2 * batch_size/envs_per_actor, num_actors))
+        # needs the runtime env fleet shape, so the trainers enforce it;
+        # here only the shape-independent minimum holds.
+        if self.num_buffers < max(2, self.num_actors):
             raise ValueError(
-                "num_buffers should be at least max(2*batch_size, num_actors) "
-                f"(got {self.num_buffers}, batch_size={self.batch_size}, "
-                f"num_actors={self.num_actors})"
-            )  # mirrors the reference's constructor check, impala_atari.py:74-77
+                "num_buffers (slot count) must be at least "
+                "max(2, num_actors) "
+                f"(got {self.num_buffers}, num_actors={self.num_actors})"
+            )
 
 
 @dataclass

@@ -242,6 +242,26 @@ class HostPlaneMixin:
         return True
 
 
+
+def check_queue_depth(args, envs_per_actor: int) -> None:
+    """Slot-aware queue floor (the check config.validate cannot do: it
+    needs the env fleet shape).  ``num_buffers`` counts SLOTS of
+    ``envs_per_actor`` lanes; one learn step drains
+    ``batch_size / envs_per_actor`` slots, and queue depth is worst-case
+    policy lag in learner steps x drained slots — deeper queues do not add
+    throughput once every actor can hold a free slot, they only add
+    staleness (the host-plane Breakout stall, round 4)."""
+    n_slots = max(args.batch_size // envs_per_actor, 1)
+    floor = max(2 * n_slots, args.num_actors)
+    if args.num_buffers < floor:
+        raise ValueError(
+            f"num_buffers ({args.num_buffers} slots of {envs_per_actor} "
+            f"lanes) must be at least max(2 * batch_size/envs_per_actor, "
+            f"num_actors) = {floor} so the learner can drain a full batch "
+            "while every actor holds a slot"
+        )
+
+
 class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
     def __init__(
         self,
@@ -283,6 +303,7 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
             obs_dtype=jax.numpy.float32 if len(obs_shape) == 1 else jax.numpy.uint8,
             core_state_shapes=tuple(tuple(c.shape) for c, _ in core),
         )
+        check_queue_depth(args, self.envs_per_actor)
         self.queue = RolloutQueue(self.spec, num_slots=args.num_buffers)
         self.episode_metrics = [
             EpisodeMetrics(self.envs_per_actor) for _ in range(len(env_fns))

@@ -224,6 +224,11 @@ class ProcessActorLearnerTrainer(BaseTrainer):
         self.envs_per_actor = envs_per_actor or max(
             args.num_envs // args.num_actors, 1
         )
+        from scalerl_tpu.trainer.actor_learner import check_queue_depth
+
+        # slot-aware ring floor (the learner pops batch_size/envs_per_actor
+        # full slots per step; a shallower ring starves it forever)
+        check_queue_depth(args, self.envs_per_actor)
         self.param_server = ParameterServer()
         self.returns: List[float] = []
         self.env_frames = 0

@@ -38,7 +38,11 @@ from scalerl_tpu.data.sequence_replay import (
 from scalerl_tpu.data.trajectory import TrajectorySpec
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
-from scalerl_tpu.trainer.actor_learner import HostPlaneMixin, _ActorThread
+from scalerl_tpu.trainer.actor_learner import (
+    HostPlaneMixin,
+    _ActorThread,
+    check_queue_depth,
+)
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 
@@ -78,6 +82,7 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             obs_dtype=jnp.uint8 if len(obs_shape) == 3 else jnp.float32,
             core_state_shapes=tuple(tuple(c.shape) for c, _ in core),
         )
+        check_queue_depth(args, self.envs_per_actor)
         self.queue = RolloutQueue(self.spec, num_slots=args.num_buffers)
         self.episode_metrics = [
             EpisodeMetrics(self.envs_per_actor) for _ in range(len(env_fns))
