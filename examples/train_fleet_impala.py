@@ -148,7 +148,11 @@ def main() -> None:
         hidden_size=64,
         rollout_length=args.rollout_length,
         batch_size=args.batch_size,
-        num_buffers=max(2 * args.batch_size, args.num_workers),
+        # slot-aware floor: num_buffers counts SLOTS (one worker's lanes
+        # each); the learner drains batch_size/envs-per-worker slots per
+        # step, and queue depth is worst-case policy lag
+        num_buffers=max(2 * max(args.batch_size // args.num_lanes, 1),
+                        args.num_workers),
         learning_rate=args.learning_rate,
         entropy_cost=0.01,
         max_timesteps=args.total_frames,

@@ -332,6 +332,45 @@ class ApexTrainer(BaseTrainer):
             self.param_server.push(self.agent.get_weights())
         return info
 
+    # -- resume --------------------------------------------------------
+    def _resume_pytree(self) -> Dict:
+        return {
+            "agent": self.agent.state,
+            "replay": self.buffer.state,
+            "global_step": np.asarray(self.global_step, np.int64),
+            "learn_steps": np.asarray(self.learn_steps, np.int64),
+        }
+
+    def save_resume(self) -> None:
+        self.save_resume_checkpoint(
+            self._resume_pytree(), self.global_step, self.learn_steps
+        )
+
+    def try_resume(self) -> bool:
+        """Restore learner state, the FULL prioritized replay (sharded or
+        not — losing it would cost warmup plus every learned priority),
+        and counters; re-lays arrays out on the mesh when one is active."""
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        agent_state = state["agent"]
+        replay_state = state["replay"]
+        mesh_learn = getattr(self.agent, "_learn_mesh", None)
+        if mesh_learn is not None:
+            agent_state = jax.device_put(agent_state, mesh_learn.state_sharding)
+        if hasattr(self.buffer, "_state_sh"):
+            replay_state = jax.device_put(replay_state, self.buffer._state_sh)
+        self.agent.state = agent_state
+        self.buffer.state = replay_state
+        self.global_step = int(state["global_step"])
+        self.learn_steps = int(state["learn_steps"])
+        self.param_server.push(self.agent.get_weights())
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: step {self.global_step}"
+            )
+        return True
+
     def run_evaluate_episodes(self, n_episodes: Optional[int] = None) -> Dict[str, float]:
         envs = self.eval_envs
         if envs is None:
@@ -357,6 +396,8 @@ class ApexTrainer(BaseTrainer):
     # ------------------------------------------------------------------
     def run(self) -> Dict[str, float]:
         args = self.args
+        if self.resuming:
+            self.try_resume()
         actors = [
             _ApexActorThread(i, self, env) for i, env in enumerate(self._actor_envs)
         ]
@@ -364,8 +405,12 @@ class ApexTrainer(BaseTrainer):
             a.start()
 
         start = time.time()
-        last_log = 0
-        last_eval = 0
+        # seed the interval gates from the (possibly resumed) step, or the
+        # first iteration immediately fires a log line and a full blocking
+        # eval sweep at the restored step
+        last_log = self.global_step
+        last_eval = self.global_step
+        last_save = self.global_step
         train_info: Dict[str, float] = {}
         try:
             while self.global_step < args.max_timesteps:
@@ -401,6 +446,14 @@ class ApexTrainer(BaseTrainer):
                     last_eval = self.global_step
                     eval_info = self.run_evaluate_episodes()
                     self.logger.log_test_data(eval_info, self.global_step)
+
+                if (
+                    args.save_model
+                    and not args.disable_checkpoint
+                    and self.global_step - last_save >= args.save_frequency
+                ):
+                    last_save = self.global_step
+                    self.save_resume()
         finally:
             self._stop.set()
             for a in actors:

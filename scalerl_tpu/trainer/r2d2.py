@@ -115,8 +115,43 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         self._max_priority = 1.0
         self._rng = jax.random.PRNGKey(args.seed + 13)
 
-    # grant_actor_restart / _resume_pytree / save_resume / try_resume come
-    # from HostPlaneMixin (shared with the IMPALA thread plane)
+    # grant_actor_restart comes from HostPlaneMixin (shared with the IMPALA
+    # thread plane); resume extends the mixin's (agent, env_frames) pytree
+    # with the REPLAY state — losing a pod-scale sequence memory on restart
+    # costs warmup_sequences of fresh collection plus every learned
+    # priority, so the buffer (sharded or not: both are pytrees Orbax
+    # handles, sharded arrays included) rides the same async checkpoint.
+
+    def _resume_pytree(self) -> Dict:
+        tree = super()._resume_pytree()
+        tree["replay"] = (
+            self._sharded_replay.state
+            if self._sharded_replay is not None
+            else self.replay
+        )
+        tree["max_priority"] = np.asarray(self._max_priority, np.float64)
+        return tree
+
+    def try_resume(self) -> bool:
+        state = self.load_resume_checkpoint(self._resume_pytree())
+        if state is None:
+            return False
+        self.agent.state = state["agent"]
+        self.env_frames = int(state["env_frames"])
+        if self._sharded_replay is not None:
+            # restore into the mesh layout the buffer was constructed with
+            self._sharded_replay.state = jax.device_put(
+                state["replay"], self._sharded_replay._state_sh
+            )
+        else:
+            self.replay = state["replay"]
+        self._max_priority = float(state["max_priority"])
+        self.param_server.push(self.agent.get_weights())
+        if self.is_main_process:
+            self.text_logger.info(
+                f"resumed from {self.resume_ckpt_path}: frames {self.env_frames}"
+            )
+        return True
 
     # ------------------------------------------------------------------
     def _insert_slots(self, n_slots: int) -> None:
@@ -178,6 +213,7 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         start = time.time()
         start_frames = self.env_frames
         last_log_frames = start_frames
+        last_save_frames = start_frames
         n_slots = max(args.batch_size // self.envs_per_actor, 1)
         seqs_per_drain = n_slots * self.envs_per_actor
         metrics: Dict = {}
@@ -192,6 +228,15 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
                     # version bump for off-host pullers; thread actors read
                     # the live params directly (central inference)
                     self.param_server.push(self.agent.get_weights(), to_host=False)
+                if (
+                    args.save_model
+                    and not args.disable_checkpoint
+                    and self.env_frames - last_save_frames >= args.save_frequency
+                ):
+                    # periodic, not just exit-time: a crash-restart must find
+                    # a fresh replay+learner snapshot (the durability claim)
+                    last_save_frames = self.env_frames
+                    self.save_resume()
                 if self.env_frames - last_log_frames >= args.logger_frequency:
                     last_log_frames = self.env_frames
                     sps = (self.env_frames - start_frames) / max(

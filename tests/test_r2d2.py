@@ -281,6 +281,14 @@ def test_r2d2_trainer_resume_roundtrip(tmp_path):
     assert tr_b.try_resume()
     assert tr_b.env_frames == frames_a
     assert int(agent_b.state.step) == step_a
+    # the replay memory survives the restart: priorities, cursors, and the
+    # running max (losing the buffer would cost warmup + learned priorities)
+    np.testing.assert_allclose(
+        np.asarray(tr_b.replay.priorities), np.asarray(tr_a.replay.priorities)
+    )
+    assert int(tr_b.replay.size) == int(tr_a.replay.size)
+    assert int(tr_b.replay.pos) == int(tr_a.replay.pos)
+    assert tr_b._max_priority == tr_a._max_priority
     tr_b.close()
 
 
