@@ -4,6 +4,7 @@ insert path, and the threaded actor/learner runtime e2e."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from scalerl_tpu.agents.dqn import DQNAgent, make_dqn_priority_fn
 from scalerl_tpu.config import ApexArguments
@@ -200,10 +201,12 @@ def test_apex_sharded_replay_mesh_e2e(tmp_path):
         trainer.close()
 
 
-def test_apex_resume_roundtrip(tmp_path):
+@pytest.mark.parametrize("mesh_spec", [None, "dp=4,fsdp=2"])
+def test_apex_resume_roundtrip(tmp_path, mesh_spec):
     """Kill-and-resume for Ape-X: learner state, the FULL prioritized
     replay (storage + priorities + cursors), and counters survive a
-    restart — the durability story the reference's Ape-X lacked."""
+    restart — the durability story the reference's Ape-X lacked.  The
+    meshed flavor restores through the sharded-layout device_put branch."""
     args_a = _args(
         max_timesteps=2500, logger_frequency=10**9, eval_frequency=10**9,
         work_dir=str(tmp_path), save_model=True, save_frequency=1000,
@@ -216,6 +219,8 @@ def test_apex_resume_roundtrip(tmp_path):
         )
 
     agent_a = DQNAgent(args_a, obs_shape=(4,), action_dim=2, donate_state=False)
+    if mesh_spec:
+        agent_a.enable_mesh(mesh_spec)
     tr_a = ApexTrainer(args_a, agent_a, make_envs)
     tr_a.run()
     assert tr_a.learn_steps > 0
@@ -232,6 +237,8 @@ def test_apex_resume_roundtrip(tmp_path):
         work_dir=str(tmp_path), save_model=True, resume=str(run_dir),
     )
     agent_b = DQNAgent(args_b, obs_shape=(4,), action_dim=2, donate_state=False)
+    if mesh_spec:
+        agent_b.enable_mesh(mesh_spec)
     tr_b = ApexTrainer(args_b, agent_b, make_envs)
     assert tr_b.try_resume()
     assert tr_b.global_step == steps_a
