@@ -416,10 +416,11 @@ def impala_breakout_host(
     planes learn the one-bounce rally (~4.5/episode, >10x random) within
     ~200k frames, but crossing 20 needs a stochastic breakthrough (staying
     under the rebound for repeated catches).  The fused arm hit it at
-    ~950k frames; four host-plane runs (budgets 600k-3M, entropy 0.01-0.03,
-    queue depths 4-32 slots) plateaued at the rally level without the
-    breakthrough.  Recorded as a miss rather than re-rolled until lucky —
-    the curve artifact shows the plateau either way."""
+    ~950k frames; five host-plane runs (seeds 0/1/7, budgets 600k-3M,
+    entropy 0.01-0.03, queue depths 4-32 slots) plateaued at the rally
+    level (3.1-5.6) without the breakthrough.  Recorded as a miss rather
+    than re-rolled until lucky — the curve artifact shows the plateau
+    either way."""
     from scalerl_tpu.agents.impala import ImpalaAgent
     from scalerl_tpu.config import ImpalaArguments
     from scalerl_tpu.envs import make_vect_envs

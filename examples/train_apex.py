@@ -40,6 +40,10 @@ def main() -> None:
         donate_state=False,  # actors read params concurrently with learn
     )
     probe.close()
+    if args.mesh_shape:
+        # pod-shape Ape-X: DDP learner + lane-sharded PER (ApexTrainer
+        # swaps in data.sharded_replay automatically when a mesh is set)
+        agent.enable_mesh(args.mesh_shape)
     trainer = ApexTrainer(args, agent, make_envs, eval_envs)
     try:
         summary = trainer.run()
