@@ -19,12 +19,10 @@ the regime the stored-state + burn-in machinery exists for.
 from __future__ import annotations
 
 import time
-from functools import partial
-from typing import Dict, NamedTuple, Optional, Tuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from scalerl_tpu.agents.r2d2 import R2D2Agent
 from scalerl_tpu.config import R2D2Arguments
