@@ -89,7 +89,59 @@ def _cost_analysis_flops(compiled) -> float | None:
     return float(flops)
 
 
-def _run_measurement(mesh_spec: str | None = None) -> None:
+def _micro_witness(device_kind: str, platform: str) -> None:
+    """~30-second TPU witness: chained bf16 matmuls, analytic FLOPs.
+
+    The full fused bench needs the tunnel to stay up through a 20-40 s
+    XLA compile plus a 20 s measurement; rounds 1-4 showed windows can be
+    shorter than that.  This program compiles in a few seconds (one
+    ``fori_loop`` of ``n``×``n`` bf16 matmuls — the MXU primitive), runs
+    ~3 s, and prints its own JSON line so the parent can bank a
+    timestamped artifact in ``BENCH_TPU.md`` before the escalation to the
+    full bench even starts (VERDICT r4 next-round item #1b).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # 8.8 TFLOP/call: ~45 ms on a v5e, minutes on one CPU core — shrink
+    # off-accelerator (that path only exists for plumbing tests)
+    on_accel = platform in ("tpu", "gpu")
+    n, k_loop = (4096, 64) if on_accel else (256, 4)
+
+    def chain(x, w):
+        return lax.fori_loop(0, k_loop, lambda _, y: (y @ w) * 0.02, x)
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (n, n), dtype=jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (n, n), dtype=jnp.bfloat16)
+    f = jax.jit(chain)
+    f(x, w).block_until_ready()  # compile + warmup
+    flops_per_call = 2.0 * n * n * n * k_loop
+    t0 = time.perf_counter()
+    calls = 0
+    while time.perf_counter() - t0 < 3.0 or calls < 2:
+        f(x, w).block_until_ready()
+        calls += 1
+    elapsed = time.perf_counter() - t0
+    achieved = flops_per_call * calls / elapsed
+    result = {
+        "metric": "tpu_micro_witness_tflops",
+        "value": round(achieved / 1e12, 2),
+        "unit": f"TFLOP/s bf16 matmul ({platform})",
+        "device_kind": device_kind,
+        "matmul_n": n,
+        "measured_s": round(elapsed, 2),
+    }
+    peak = _peak_flops(device_kind)
+    if peak is not None:
+        result["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(result), flush=True)
+
+
+def _run_measurement(
+    mesh_spec: str | None = None, fast: str | None = None
+) -> None:
     """Child mode: probe + measure in one process.
 
     Prints ``backend: X`` the moment the backend answers (the parent's
@@ -118,6 +170,15 @@ def _run_measurement(mesh_spec: str | None = None) -> None:
     platform = setup_platform("auto")
     print("backend:", platform, flush=True)  # parent's probe watches this
     device_kind = jax.devices()[0].device_kind
+
+    # Micro-witness first on accelerators: a durable artifact lands within
+    # ~30 s of backend ack, so a tunnel window too short for the full
+    # fused bench still leaves a timestamped TPU number (VERDICT r4 #1b).
+    on_accel_now = platform in ("tpu", "gpu")
+    if fast == "only" or (fast == "first" and on_accel_now and mesh_spec is None):
+        _micro_witness(device_kind, platform)
+        if fast == "only":
+            return
 
     # batch/unroll sized for one chip (swept: B=512/iters=5 beats B=128/10
     # by ~21% — bigger batches keep the MXU busy between infeed boundaries);
@@ -262,11 +323,15 @@ def _mesh_device_total(mesh_spec: str) -> int:
 class _Child:
     """A supervised measurement subprocess with line-buffered stdout."""
 
-    def __init__(self, cpu: bool, mesh_spec: str | None = None) -> None:
+    def __init__(
+        self, cpu: bool, mesh_spec: str | None = None, fast: str | None = None
+    ) -> None:
         env = dict(os.environ)
         cmd = [sys.executable, str(Path(__file__).resolve()), "--run"]
         if mesh_spec:
             cmd += ["--mesh", mesh_spec]
+        if fast:
+            cmd += ["--fast-mode", fast]
         if cpu:
             env["JAX_PLATFORMS"] = "cpu"
             flags = env.get("XLA_FLAGS", "")
@@ -360,13 +425,22 @@ def _log_tpu_success(line: str) -> None:
         pass
 
 
-def main(mesh_spec: str | None = None) -> None:
+def _is_micro(line: str) -> bool:
+    return _is_json(line) and json.loads(line).get("metric") == "tpu_micro_witness_tflops"
+
+
+def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
     deadline = time.monotonic() + BUDGET_S
     errors: list[str] = []
 
     # CPU fallback starts now, in parallel — pinned to cpu so it never
-    # touches the tunnel; result is banked for the give-up path.
-    cpu_child = _Child(cpu=True, mesh_spec=mesh_spec)
+    # touches the tunnel; result is banked for the give-up path.  In
+    # --fast mode the fallback is the quick micro witness too: the whole
+    # point of the flag is an artifact in seconds, not the full fused
+    # CPU bench.
+    cpu_child = _Child(
+        cpu=True, mesh_spec=mesh_spec, fast="only" if fast_only else None
+    )
 
     # If the DRIVER's own timeout kills this process before the budget
     # elapses, still emit the one promised JSON line: print whatever the
@@ -420,7 +494,11 @@ def main(mesh_spec: str | None = None) -> None:
         probe_s = PROBE_SCHEDULE_S[min(probe_idx, len(PROBE_SCHEDULE_S) - 1)]
         probe_idx += 1
         probe_s = min(probe_s, max(deadline - time.monotonic() - 10, 15))
-        child = _Child(cpu=False, mesh_spec=mesh_spec)
+        child = _Child(
+            cpu=False,
+            mesh_spec=mesh_spec,
+            fast="only" if fast_only else "first",
+        )
         live_children.append(child)
         backend_line = child.wait_for(lambda l: l.startswith("backend:"), probe_s)
         if backend_line is None:
@@ -439,6 +517,24 @@ def main(mesh_spec: str | None = None) -> None:
             break
         measure_s = min(MEASURE_TIMEOUT_S, max(deadline - time.monotonic(), 60))
         json_line = child.wait_for(_is_json, measure_s)
+        if json_line is not None and _is_micro(json_line):
+            # bank the micro artifact THE MOMENT it lands — a tunnel drop
+            # during the full bench no longer loses the whole window
+            _log_tpu_success(json_line)
+            if fast_only:
+                tpu_line = json_line
+                child.kill()
+                break
+            micro = json_line
+            # recompute against the deadline: reusing the pre-micro
+            # measure_s would let the wait overrun BUDGET_S by a full
+            # MEASURE_TIMEOUT_S
+            measure_s = min(
+                MEASURE_TIMEOUT_S, max(deadline - time.monotonic(), 60)
+            )
+            json_line = child.wait_for(
+                lambda l: _is_json(l) and l != micro, measure_s
+            )
         if json_line is not None:
             tpu_line = json_line
             child.kill()
@@ -451,7 +547,8 @@ def main(mesh_spec: str | None = None) -> None:
 
     if tpu_line is not None:
         cpu_child.kill()
-        _log_tpu_success(tpu_line)
+        if not _is_micro(tpu_line):  # micro lines were banked on arrival
+            _log_tpu_success(tpu_line)
         _disarm()
         print(tpu_line)
         return
@@ -507,8 +604,11 @@ if __name__ == "__main__":
             import jax
 
             jax.config.update("jax_platforms", "cpu")
+        fast_mode = None
+        if "--fast-mode" in sys.argv[1:]:
+            fast_mode = sys.argv[sys.argv.index("--fast-mode") + 1]
         try:
-            _run_measurement(_argv_mesh())
+            _run_measurement(_argv_mesh(), fast=fast_mode)
         except Exception:  # noqa: BLE001 — parent needs the traceback on stderr
             import traceback
 
@@ -516,7 +616,7 @@ if __name__ == "__main__":
             sys.exit(1)
     else:
         try:
-            main(_argv_mesh())
+            main(_argv_mesh(), fast_only="--fast" in sys.argv[1:])
         except Exception as e:  # noqa: BLE001 — must always print one JSON line
             print(
                 json.dumps(
