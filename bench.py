@@ -489,6 +489,7 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
     signal.signal(signal.SIGTERM, _on_term)
 
     tpu_line = None
+    micro_banked = False
     probe_idx = 0
     while time.monotonic() < deadline - 30:
         probe_s = PROBE_SCHEDULE_S[min(probe_idx, len(PROBE_SCHEDULE_S) - 1)]
@@ -497,7 +498,10 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
         child = _Child(
             cpu=False,
             mesh_spec=mesh_spec,
-            fast="only" if fast_only else "first",
+            # once a micro artifact is banked this run, later attempts go
+            # straight to the full bench — no duplicate BENCH_TPU.md rows,
+            # no ~30 s of a possibly-short window re-measuring it
+            fast="only" if fast_only else (None if micro_banked else "first"),
         )
         live_children.append(child)
         backend_line = child.wait_for(lambda l: l.startswith("backend:"), probe_s)
@@ -521,6 +525,7 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
             # bank the micro artifact THE MOMENT it lands — a tunnel drop
             # during the full bench no longer loses the whole window
             _log_tpu_success(json_line)
+            micro_banked = True
             if fast_only:
                 tpu_line = json_line
                 child.kill()

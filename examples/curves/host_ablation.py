@@ -1,0 +1,206 @@
+"""Host-actor-plane Breakout ablation matrix (VERDICT r4 next-round #2).
+
+Round-4 standing result: the fused device loop crosses windowed return 20
+on Breakout at ~1M frames, while five host-plane runs (seeds/budgets/
+entropy/queue-depth varied) plateaued at the one-bounce-rally level
+(~3-5.6).  This harness isolates the cause by running one arm per
+hypothesis on the numpy-twin Breakout, all at the same budget and seed:
+
+- ``geom_1x16``  — 1 actor x 16 lanes, batch = ONE slot of 16 lanes,
+  minimal queue (depth 2).  This is the fused arm's exact data geometry
+  (16 distinct lanes per update, lag <= 1 learner step) on the host
+  plane; it is simultaneously the VERDICT's "fused hyperparameters
+  transplanted exactly" and "slot-queue depth 1" arm.
+- ``geom_4x4``   — 4 actors x 4 lanes: each update batches 4 slots from 4
+  different actors (decorrelated), vs the baseline's 2 slots from 2.
+- ``lag_rho1``   — baseline geometry, but behavior logits are replaced by
+  the target policy's own before each update (the off-policy-lag proof's
+  rho=1 trick, ``curves/impala.py:run_lagged_arm``): if V-trace's rho/c
+  clipping under queue lag is what starves the breakthrough, forcing
+  exact on-policyness removes it.
+- ``entropy_sched`` — baseline geometry, entropy cost annealed 0.03 ->
+  0.005 over 1M frames (``ImpalaArguments.entropy_cost_end``): high-early
+  exploration through the rally plateau, low-late exploitation.
+- ``bt_B32``     — batch 32 lanes (4 slots of 8): 640 frames/update.
+- ``bt_T10``     — unroll 10 (half the chunk): halves worst-case lag in
+  env steps and doubles update frequency at fixed frames/sec.
+
+Each arm records a TensorBoard curve (``work_dirs/learning_curves/
+host_ablation/<arm>/``) and a summary row; the combined matrix lands in
+``work_dirs/learning_curves/host_ablation.json`` and the conclusion in
+``docs/LEARNING_CURVES.md``.
+
+Run: ``python examples/curves/host_ablation.py [--arms a,b] [--max-frames N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # env vars are ignored under axon
+
+import numpy as np  # noqa: E402
+
+OUT_DIR = Path(__file__).resolve().parents[2] / "work_dirs" / "learning_curves"
+
+
+def run_host_breakout_arm(
+    arm: str,
+    num_actors: int = 2,
+    envs_per_actor: int = 8,
+    batch_size: int = 16,
+    rollout_length: int = 20,
+    num_buffers: int | None = None,
+    entropy_cost: float = 0.01,
+    entropy_cost_end: float | None = None,
+    entropy_anneal_frames: int = 0,
+    force_on_policy_rhos: bool = False,
+    max_frames: int = 1_500_000,
+    threshold: float = 20.0,
+    seed: int = 0,
+):
+    """One ablation arm of the host-plane Breakout protocol (the
+    ``impala_breakout_host`` recipe with the hypothesis knob exposed)."""
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.envs.synthetic_gym import register_synthetic_envs
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    from curves.common import _first_crossing
+
+    register_synthetic_envs()
+    n_slots = max(batch_size // envs_per_actor, 1)
+    if num_buffers is None:
+        num_buffers = max(2 * n_slots, num_actors)
+    args = ImpalaArguments(
+        env_id="BreakoutGym-v0",
+        rollout_length=rollout_length,
+        batch_size=batch_size,
+        num_actors=num_actors,
+        num_buffers=num_buffers,
+        use_lstm=False,
+        hidden_size=256,
+        learning_rate=1e-3,
+        entropy_cost=entropy_cost,
+        entropy_cost_end=entropy_cost_end,
+        entropy_anneal_frames=entropy_anneal_frames,
+        gamma=0.99,
+        seed=seed,
+        logger_backend="tensorboard",
+        logger_frequency=10_000,
+        work_dir=str(OUT_DIR / "host_ablation"),
+        project="",
+        save_model=False,
+        max_timesteps=max_frames,
+    )
+    args.validate()
+    agent = ImpalaAgent(
+        args, obs_shape=(10, 10, 1), num_actions=3, obs_dtype=np.uint8
+    )
+    if force_on_policy_rhos:
+        # the off-policy-lag proof's rho=1 substitution, applied to the
+        # live plane: recompute logits under the CURRENT params and store
+        # them as "behavior", so V-trace sees exactly-on-policy data and
+        # its rho/c clipping becomes inert.  Everything else is untouched.
+        model, base_learn = agent.model, agent._learn
+
+        @jax.jit
+        def learn_rho1(state, traj):
+            out, _ = model.apply(
+                state.params, traj.obs, traj.action, traj.reward,
+                traj.done, traj.core_state,
+            )
+            logits = jax.lax.stop_gradient(out.policy_logits)
+            logits = logits.at[-1].set(0.0)  # row T convention: unused
+            return base_learn(state, traj.replace(logits=logits))
+
+        agent._learn = learn_rho1
+
+    env_fns = [
+        (
+            lambda i=i: make_vect_envs(
+                "BreakoutGym-v0", num_envs=envs_per_actor, seed=seed + i,
+                async_envs=False,
+            )
+        )
+        for i in range(num_actors)
+    ]
+    # timestamped run dir: a deterministic name would stack a re-run's TB
+    # events next to the old run's, and _first_crossing would read both
+    trainer = HostActorLearnerTrainer(
+        args, agent, env_fns, run_name=f"host_ablation_{arm}_{int(time.time())}"
+    )
+    t0 = time.time()
+    result = trainer.train(total_frames=max_frames)
+    wall = time.time() - t0
+    hit_frames = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
+    trainer.close()
+    return {
+        "arm": arm,
+        "geometry": f"{num_actors}x{envs_per_actor} lanes, B={batch_size}, "
+        f"T={rollout_length}, buffers={num_buffers}",
+        "entropy": (
+            f"{entropy_cost}->{entropy_cost_end} over {entropy_anneal_frames}"
+            if entropy_cost_end is not None
+            else f"{entropy_cost}"
+        ),
+        "rho1": force_on_policy_rhos,
+        "threshold": threshold,
+        "final_return": round(result.get("return_mean", float("nan")), 2),
+        "frames": int(trainer.env_frames),
+        "frames_to_threshold": hit_frames,
+        "wall_s": round(wall, 1),
+        "fps": round(result.get("sps", float("nan")), 1),
+        "passed": hit_frames is not None,
+    }
+
+
+ARMS = {
+    "geom_1x16": dict(num_actors=1, envs_per_actor=16),
+    "geom_4x4": dict(num_actors=4, envs_per_actor=4),
+    "lag_rho1": dict(force_on_policy_rhos=True),
+    "entropy_sched": dict(
+        entropy_cost=0.03, entropy_cost_end=0.005, entropy_anneal_frames=1_000_000
+    ),
+    "bt_B32": dict(batch_size=32),
+    "bt_T10": dict(rollout_length=10),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--arms", default="all", help="comma list or 'all'")
+    p.add_argument("--max-frames", type=int, default=1_500_000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    names = list(ARMS) if args.arms == "all" else args.arms.split(",")
+    out_path = OUT_DIR / "host_ablation.json"
+    rows = []
+    if out_path.exists():  # resume: keep completed arms from a prior run
+        rows = [
+            r for r in json.loads(out_path.read_text()) if r["arm"] not in names
+        ]
+    for name in names:
+        print(f"=== arm {name} ===", flush=True)
+        row = run_host_breakout_arm(
+            name, max_frames=args.max_frames, seed=args.seed, **ARMS[name]
+        )
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        out_path.parent.mkdir(parents=True, exist_ok=True)
+        out_path.write_text(json.dumps(rows, indent=2) + "\n")
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -124,14 +124,33 @@ def make_impala_learn_fn(
     means are ``pmean``-ed.
     """
 
+    # optional linear entropy anneal (config: entropy_cost_end /
+    # entropy_anneal_frames), evaluated at the learner step inside the
+    # jitted update — same pattern as the LR schedule in
+    # make_impala_optimizer, so the annealed cost is traced, not baked
+    ent_schedule = None
+    end_cost = getattr(args, "entropy_cost_end", None)
+    anneal_frames = getattr(args, "entropy_anneal_frames", 0)
+    if end_cost is not None and anneal_frames > 0:
+        n_updates = max(
+            anneal_frames // (args.rollout_length * args.batch_size), 1
+        )
+        ent_schedule = optax.linear_schedule(
+            args.entropy_cost, end_cost, n_updates
+        )
+
     def learn(state: ImpalaTrainState, traj: Trajectory):
+        ent_cost = (
+            ent_schedule(state.step) if ent_schedule is not None
+            else args.entropy_cost
+        )
         (loss, metrics), grads = jax.value_and_grad(impala_loss, has_aux=True)(
             state.params,
             model,
             traj,
             discounting=args.discounting,
             baseline_cost=args.baseline_cost,
-            entropy_cost=args.entropy_cost,
+            entropy_cost=ent_cost,
             reward_clipping=args.reward_clipping,
             rho_clip=args.vtrace_rho_clip,
             c_clip=args.vtrace_c_clip,

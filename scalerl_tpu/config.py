@@ -410,6 +410,13 @@ class ImpalaArguments(RLArguments):
     reward_clipping: str = "abs_one"  # abs_one | none
     baseline_cost: float = 0.5
     entropy_cost: float = 0.01
+    # optional linear entropy anneal: cost goes entropy_cost ->
+    # entropy_cost_end over entropy_anneal_frames env frames (None/0 =
+    # constant, the reference's behavior).  High-early/low-late keeps
+    # exploration alive through a long incubation (the Breakout rally
+    # plateau) without paying a permanently noisy policy
+    entropy_cost_end: Optional[float] = None
+    entropy_anneal_frames: int = 0
     vtrace_rho_clip: float = 1.0
     vtrace_c_clip: float = 1.0
     # Optimiser (RMSProp parity, impala_atari.py:313-320)
