@@ -4,7 +4,9 @@ Round-4 standing result: the fused device loop crosses windowed return 20
 on Breakout at ~1M frames, while five host-plane runs (seeds/budgets/
 entropy/queue-depth varied) plateaued at the one-bounce-rally level
 (~3-5.6).  This harness isolates the cause by running one arm per
-hypothesis on the numpy-twin Breakout, all at the same budget and seed:
+hypothesis — all through THE shared recipe
+(``curves/impala.py:run_host_breakout_arm``, the same code path as the
+recorded baseline), same budget and seed:
 
 - ``geom_1x16``  — 1 actor x 16 lanes, batch = ONE slot of 16 lanes,
   minimal queue (depth 2).  This is the fused arm's exact data geometry
@@ -15,9 +17,8 @@ hypothesis on the numpy-twin Breakout, all at the same budget and seed:
   different actors (decorrelated), vs the baseline's 2 slots from 2.
 - ``lag_rho1``   — baseline geometry, but behavior logits are replaced by
   the target policy's own before each update (the off-policy-lag proof's
-  rho=1 trick, ``curves/impala.py:run_lagged_arm``): if V-trace's rho/c
-  clipping under queue lag is what starves the breakthrough, forcing
-  exact on-policyness removes it.
+  rho=1 trick): if V-trace's rho/c clipping under queue lag is what
+  starves the breakthrough, forcing exact on-policyness removes it.
 - ``entropy_sched`` — baseline geometry, entropy cost annealed 0.03 ->
   0.005 over 1M frames (``ImpalaArguments.entropy_cost_end``): high-early
   exploration through the rally plateau, low-late exploitation.
@@ -26,11 +27,13 @@ hypothesis on the numpy-twin Breakout, all at the same budget and seed:
   env steps and doubles update frequency at fixed frames/sec.
 
 Each arm records a TensorBoard curve (``work_dirs/learning_curves/
-host_ablation/<arm>/``) and a summary row; the combined matrix lands in
+host_ablation/``) and a summary row; the combined matrix lands in
 ``work_dirs/learning_curves/host_ablation.json`` and the conclusion in
 ``docs/LEARNING_CURVES.md``.
 
 Run: ``python examples/curves/host_ablation.py [--arms a,b] [--max-frames N]``
+Arms already present in the summary JSON are skipped (crash-resume);
+``--force`` re-runs them.
 """
 
 from __future__ import annotations
@@ -48,122 +51,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")  # env vars are ignored under axon
 
-import numpy as np  # noqa: E402
-
 OUT_DIR = Path(__file__).resolve().parents[2] / "work_dirs" / "learning_curves"
-
-
-def run_host_breakout_arm(
-    arm: str,
-    num_actors: int = 2,
-    envs_per_actor: int = 8,
-    batch_size: int = 16,
-    rollout_length: int = 20,
-    num_buffers: int | None = None,
-    entropy_cost: float = 0.01,
-    entropy_cost_end: float | None = None,
-    entropy_anneal_frames: int = 0,
-    force_on_policy_rhos: bool = False,
-    max_frames: int = 1_500_000,
-    threshold: float = 20.0,
-    seed: int = 0,
-):
-    """One ablation arm of the host-plane Breakout protocol (the
-    ``impala_breakout_host`` recipe with the hypothesis knob exposed)."""
-    from scalerl_tpu.agents.impala import ImpalaAgent
-    from scalerl_tpu.config import ImpalaArguments
-    from scalerl_tpu.envs import make_vect_envs
-    from scalerl_tpu.envs.synthetic_gym import register_synthetic_envs
-    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
-
-    from curves.common import _first_crossing
-
-    register_synthetic_envs()
-    n_slots = max(batch_size // envs_per_actor, 1)
-    if num_buffers is None:
-        num_buffers = max(2 * n_slots, num_actors)
-    args = ImpalaArguments(
-        env_id="BreakoutGym-v0",
-        rollout_length=rollout_length,
-        batch_size=batch_size,
-        num_actors=num_actors,
-        num_buffers=num_buffers,
-        use_lstm=False,
-        hidden_size=256,
-        learning_rate=1e-3,
-        entropy_cost=entropy_cost,
-        entropy_cost_end=entropy_cost_end,
-        entropy_anneal_frames=entropy_anneal_frames,
-        gamma=0.99,
-        seed=seed,
-        logger_backend="tensorboard",
-        logger_frequency=10_000,
-        work_dir=str(OUT_DIR / "host_ablation"),
-        project="",
-        save_model=False,
-        max_timesteps=max_frames,
-    )
-    args.validate()
-    agent = ImpalaAgent(
-        args, obs_shape=(10, 10, 1), num_actions=3, obs_dtype=np.uint8
-    )
-    if force_on_policy_rhos:
-        # the off-policy-lag proof's rho=1 substitution, applied to the
-        # live plane: recompute logits under the CURRENT params and store
-        # them as "behavior", so V-trace sees exactly-on-policy data and
-        # its rho/c clipping becomes inert.  Everything else is untouched.
-        model, base_learn = agent.model, agent._learn
-
-        @jax.jit
-        def learn_rho1(state, traj):
-            out, _ = model.apply(
-                state.params, traj.obs, traj.action, traj.reward,
-                traj.done, traj.core_state,
-            )
-            logits = jax.lax.stop_gradient(out.policy_logits)
-            logits = logits.at[-1].set(0.0)  # row T convention: unused
-            return base_learn(state, traj.replace(logits=logits))
-
-        agent._learn = learn_rho1
-
-    env_fns = [
-        (
-            lambda i=i: make_vect_envs(
-                "BreakoutGym-v0", num_envs=envs_per_actor, seed=seed + i,
-                async_envs=False,
-            )
-        )
-        for i in range(num_actors)
-    ]
-    # timestamped run dir: a deterministic name would stack a re-run's TB
-    # events next to the old run's, and _first_crossing would read both
-    trainer = HostActorLearnerTrainer(
-        args, agent, env_fns, run_name=f"host_ablation_{arm}_{int(time.time())}"
-    )
-    t0 = time.time()
-    result = trainer.train(total_frames=max_frames)
-    wall = time.time() - t0
-    hit_frames = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
-    trainer.close()
-    return {
-        "arm": arm,
-        "geometry": f"{num_actors}x{envs_per_actor} lanes, B={batch_size}, "
-        f"T={rollout_length}, buffers={num_buffers}",
-        "entropy": (
-            f"{entropy_cost}->{entropy_cost_end} over {entropy_anneal_frames}"
-            if entropy_cost_end is not None
-            else f"{entropy_cost}"
-        ),
-        "rho1": force_on_policy_rhos,
-        "threshold": threshold,
-        "final_return": round(result.get("return_mean", float("nan")), 2),
-        "frames": int(trainer.env_frames),
-        "frames_to_threshold": hit_frames,
-        "wall_s": round(wall, 1),
-        "fps": round(result.get("sps", float("nan")), 1),
-        "passed": hit_frames is not None,
-    }
-
 
 ARMS = {
     "geom_1x16": dict(num_actors=1, envs_per_actor=16),
@@ -178,24 +66,38 @@ ARMS = {
 
 
 def main() -> None:
+    from curves.impala import run_host_breakout_arm
+
     p = argparse.ArgumentParser()
     p.add_argument("--arms", default="all", help="comma list or 'all'")
     p.add_argument("--max-frames", type=int, default=1_500_000)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--force", action="store_true",
+        help="re-run arms already present in host_ablation.json",
+    )
     args = p.parse_args()
     names = list(ARMS) if args.arms == "all" else args.arms.split(",")
     out_path = OUT_DIR / "host_ablation.json"
-    rows = []
-    if out_path.exists():  # resume: keep completed arms from a prior run
-        rows = [
-            r for r in json.loads(out_path.read_text()) if r["arm"] not in names
-        ]
-    for name in names:
+    rows = json.loads(out_path.read_text()) if out_path.exists() else []
+    done = {r["arm"] for r in rows}
+    to_run = [n for n in names if args.force or n not in done]
+    for skipped in set(names) - set(to_run):
+        print(f"=== arm {skipped}: already recorded, skipping (--force to re-run)")
+    for name in to_run:
         print(f"=== arm {name} ===", flush=True)
         row = run_host_breakout_arm(
-            name, max_frames=args.max_frames, seed=args.seed, **ARMS[name]
+            name,
+            max_frames=args.max_frames,
+            seed=args.seed,
+            work_dir=OUT_DIR / "host_ablation",
+            # timestamped run dir: a deterministic name would stack a
+            # re-run's TB events next to the old run's, and the crossing
+            # scan would read both
+            run_name=f"host_ablation_{name}_{int(time.time())}",
+            **ARMS[name],
         )
-        rows.append(row)
+        rows = [r for r in rows if r["arm"] != name] + [row]
         print(json.dumps(row), flush=True)
         out_path.parent.mkdir(parents=True, exist_ok=True)
         out_path.write_text(json.dumps(rows, indent=2) + "\n")

@@ -401,26 +401,33 @@ def impala_breakout(
     )
 
 
-def impala_breakout_host(
+def run_host_breakout_arm(
+    arm: str,
     num_actors: int = 2,
     envs_per_actor: int = 8,
-    max_frames: int = 3_000_000,
+    batch_size: int = 16,
+    rollout_length: int = 20,
+    num_buffers: int | None = None,
+    entropy_cost: float = 0.01,
+    entropy_cost_end: float | None = None,
+    entropy_anneal_frames: int = 0,
+    force_on_policy_rhos: bool = False,
+    max_frames: int = 1_500_000,
     threshold: float = 20.0,
     seed: int = 0,
+    work_dir=None,
+    run_name: str | None = None,
 ):
-    """Host actor plane (SEED-style central inference) on the numpy twin
-    of Breakout — the same wall-clock-to-score protocol on the CPU-env
-    topology, so both planes have a recorded time-to-threshold.
+    """THE host-plane Breakout recipe, parameterized — shared by the
+    recorded baseline (:func:`impala_breakout_host`) and every arm of the
+    ablation matrix (``examples/curves/host_ablation.py``), so the
+    "same protocol" claim is one code path, not two that can drift.
 
-    Honest-negative note (round 4): Breakout has a long incubation — BOTH
-    planes learn the one-bounce rally (~4.5/episode, >10x random) within
-    ~200k frames, but crossing 20 needs a stochastic breakthrough (staying
-    under the rebound for repeated catches).  The fused arm hit it at
-    ~950k frames; five host-plane runs (seeds 0/1/7, budgets 600k-3M,
-    entropy 0.01-0.03, queue depths 4-32 slots) plateaued at the rally
-    level (3.1-5.6) without the breakthrough.  Recorded as a miss rather
-    than re-rolled until lucky — the curve artifact shows the plateau
-    either way."""
+    ``force_on_policy_rhos``: the off-policy-lag proof's rho=1 trick
+    (:func:`run_lagged_arm`) applied to the live plane — behavior logits
+    are recomputed under the CURRENT params before each update, making
+    V-trace's rho/c clipping inert.
+    """
     from scalerl_tpu.agents.impala import ImpalaAgent
     from scalerl_tpu.config import ImpalaArguments
     from scalerl_tpu.envs import make_vect_envs
@@ -428,29 +435,48 @@ def impala_breakout_host(
     from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
 
     register_synthetic_envs()
+    n_slots = max(batch_size // envs_per_actor, 1)
+    if num_buffers is None:
+        # minimal slot queue: depth IS worst-case policy lag (the old
+        # 2*batch_size floor compared slots to lanes — 16x too deep)
+        num_buffers = max(2 * n_slots, num_actors)
     args = ImpalaArguments(
         env_id="BreakoutGym-v0",
-        rollout_length=20,
-        batch_size=16,
+        rollout_length=rollout_length,
+        batch_size=batch_size,
         num_actors=num_actors,
-        # minimal slot queue: depth IS worst-case policy lag (the old
-        # 2*batch_size floor compared slots to lanes — a 16x-too-deep queue)
-        num_buffers=4,
+        num_buffers=num_buffers,
         use_lstm=False,
         hidden_size=256,
         learning_rate=1e-3,
-        entropy_cost=0.01,
+        entropy_cost=entropy_cost,
+        entropy_cost_end=entropy_cost_end,
+        entropy_anneal_frames=entropy_anneal_frames,
         gamma=0.99,
         seed=seed,
         logger_backend="tensorboard",
         logger_frequency=10_000,
-        work_dir=str(OUT_DIR),
+        work_dir=str(work_dir if work_dir is not None else OUT_DIR),
         project="",
         save_model=False,
         max_timesteps=max_frames,
     )
     args.validate()
     agent = ImpalaAgent(args, obs_shape=(10, 10, 1), num_actions=3, obs_dtype=np.uint8)
+    if force_on_policy_rhos:
+        model, base_learn = agent.model, agent._learn
+
+        @jax.jit
+        def learn_rho1(state, traj):
+            out, _ = model.apply(
+                state.params, traj.obs, traj.action, traj.reward,
+                traj.done, traj.core_state,
+            )
+            logits = jax.lax.stop_gradient(out.policy_logits)
+            logits = logits.at[-1].set(0.0)  # row T convention: unused
+            return base_learn(state, traj.replace(logits=logits))
+
+        agent._learn = learn_rho1
     env_fns = [
         (
             lambda i=i: make_vect_envs(
@@ -461,7 +487,7 @@ def impala_breakout_host(
         for i in range(num_actors)
     ]
     trainer = HostActorLearnerTrainer(
-        args, agent, env_fns, run_name="impala_breakout_host"
+        args, agent, env_fns, run_name=run_name or f"host_breakout_{arm}"
     )
     t0 = time.time()
     result = trainer.train(total_frames=max_frames)
@@ -469,17 +495,66 @@ def impala_breakout_host(
     hit_frames = _first_crossing(trainer.tb_log_dir, "train/return_mean", threshold)
     trainer.close()
     return {
-        "experiment": "impala_breakout_host",
-        "env": "BreakoutGym-v0 (numpy twin)",
-        "algo": "IMPALA (host actor plane, central inference)",
+        "arm": arm,
+        "geometry": f"{num_actors}x{envs_per_actor} lanes, B={batch_size}, "
+        f"T={rollout_length}, buffers={num_buffers}",
+        "entropy": (
+            f"{entropy_cost}->{entropy_cost_end} over {entropy_anneal_frames}"
+            if entropy_cost_end is not None
+            else f"{entropy_cost}"
+        ),
+        "rho1": force_on_policy_rhos,
         "threshold": threshold,
-        "optimal_return": 62.0,
         "final_return": round(result.get("return_mean", float("nan")), 2),
         "frames": int(trainer.env_frames),
         "frames_to_threshold": hit_frames,
         "wall_s": round(wall, 1),
         "fps": round(result.get("sps", float("nan")), 1),
         "passed": hit_frames is not None,
+    }
+
+
+def impala_breakout_host(
+    num_actors: int = 2,
+    envs_per_actor: int = 8,
+    max_frames: int = 3_000_000,
+    threshold: float = 20.0,
+    seed: int = 0,
+):
+    """Host actor plane (SEED-style central inference) on the numpy twin
+    of Breakout — the same wall-clock-to-score protocol on the CPU-env
+    topology, so both planes have a recorded time-to-threshold.  Delegates
+    to :func:`run_host_breakout_arm` (the single shared recipe).
+
+    Honest-negative note (round 4): Breakout has a long incubation — BOTH
+    planes learn the one-bounce rally (~4.5/episode, >10x random) within
+    ~200k frames, but crossing 20 needs a stochastic breakthrough (staying
+    under the rebound for repeated catches).  The fused arm hit it at
+    ~950k frames; five host-plane runs (seeds 0/1/7, budgets 600k-3M,
+    entropy 0.01-0.03, queue depths 4-32 slots) plateaued at the rally
+    level (3.1-5.6) without the breakthrough.  Round 5's ablation matrix
+    (``examples/curves/host_ablation.py``) isolates the cause."""
+    row = run_host_breakout_arm(
+        "baseline",
+        num_actors=num_actors,
+        envs_per_actor=envs_per_actor,
+        max_frames=max_frames,
+        threshold=threshold,
+        seed=seed,
+        run_name="impala_breakout_host",
+    )
+    return {
+        "experiment": "impala_breakout_host",
+        "env": "BreakoutGym-v0 (numpy twin)",
+        "algo": "IMPALA (host actor plane, central inference)",
+        "threshold": row["threshold"],
+        "optimal_return": 62.0,
+        "final_return": row["final_return"],
+        "frames": row["frames"],
+        "frames_to_threshold": row["frames_to_threshold"],
+        "wall_s": row["wall_s"],
+        "fps": row["fps"],
+        "passed": row["passed"],
     }
 
 

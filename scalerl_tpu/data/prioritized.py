@@ -88,16 +88,17 @@ def per_sample(
     beta: jnp.ndarray,
     n_step: int = 1,
     gamma: float = 0.99,
-    method: str = "hierarchical",
+    method: str = "auto",
 ) -> Dict[str, jnp.ndarray]:
     """Stratified proportional sample; returns transitions + ``weights``.
 
     The distribution is ``p_i^alpha`` over valid logical rows (those with a
     full n-step window).  ``method`` picks the search implementation
-    (``ops/pallas_per.py``): ``cumsum`` is SURVEY.md §7's plan A,
-    ``hierarchical`` a two-level XLA search that avoids materializing the
-    full-capacity cumsum, ``pallas`` the TPU kernel with scalar-prefetched
-    block DMA.
+    (``ops/pallas_per.py``): ``auto`` (default) resolves to the Pallas
+    kernel on TPU and the hierarchical XLA search elsewhere; ``cumsum`` is
+    SURVEY.md §7's plan A, ``hierarchical`` the two-level XLA search that
+    avoids materializing the full-capacity cumsum, ``pallas`` the TPU
+    kernel with scalar-prefetched block DMA.
     """
     from scalerl_tpu.ops.pallas_per import proportional_sample
 
@@ -170,7 +171,7 @@ class PrioritizedReplayBuffer:
         n_step: int = 1,
         gamma: float = 0.99,
         extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
-        sample_method: str = "hierarchical",
+        sample_method: str = "auto",
         action_shape: Tuple[int, ...] = (),
         action_dtype: jnp.dtype = jnp.int32,
     ) -> None:

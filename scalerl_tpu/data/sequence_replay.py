@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from scalerl_tpu.ops.pallas_per import hierarchical_sample
+from scalerl_tpu.ops.pallas_per import proportional_sample
 
 
 @struct.dataclass
@@ -106,7 +106,7 @@ def seq_sample(
     u = jax.random.uniform(key, (batch_size,))
     # stratified targets over the live mass
     targets = (jnp.arange(batch_size) + u) / batch_size * total
-    idx = hierarchical_sample(scaled, targets)
+    idx = proportional_sample(scaled, targets, method="auto")
 
     probs = scaled[idx] / jnp.maximum(total, 1e-9)
     n = jnp.maximum(state.size.astype(jnp.float32), 1.0)

@@ -55,7 +55,7 @@ from scalerl_tpu.data.sequence_replay import (
     seq_init,
     seq_update_priorities_keep_empty,
 )
-from scalerl_tpu.ops.pallas_per import hierarchical_sample, proportional_sample
+from scalerl_tpu.ops.pallas_per import proportional_sample
 
 
 def replay_shard_axes(mesh) -> Tuple[str, ...]:
@@ -225,7 +225,7 @@ class ShardedPrioritizedReplay:
 
             u = jax.random.uniform(key, (b_local,))
             targets = (jnp.arange(b_local) + u) / b_local * m_local
-            flat_logical = proportional_sample(flat_p, targets, method="hierarchical")
+            flat_logical = proportional_sample(flat_p, targets, method="auto")
 
             # per-draw probability under the two-level scheme
             q = flat_p[flat_logical] / jnp.maximum(m_local, 1e-12) / n_shards
@@ -323,7 +323,7 @@ def seq_sample_sharded_local(
     m_local = jnp.sum(scaled)
     u = jax.random.uniform(key, (b_local,))
     targets = (jnp.arange(b_local) + u) / b_local * m_local
-    idx = hierarchical_sample(scaled, targets)
+    idx = proportional_sample(scaled, targets, method="auto")
 
     q = scaled[idx] / jnp.maximum(m_local, 1e-9) / n_shards
     size = state.size if global_size is None else global_size

@@ -109,13 +109,50 @@ def pallas_sample(
     return jnp.clip(out[:, 0], 0, flat_p.shape[0] - 1)
 
 
+_SAMPLE_METHODS = ("cumsum", "hierarchical", "pallas")
+
+
+def resolve_sample_method(method: str = "auto") -> str:
+    """Resolve ``"auto"`` to the best concrete method for this backend.
+
+    TPU -> ``pallas`` (the scalar-prefetch kernel; top-level and
+    shard_map'd legality covered by ``tests_tpu/test_compiled_kernels.py``),
+    anything else -> ``hierarchical`` (pure XLA, runs everywhere).
+    Resolution happens at trace time — ``jax.default_backend()`` is the
+    backend the jitted program will run on in a single-backend process.
+    The env var ``SCALERL_PER_METHOD`` overrides what ``auto`` resolves to
+    (e.g. ``hierarchical`` to back out the kernel on TPU without touching
+    call sites); an explicitly pinned method always wins, so tests that
+    compare methods stay meaningful under the override.
+    """
+    import os
+
+    if method != "auto":
+        if method not in _SAMPLE_METHODS:
+            raise ValueError(
+                f"unknown sampling method {method!r}; use one of "
+                f"{('auto',) + _SAMPLE_METHODS}"
+            )
+        return method
+    forced = os.environ.get("SCALERL_PER_METHOD")
+    if forced:
+        if forced not in _SAMPLE_METHODS:
+            raise ValueError(
+                f"SCALERL_PER_METHOD={forced!r} is not one of {_SAMPLE_METHODS}"
+            )
+        return forced
+    return "pallas" if jax.default_backend() == "tpu" else "hierarchical"
+
+
 def proportional_sample(
     flat_p: jnp.ndarray,
     targets: jnp.ndarray,
-    method: str = "hierarchical",
+    method: str = "auto",
     block_size: int = 1024,
 ) -> jnp.ndarray:
-    """Dispatch: ``cumsum`` (flat plan A), ``hierarchical``, or ``pallas``."""
+    """Dispatch: ``auto`` (backend-resolved), ``cumsum`` (flat plan A),
+    ``hierarchical``, or ``pallas``."""
+    method = resolve_sample_method(method)
     if method == "cumsum":
         cum = jnp.cumsum(flat_p)
         idx = jnp.searchsorted(cum, targets, side="left")

@@ -93,8 +93,16 @@ def test_pallas_per_sample_compiled():
     ref = hierarchical_sample(flat_p, targets, block_size=1024)
     np.testing.assert_array_equal(np.asarray(compiled), np.asarray(ref))
     # and both agree with the O(n) cumsum reference
-    ref2 = proportional_sample(flat_p, targets)
+    ref2 = proportional_sample(flat_p, targets, method="cumsum")
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(ref2))
+    # on this backend the default "auto" must route to the Pallas kernel
+    # (VERDICT r4 #7: the flagship Ape-X/R2D2 paths use it the day
+    # hardware answers), and produce the same sample
+    from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+    assert resolve_sample_method("auto") == "pallas"
+    auto = proportional_sample(flat_p, targets)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
 
 
 def test_fused_loop_one_chunk_on_tpu():
@@ -180,10 +188,11 @@ def test_device_r2d2_fused_iteration_on_tpu():
 
 def test_sharded_replay_on_tpu_mesh():
     """Lane-sharded PER sampling under shard_map compiles on the TPU mesh
-    (psum/pmax weight normalization + per-shard stratified draws).  Skips
-    on a single-chip tunnel — the sharded path needs >= 2 devices."""
-    if jax.device_count() < 2:
-        pytest.skip("sharded replay needs >= 2 TPU devices")
+    (psum/pmax weight normalization + per-shard stratified draws).  On a
+    single-chip tunnel this runs at dp=1 — one shard, but the lowering is
+    the real composition the flagship paths use: the Pallas sample kernel
+    (``auto`` resolves to it on TPU) inside shard_map with the size-1
+    collectives, so hardware day can't be the first time it traces."""
     from scalerl_tpu.data.sharded_replay import ShardedPrioritizedReplay
     from scalerl_tpu.parallel import make_mesh
 
