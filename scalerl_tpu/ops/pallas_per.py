@@ -159,9 +159,8 @@ def proportional_sample(
         return jnp.clip(idx, 0, flat_p.shape[0] - 1).astype(jnp.int32)
     if method == "hierarchical":
         return hierarchical_sample(flat_p, targets, block_size)
-    if method == "pallas":
-        return pallas_sample(flat_p, targets, block_size)
-    raise ValueError(f"unknown sampling method {method!r}")
+    # resolve_sample_method validated; only "pallas" remains
+    return pallas_sample(flat_p, targets, block_size)
 
 
 @functools.partial(jax.jit, static_argnames=("method", "block_size"))

@@ -104,9 +104,10 @@ def test_auto_method_resolution(monkeypatch):
     assert resolve_sample_method("auto") == "hierarchical"
 
 
-def test_auto_equals_hierarchical_on_cpu():
+def test_auto_equals_hierarchical_on_cpu(monkeypatch):
     """The flipped defaults are behavior-preserving off-TPU: a per_sample
     with method='auto' returns the identical batch to 'hierarchical'."""
+    monkeypatch.delenv("SCALERL_PER_METHOD", raising=False)
     buf = PrioritizedReplayBuffer(obs_shape=(4,), capacity=128, num_envs=2)
     rng = np.random.default_rng(3)
     for i in range(50):
