@@ -221,11 +221,13 @@ def main() -> None:
         from scalerl_tpu.utils.platform import setup_platform
 
         setup_platform(args.platform)
-    stacks = [
-        s for s in args.stacks
-        if not (args.env == "pixel" and s == "shm-multi")
-    ]
     print(f"env throughput: env={args.env} num_envs={args.num_envs} steps={args.steps}")
+    stacks = []
+    for s in args.stacks:
+        if args.env == "pixel" and s == "shm-multi":
+            print(f"  {s:<12} SKIPPED (cartpole-toy-only stack)")
+            continue
+        stacks.append(s)
     results = {}
     for name in stacks:
         try:

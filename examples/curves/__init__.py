@@ -20,7 +20,7 @@ from curves.impala import (
     impala_synthetic,
     impala_synthetic_northstar,
 )
-from curves.marl import marl_pursuit_iql
+from curves.marl import marl_pursuit_iql, marl_pursuit_v4
 from curves.onpolicy import a3c_cartpole, ppo_cartpole, ppo_recall_lstm
 from curves.r2d2 import r2d2_recall, r2d2_recall_device
 from curves.transformer import transformer_recall
@@ -44,5 +44,6 @@ EXPERIMENTS = {
     "ppo_cartpole": ppo_cartpole,
     "dqn_cartpole": dqn_cartpole,
     "marl_pursuit_iql": marl_pursuit_iql,
+    "marl_pursuit_v4": marl_pursuit_v4,
     "transformer_recall": transformer_recall,
 }

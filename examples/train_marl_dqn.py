@@ -76,6 +76,106 @@ def evaluate_matchup(
     return caught / episodes, float(np.mean(lengths))
 
 
+def train_iql(
+    venv,
+    make_agent_args,  # (index, name) -> DQNArguments
+    obs_shape: Tuple[int, ...],
+    n_actions: int,
+    max_steps: int,
+    batch_size: int = 64,
+    warmup: int = 500,
+    train_frequency: int = 4,
+    seed: int = 0,
+    on_window=None,
+) -> Dict:
+    """THE independent-Q-learning loop over the async multi-agent plane —
+    shared by the toy-pursuit example and the real-PettingZoo pursuit_v4
+    curve (one code path; a fix here serves both).
+
+    Truncation handling: the async workers autoreset and stash the true
+    terminal observation in ``infos[i]["final_observation"]`` — the replay
+    must see THAT as ``next_obs`` at episode ends, not the fresh reset
+    observation (bootstrapping ``r + gamma * maxQ(reset_obs)`` against an
+    unrelated state biases Q-values at every episode boundary).
+
+    ``on_window(frames, per_agent_returns, team_return)`` fires every 500
+    steps.  Returns a dict with the trained ``agents``, per-agent and team
+    return windows, and throughput numbers.
+    """
+    from scalerl_tpu.agents.dqn import DQNAgent
+    from scalerl_tpu.data.sampler import Sampler
+
+    names = list(venv.agents)
+    num_envs = venv.num_envs
+    agents: Dict[str, DQNAgent] = {}
+    samplers: Dict[str, Sampler] = {}
+    for i, name in enumerate(names):
+        args = make_agent_args(i, name)
+        agents[name] = DQNAgent(args, obs_shape=obs_shape, action_dim=n_actions)
+        samplers[name] = Sampler(
+            obs_shape=obs_shape, capacity=args.buffer_size, num_envs=num_envs,
+            n_step=1, gamma=args.gamma,
+        )
+
+    obs, _ = venv.reset(seed=seed)
+    ep_ret = {a: np.zeros(num_envs) for a in names}
+    window: Dict[str, list] = {a: [] for a in names}
+    team_ep = np.zeros(num_envs)
+    team_window: list = []
+    t0 = time.time()
+    for step in range(max_steps):
+        actions = {a: np.asarray(agents[a].get_action(obs[a])) for a in names}
+        next_obs, rew, term, trunc, infos = venv.step(actions)
+        done = {a: np.logical_or(term[a], trunc[a]) for a in names}
+        # replay must bootstrap from the TRUE terminal obs at episode ends
+        store_next = dict(next_obs)
+        for i, info in enumerate(infos):
+            fin = info.get("final_observation") if info else None
+            if fin is not None:
+                for a in names:
+                    if store_next[a] is next_obs[a]:
+                        store_next[a] = np.array(next_obs[a])
+                    store_next[a][i] = fin[a]
+        team_step = np.zeros(num_envs)
+        for a in names:
+            samplers[a].add(
+                obs[a], store_next[a], actions[a], rew[a], term[a],
+                boundary=done[a],
+            )
+            agents[a].update_exploration(num_envs)
+            ep_ret[a] += rew[a]
+            team_step += rew[a]
+            for i in np.nonzero(done[a])[0]:
+                window[a].append(ep_ret[a][i])
+                ep_ret[a][i] = 0.0
+        team_ep += team_step
+        all_done = np.all([done[a] for a in names], axis=0)
+        for i in np.nonzero(all_done)[0]:
+            team_window.append(team_ep[i])
+            team_ep[i] = 0.0
+        obs = next_obs
+        if step >= warmup and step % train_frequency == 0:
+            for a in names:
+                agents[a].learn(samplers[a].sample(batch_size))
+        if on_window is not None and step and step % 500 == 0:
+            returns = {
+                a: float(np.mean(window[a][-200:])) if window[a] else 0.0
+                for a in names
+            }
+            team = float(np.mean(team_window[-50:])) if team_window else 0.0
+            on_window(step * num_envs, returns, team)
+
+    wall = time.time() - t0
+    return {
+        "agents": agents,
+        "window": window,
+        "team_window": team_window,
+        "wall_s": wall,
+        "env_frames": max_steps * num_envs,
+        "fps": round(max_steps * num_envs / max(wall, 1e-9), 1),
+    }
+
+
 def run_marl(
     num_envs: int = 8,
     max_steps: int = 4000,  # env steps per lane -> num_envs * this transitions
@@ -90,18 +190,14 @@ def run_marl(
     ``on_window(step, returns_dict)`` fires every 500 steps with each
     agent's windowed mean episode return (the curve hook).
     """
-    from scalerl_tpu.agents.dqn import DQNAgent
     from scalerl_tpu.config import DQNArguments
-    from scalerl_tpu.data.sampler import Sampler
     from scalerl_tpu.envs.multi_agent import PursuitToyEnv, make_multi_agent_vec_env
 
     venv = make_multi_agent_vec_env(PursuitToyEnv, num_envs=num_envs)
     try:
-        agent_names = list(venv.agents)
-        agents: Dict[str, DQNAgent] = {}
-        samplers: Dict[str, Sampler] = {}
-        for i, name in enumerate(agent_names):
-            args = DQNArguments(
+        t = train_iql(
+            venv,
+            lambda i, name: DQNArguments(
                 env_id="PursuitToy-v0",
                 hidden_sizes="64,64",
                 buffer_size=50_000,
@@ -114,45 +210,20 @@ def run_marl(
                 logger_backend="none",
                 save_model=False,
                 seed=seed + 17 * i,
-            )
-            agents[name] = DQNAgent(args, obs_shape=(4,), action_dim=3)
-            samplers[name] = Sampler(
-                obs_shape=(4,), capacity=args.buffer_size, num_envs=num_envs,
-                n_step=1, gamma=args.gamma,
-            )
-
-        obs, _ = venv.reset(seed=seed)
-        ep_ret = {a: np.zeros(num_envs) for a in agent_names}
-        window: Dict[str, list] = {a: [] for a in agent_names}
-        t0 = time.time()
-        for step in range(max_steps):
-            actions = {a: np.asarray(agents[a].get_action(obs[a])) for a in agent_names}
-            next_obs, rew, term, trunc, _ = venv.step(actions)
-            done = {
-                a: np.logical_or(term[a], trunc[a]) for a in agent_names
-            }
-            for a in agent_names:
-                samplers[a].add(
-                    obs[a], next_obs[a], actions[a], rew[a], term[a],
-                    boundary=done[a],
-                )
-                agents[a].update_exploration(num_envs)
-                ep_ret[a] += rew[a]
-                for i in np.nonzero(done[a])[0]:
-                    window[a].append(ep_ret[a][i])
-                    ep_ret[a][i] = 0.0
-            obs = next_obs
-            if step >= warmup and step % train_frequency == 0:
-                for a in agent_names:
-                    agents[a].learn(samplers[a].sample(batch_size))
-            if on_window is not None and step and step % 500 == 0:
-                returns = {
-                    a: float(np.mean(window[a][-200:])) if window[a] else 0.0
-                    for a in agent_names
-                }
-                on_window(step * num_envs, returns)
-
-        wall = time.time() - t0
+            ),
+            obs_shape=(4,),
+            n_actions=3,
+            max_steps=max_steps,
+            batch_size=batch_size,
+            warmup=warmup,
+            train_frequency=train_frequency,
+            seed=seed,
+            on_window=(
+                None if on_window is None
+                else lambda f, returns, team: on_window(f, returns)
+            ),
+        )
+        agents, window, wall = t["agents"], t["window"], t["wall_s"]
         chaser, runner = agents["chaser"], agents["runner"]
         rate_cr, len_cr = evaluate_matchup(chaser.predict, None, seed=seed + 1)
         rate_rr, len_rr = evaluate_matchup(None, None, seed=seed + 2)
@@ -163,7 +234,7 @@ def run_marl(
             "fps": round(max_steps * num_envs / wall, 1),
             "final_returns": {
                 a: float(np.mean(window[a][-200:])) if window[a] else 0.0
-                for a in agent_names
+                for a in agents
             },
             # the MARL evidence: trained chaser catches much FASTER than a
             # random one; trained runner gets caught far LESS often

@@ -37,6 +37,12 @@ from scalerl_tpu.parallel.sharding import (  # noqa: F401
     shard_params,
     trajectory_sharding,
 )
+from scalerl_tpu.parallel.pipeline import (  # noqa: F401
+    hetero_sequential_apply,
+    make_hetero_pipeline_apply,
+    make_pipeline_apply,
+    sequential_apply,
+)
 from scalerl_tpu.parallel.train_step import (  # noqa: F401
     enable_offpolicy_mesh,
     make_parallel_act_fn,
