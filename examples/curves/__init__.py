@@ -11,6 +11,7 @@ from curves.continuous import sac_pendulum, td3_pendulum
 from curves.dqn import dqn_cartpole
 from curves.impala import (
     impala_breakout,
+    impala_breakout_84,
     impala_breakout_host,
     impala_cartpole,
     impala_catch,
@@ -30,6 +31,7 @@ EXPERIMENTS = {
     "impala_synthetic_northstar": impala_synthetic_northstar,
     "impala_catch": impala_catch,
     "impala_breakout": impala_breakout,
+    "impala_breakout_84": impala_breakout_84,
     "impala_breakout_host": impala_breakout_host,
     "impala_pong_ale": impala_pong_ale,
     "impala_cartpole": impala_cartpole,

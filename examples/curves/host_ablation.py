@@ -64,9 +64,17 @@ ARMS = {
     "bt_T10": dict(rollout_length=10),
 }
 
+# lag-isolation arms: the FUSED loop with an artificially stale behavior
+# snapshot (everything else identical to the passing impala_breakout) —
+# run via curves.impala.run_fused_lagged_breakout, not the host recipe
+FUSED_LAG_ARMS = {
+    "fused_lag1": dict(pull_every=1),  # control: == the fused loop
+    "fused_lag2": dict(pull_every=2),  # one chunk of lag (host-plane floor)
+}
+
 
 def main() -> None:
-    from curves.impala import run_host_breakout_arm
+    from curves.impala import run_fused_lagged_breakout, run_host_breakout_arm
 
     p = argparse.ArgumentParser()
     p.add_argument("--arms", default="all", help="comma list or 'all'")
@@ -77,7 +85,8 @@ def main() -> None:
         help="re-run arms already present in host_ablation.json",
     )
     args = p.parse_args()
-    names = list(ARMS) if args.arms == "all" else args.arms.split(",")
+    all_arms = {**ARMS, **FUSED_LAG_ARMS}
+    names = list(all_arms) if args.arms == "all" else args.arms.split(",")
     out_path = OUT_DIR / "host_ablation.json"
     rows = json.loads(out_path.read_text()) if out_path.exists() else []
     done = {r["arm"] for r in rows}
@@ -86,17 +95,23 @@ def main() -> None:
         print(f"=== arm {skipped}: already recorded, skipping (--force to re-run)")
     for name in to_run:
         print(f"=== arm {name} ===", flush=True)
-        row = run_host_breakout_arm(
-            name,
-            max_frames=args.max_frames,
-            seed=args.seed,
-            work_dir=OUT_DIR / "host_ablation",
-            # timestamped run dir: a deterministic name would stack a
-            # re-run's TB events next to the old run's, and the crossing
-            # scan would read both
-            run_name=f"host_ablation_{name}_{int(time.time())}",
-            **ARMS[name],
-        )
+        if name in FUSED_LAG_ARMS:
+            row = run_fused_lagged_breakout(
+                name, max_frames=args.max_frames, seed=args.seed,
+                **FUSED_LAG_ARMS[name],
+            )
+        else:
+            row = run_host_breakout_arm(
+                name,
+                max_frames=args.max_frames,
+                seed=args.seed,
+                work_dir=OUT_DIR / "host_ablation",
+                # timestamped run dir: a deterministic name would stack a
+                # re-run's TB events next to the old run's, and the
+                # crossing scan would read both
+                run_name=f"host_ablation_{name}_{int(time.time())}",
+                **ARMS[name],
+            )
         rows = [r for r in rows if r["arm"] != name] + [row]
         print(json.dumps(row), flush=True)
         out_path.parent.mkdir(parents=True, exist_ok=True)

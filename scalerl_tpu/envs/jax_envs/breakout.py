@@ -62,18 +62,29 @@ class JaxBreakout(JaxEnv):
         brick_rows: int = 3,
         brick_top: int = 2,
         max_steps: int = 500,
+        render_size: int | None = None,
     ) -> None:
+        """``render_size``: render observations upscaled (nearest-neighbor)
+        to ``render_size`` x ``render_size`` — identical game DYNAMICS at
+        ALE's 84x84 observation scale, so the wall-clock-to-score protocol
+        prices the conv torso at the north-star shape (VERDICT r4 #6).
+        ALE Breakout is itself a small machine state rendered big; this is
+        the same separation."""
         if brick_top + brick_rows >= size - 2:
             raise ValueError("brick wall must leave room above the paddle row")
+        if render_size is not None and render_size < size:
+            raise ValueError("render_size must be >= the logical grid size")
         self.size = size
         self.stack = stack
         self.brick_rows = brick_rows
         self.brick_top = brick_top
         self.max_steps = max_steps
+        self.render_size = render_size
 
     @property
     def observation_shape(self) -> Tuple[int, ...]:
-        return (self.size, self.size, self.stack)
+        side = self.render_size or self.size
+        return (side, side, self.stack)
 
     @property
     def observation_dtype(self):
@@ -97,6 +108,11 @@ class JaxBreakout(JaxEnv):
         ball = (rows == state.ball_y) & (cols == state.ball_x)
         paddle = (rows == self.size - 1) & (jnp.abs(cols - state.paddle_x) <= 1)
         frame = jnp.where(ball | paddle, jnp.uint8(255), frame)
+        if self.render_size is not None:
+            # nearest-neighbor upscale: gather rows/cols by the index map
+            # (pure gathers — XLA fuses this into the consumer)
+            idx = (jnp.arange(self.render_size) * self.size) // self.render_size
+            frame = frame[idx][:, idx]
         return jnp.broadcast_to(frame[:, :, None], self.observation_shape)
 
     def _spawn(self, key: jax.Array) -> BreakoutState:

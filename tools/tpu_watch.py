@@ -85,6 +85,11 @@ def run_payload(n_devices: int = 1) -> None:
         ("bench", [sys.executable, "bench.py"], 1500, env),
         ("tests_tpu", [sys.executable, "-m", "pytest", "tests_tpu", "-q"], 1800, env),
         ("profile", [sys.executable, "examples/profile_fused_loop.py"], 1200, env),
+        # the ALE-scale flagship curve: ~4M frames is under a minute at the
+        # witnessed single-chip rate, so a held tunnel records the
+        # wall-clock-to-score protocol at the north-star pixel shape
+        ("breakout84", [sys.executable, "examples/learning_curves.py",
+                        "impala_breakout_84", "--tpu"], 1800, env),
     ]
     if n_devices > 1:  # aggregate north-star shape, only when multi-chip
         steps.insert(
@@ -105,7 +110,15 @@ def run_payload(n_devices: int = 1) -> None:
                 bl.write(f"[watcher] {name} failed: {e}\n")
     log_probe(f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done (see BENCH_TPU.md)")
     try:
-        subprocess.run(["git", "add", "BENCH_TPU.md", "TPU_PROBELOG.md"], cwd=REPO)
+        subprocess.run(
+            # summary.json lives under gitignored work_dirs/ but is
+            # force-tracked (the docs table is generated from it — the two
+            # committed artifacts must stay in step)
+            ["git", "add", "-f", "BENCH_TPU.md", "TPU_PROBELOG.md",
+             "docs/LEARNING_CURVES.md",
+             "work_dirs/learning_curves/summary.json"],
+            cwd=REPO,
+        )
         subprocess.run(
             ["git", "commit", "-m", "Record witnessed TPU bench artifacts"], cwd=REPO
         )
