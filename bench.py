@@ -139,6 +139,85 @@ def _micro_witness(device_kind: str, platform: str) -> None:
     print(json.dumps(result), flush=True)
 
 
+def _run_learn_measurement() -> None:
+    """Learner-step-only benchmark: MFU of the IMPALA training update.
+
+    The fused-loop MFU (~0.9% witnessed) is env-step/HBM-bound by design
+    — most of its wall-clock is the pixel env scan, not matmuls.  This
+    mode isolates the LEARN step (AtariNet forward + V-trace + backward +
+    RMSProp over a [T+1, B] trajectory at the north-star shape, bf16
+    torso) and reports ITS throughput and MFU — the number comparable to
+    supervised-training MFU figures.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.data.trajectory import Trajectory
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+
+    T = 20
+    B = 256 if on_accel else 8
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=512, rollout_length=T, batch_size=B,
+        max_timesteps=0,
+        compute_dtype="bfloat16" if on_accel else "float32",
+    )
+    agent = ImpalaAgent(args, obs_shape=(84, 84, 4), num_actions=6)
+    learn = agent.make_learn_fn()
+    key = jax.random.PRNGKey(0)
+    traj = Trajectory(
+        obs=jax.random.randint(key, (T + 1, B, 84, 84, 4), 0, 255, jnp.uint8),
+        action=jax.random.randint(key, (T + 1, B), 0, 6, jnp.int32),
+        reward=jax.random.normal(key, (T + 1, B), jnp.float32),
+        done=jnp.zeros((T + 1, B), jnp.bool_),
+        logits=jax.random.normal(key, (T + 1, B, 6), jnp.float32),
+        core_state=agent.initial_state(B),
+    )
+    flops_per_step = None
+    run_fn = jax.jit(learn)
+    try:
+        compiled = jax.jit(learn).lower(agent.state, traj).compile()
+        flops_per_step = _cost_analysis_flops(compiled)
+        run_fn = compiled
+    except Exception:  # noqa: BLE001 — keep the jit path, no MFU
+        pass
+    state, m = run_fn(agent.state, traj)
+    float(m["total_loss"])  # sync through a host fetch (tunnel-safe)
+    target_s = 15.0 if on_accel else 4.0
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < target_s or steps < 2:
+        state, m = run_fn(state, traj)
+        steps += 1
+        float(m["total_loss"])
+    elapsed = time.perf_counter() - t0
+    frames = steps * T * B
+    result = {
+        "metric": "impala_learn_step_frames_per_sec",
+        "value": round(frames / elapsed, 1),
+        "unit": f"train frames/sec ({platform})",
+        "device_kind": device_kind,
+        "batch": B,
+        "unroll": T,
+        "steps_per_sec": round(steps / elapsed, 2),
+        "measured_s": round(elapsed, 1),
+    }
+    if flops_per_step is not None:
+        achieved = flops_per_step * steps / elapsed
+        result["achieved_tflops_per_s"] = round(achieved / 1e12, 2)
+        peak = _peak_flops(device_kind)
+        if peak is not None:
+            result["mfu"] = round(achieved / peak, 4)
+    print(json.dumps(result), flush=True)
+
+
 def _run_measurement(
     mesh_spec: str | None = None, fast: str | None = None
 ) -> None:
@@ -324,7 +403,11 @@ class _Child:
     """A supervised measurement subprocess with line-buffered stdout."""
 
     def __init__(
-        self, cpu: bool, mesh_spec: str | None = None, fast: str | None = None
+        self,
+        cpu: bool,
+        mesh_spec: str | None = None,
+        fast: str | None = None,
+        learn: bool = False,
     ) -> None:
         env = dict(os.environ)
         cmd = [sys.executable, str(Path(__file__).resolve()), "--run"]
@@ -332,6 +415,8 @@ class _Child:
             cmd += ["--mesh", mesh_spec]
         if fast:
             cmd += ["--fast-mode", fast]
+        if learn:
+            cmd += ["--learn-run"]
         if cpu:
             env["JAX_PLATFORMS"] = "cpu"
             flags = env.get("XLA_FLAGS", "")
@@ -429,9 +514,21 @@ def _is_micro(line: str) -> bool:
     return _is_json(line) and json.loads(line).get("metric") == "tpu_micro_witness_tflops"
 
 
-def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
+def main(
+    mesh_spec: str | None = None,
+    fast_only: bool = False,
+    learn: bool = False,
+) -> None:
     deadline = time.monotonic() + BUDGET_S
     errors: list[str] = []
+    # failure artifacts must carry the metric of the mode that FAILED —
+    # a dead --learn run labeled as the fused env-fps bench would record
+    # a bogus zero datapoint under the flagship metric
+    fail_metric = (
+        "impala_learn_step_frames_per_sec" if learn
+        else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
+        else "impala_atari_env_frames_per_sec_per_chip"
+    )
 
     # CPU fallback starts now, in parallel — pinned to cpu so it never
     # touches the tunnel; result is banked for the give-up path.  In
@@ -439,7 +536,8 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
     # point of the flag is an artifact in seconds, not the full fused
     # CPU bench.
     cpu_child = _Child(
-        cpu=True, mesh_spec=mesh_spec, fast="only" if fast_only else None
+        cpu=True, mesh_spec=mesh_spec,
+        fast="only" if fast_only else None, learn=learn,
     )
 
     # If the DRIVER's own timeout kills this process before the budget
@@ -462,9 +560,9 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
             print(
                 json.dumps(
                     {
-                        "metric": "impala_atari_env_frames_per_sec_per_chip",
+                        "metric": fail_metric,
                         "value": 0.0,
-                        "unit": "frames/sec/chip (unavailable)",
+                        "unit": "unavailable",
                         "vs_baseline": 0.0,
                         "error": "killed before any measurement finished: "
                         + "; ".join(errors)[-400:],
@@ -500,8 +598,13 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
             mesh_spec=mesh_spec,
             # once a micro artifact is banked this run, later attempts go
             # straight to the full bench — no duplicate BENCH_TPU.md rows,
-            # no ~30 s of a possibly-short window re-measuring it
-            fast="only" if fast_only else (None if micro_banked else "first"),
+            # no ~30 s of a possibly-short window re-measuring it.  Learn
+            # mode has its own single program; no micro phase.
+            fast=(
+                None if learn
+                else ("only" if fast_only else (None if micro_banked else "first"))
+            ),
+            learn=learn,
         )
         live_children.append(child)
         backend_line = child.wait_for(lambda l: l.startswith("backend:"), probe_s)
@@ -579,9 +682,9 @@ def main(mesh_spec: str | None = None, fast_only: bool = False) -> None:
     print(
         json.dumps(
             {
-                "metric": "impala_atari_env_frames_per_sec_per_chip",
+                "metric": fail_metric,
                 "value": 0.0,
-                "unit": "frames/sec/chip (unavailable)",
+                "unit": "unavailable",
                 "vs_baseline": 0.0,
                 "error": "; ".join(errors)[-800:],
             }
@@ -613,7 +716,10 @@ if __name__ == "__main__":
         if "--fast-mode" in sys.argv[1:]:
             fast_mode = sys.argv[sys.argv.index("--fast-mode") + 1]
         try:
-            _run_measurement(_argv_mesh(), fast=fast_mode)
+            if "--learn-run" in sys.argv[1:]:
+                _run_learn_measurement()
+            else:
+                _run_measurement(_argv_mesh(), fast=fast_mode)
         except Exception:  # noqa: BLE001 — parent needs the traceback on stderr
             import traceback
 
@@ -621,12 +727,20 @@ if __name__ == "__main__":
             sys.exit(1)
     else:
         try:
-            main(_argv_mesh(), fast_only="--fast" in sys.argv[1:])
+            main(
+                _argv_mesh(),
+                fast_only="--fast" in sys.argv[1:],
+                learn="--learn" in sys.argv[1:],
+            )
         except Exception as e:  # noqa: BLE001 — must always print one JSON line
             print(
                 json.dumps(
                     {
-                        "metric": "impala_atari_env_frames_per_sec_per_chip",
+                        "metric": (
+                            "impala_learn_step_frames_per_sec"
+                            if "--learn" in sys.argv[1:]
+                            else "impala_atari_env_frames_per_sec_per_chip"
+                        ),
                         "value": 0.0,
                         "unit": "frames/sec/chip (unavailable)",
                         "vs_baseline": 0.0,

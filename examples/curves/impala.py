@@ -654,14 +654,13 @@ def impala_breakout_host(
     topology, so both planes have a recorded time-to-threshold.  Delegates
     to :func:`run_host_breakout_arm` (the single shared recipe).
 
-    Honest-negative note (round 4): Breakout has a long incubation — BOTH
-    planes learn the one-bounce rally (~4.5/episode, >10x random) within
-    ~200k frames, but crossing 20 needs a stochastic breakthrough (staying
-    under the rebound for repeated catches).  The fused arm hit it at
-    ~950k frames; five host-plane runs (seeds 0/1/7, budgets 600k-3M,
-    entropy 0.01-0.03, queue depths 4-32 slots) plateaued at the rally
-    level (3.1-5.6) without the breakthrough.  Round 5's ablation matrix
-    (``examples/curves/host_ablation.py``) isolates the cause."""
+    History: seven round-4/5 runs at T=20 plateaued at the one-bounce
+    rally level (2-5.6) while the fused loop crossed 20 at ~1M frames.
+    Round 5's ablation matrix (``examples/curves/host_ablation.py``,
+    table in docs/LEARNING_CURVES.md) isolated chunk-scale behavior
+    staleness as the cause — one chunk of T=20 lag collapses even the
+    fused loop to the same plateau — and with T=10 this recipe CROSSES:
+    threshold 20 at ~847k frames, final return 45.0 at 2M (recorded)."""
     row = run_host_breakout_arm(
         "baseline",
         num_actors=num_actors,
