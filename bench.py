@@ -184,9 +184,12 @@ def _run_learn_measurement() -> None:
     run_fn = jax.jit(learn)
     try:
         compiled = jax.jit(learn).lower(agent.state, traj).compile()
-        flops_per_step = _cost_analysis_flops(compiled)
+        # keep the executable BEFORE attempting cost analysis: a failing
+        # cost_analysis must not discard the compile and force a second
+        # full compile inside a possibly-short tunnel window
         run_fn = compiled
-    except Exception:  # noqa: BLE001 — keep the jit path, no MFU
+        flops_per_step = _cost_analysis_flops(compiled)
+    except Exception:  # noqa: BLE001 — whatever run_fn holds still works
         pass
     state, m = run_fn(agent.state, traj)
     float(m["total_loss"])  # sync through a host fetch (tunnel-safe)
@@ -726,6 +729,11 @@ if __name__ == "__main__":
             traceback.print_exc()
             sys.exit(1)
     else:
+        if "--learn" in sys.argv[1:] and _argv_mesh() is not None:
+            raise SystemExit(
+                "--learn --mesh is not supported: the learn bench measures "
+                "one device (run bench.py --mesh for the multi-chip shape)"
+            )
         try:
             main(
                 _argv_mesh(),
@@ -739,10 +747,12 @@ if __name__ == "__main__":
                         "metric": (
                             "impala_learn_step_frames_per_sec"
                             if "--learn" in sys.argv[1:]
+                            else "impala_atari_env_frames_per_sec_aggregate"
+                            if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"
                         ),
                         "value": 0.0,
-                        "unit": "frames/sec/chip (unavailable)",
+                        "unit": "unavailable",
                         "vs_baseline": 0.0,
                         "error": f"orchestrator: {type(e).__name__}: {e}"[:800],
                     }

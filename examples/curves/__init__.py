@@ -22,7 +22,12 @@ from curves.impala import (
     impala_synthetic_northstar,
 )
 from curves.marl import marl_pursuit_iql, marl_pursuit_v4
-from curves.onpolicy import a3c_cartpole, ppo_cartpole, ppo_recall_lstm
+from curves.onpolicy import (
+    a3c_cartpole,
+    a3c_fleet_cartpole,
+    ppo_cartpole,
+    ppo_recall_lstm,
+)
 from curves.r2d2 import r2d2_recall, r2d2_recall_device
 from curves.transformer import transformer_recall
 
@@ -43,6 +48,7 @@ EXPERIMENTS = {
     "sac_pendulum": sac_pendulum,
     "td3_pendulum": td3_pendulum,
     "a3c_cartpole": a3c_cartpole,
+    "a3c_fleet_cartpole": a3c_fleet_cartpole,
     "ppo_cartpole": ppo_cartpole,
     "dqn_cartpole": dqn_cartpole,
     "marl_pursuit_iql": marl_pursuit_iql,

@@ -1,0 +1,37 @@
+"""Async-gradient A3C over the fleet (the Ray-variant counterpart).
+
+Parity: ``scalerl/algorithms/a3c/ray_a3c.py:27-127`` — remote actors
+compute gradients, a central driver applies them asynchronously and
+republishes weights.  Here that protocol runs over the framework's own
+fleet layer; these tests drive it end to end with real worker processes.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+
+
+def test_a3c_fleet_async_gradient_protocol():
+    """Plumbing: fleet workers return real gradients, the server applies
+    every one of them (updates == tasks), and the weight version advances
+    past the initial publish — the async republish loop is live."""
+    from train_a3c_fleet import train_a3c_fleet
+
+    s = train_a3c_fleet(num_workers=2, total_frames=6_000, unroll=16,
+                        num_envs=4, seed=3)
+    assert s["applied_updates"] >= 90  # 6000 // (16*4) == 93 tasks
+    assert s["weight_version"] == s["applied_updates"] + 1
+    assert s["env_frames"] >= 5_700
+
+
+@pytest.mark.slow
+def test_a3c_fleet_learns_cartpole():
+    """The async protocol genuinely LEARNS: windowed return climbs well
+    past random (~20) within a modest budget."""
+    from train_a3c_fleet import train_a3c_fleet
+
+    s = train_a3c_fleet(num_workers=2, total_frames=150_000, seed=0)
+    assert s["windowed_return"] > 100.0, s
