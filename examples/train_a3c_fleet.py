@@ -56,23 +56,36 @@ def _a3c_grad_runner(task, weights, worker_id):
         from scalerl_tpu.envs import make_jax_vec_env
 
         args = A3CArguments(
-            hidden_size=int(task["hidden_size"]),
+            hidden_sizes=str(task["hidden_sizes"]),
             gamma=float(task["gamma"]),
             gae_lambda=float(task["gae_lambda"]),
             value_loss_coef=float(task["value_loss_coef"]),
             entropy_coef=float(task["entropy_coef"]),
         )
-        model = build_model(args, obs_shape=(4,), num_actions=2)
         venv = make_jax_vec_env(task["env_id"], int(task["num_envs"]))
+        # derive shapes from the env the worker actually built — a
+        # mismatched hardcode would surface as an opaque XLA shape error
+        # deep inside the jitted scan
+        model = build_model(
+            args, obs_shape=venv.observation_shape,
+            num_actions=venv.num_actions,
+        )
 
         def rollout_and_grad(params, env_state, obs, last_action, reward,
                              done, ep_ret, key, unroll):
-            """One [T+1, B] on-policy chunk + grad, all one jitted fn."""
+            """One [T+1, B] on-policy chunk + grad, all one jitted fn.
+
+            Row 0 is the CARRIED boundary state (the previous chunk's
+            bootstrap row), and the scan steps exactly ``unroll`` times —
+            the OnPolicyTrainer overlap convention, so no transition is
+            ever dropped between chunks and frames == T * B exactly.
+            """
             import jax.numpy as jnp
 
             from scalerl_tpu.data.trajectory import Trajectory
 
             B = obs.shape[0]
+            row0 = (obs, last_action, reward, done)
 
             def step(carry, _):
                 env_state, obs, last_action, reward, done, ep_ret, key = carry
@@ -82,22 +95,29 @@ def _a3c_grad_runner(task, weights, worker_id):
                     done[None], (),
                 )
                 action = jax.random.categorical(akey, out.policy_logits[0])
-                row = (obs, last_action, reward, done)
                 env_state, nobs, nrew, ndone = venv.step(env_state, action, skey)
+                nrew = nrew.astype(jnp.float32)
                 ep_ret = ep_ret + nrew
                 ep_done_ret = jnp.where(ndone, ep_ret, 0.0)
                 ep_ret = jnp.where(ndone, 0.0, ep_ret)
                 carry = (env_state, nobs, action.astype(jnp.int32),
-                         nrew.astype(jnp.float32), ndone, ep_ret, key)
+                         nrew, ndone, ep_ret, key)
+                row = (nobs, action.astype(jnp.int32), nrew, ndone)
                 return carry, (row, ep_done_ret, ndone.astype(jnp.float32))
 
             carry = (env_state, obs, last_action, reward, done, ep_ret, key)
-            carry, ((obs_t, act_t, rew_t, done_t), ep_rets, ep_dones) = (
-                jax.lax.scan(step, carry, None, length=unroll + 1)
+            carry, ((obs_s, act_s, rew_s, done_s), ep_rets, ep_dones) = (
+                jax.lax.scan(step, carry, None, length=unroll)
             )
+            obs_t = jnp.concatenate([row0[0][None], obs_s])
+            act_t = jnp.concatenate([row0[1][None], act_s])
+            rew_t = jnp.concatenate([row0[2][None], rew_s])
+            done_t = jnp.concatenate([row0[3][None], done_s])
             traj = Trajectory(
                 obs=obs_t, action=act_t, reward=rew_t, done=done_t,
-                logits=jnp.zeros((unroll + 1, B, 2), jnp.float32),  # unused by a3c_loss
+                logits=jnp.zeros(
+                    (unroll + 1, B, venv.num_actions), jnp.float32
+                ),  # unused by a3c_loss
                 core_state=(),
             )
             (loss, metrics), grads = jax.value_and_grad(
@@ -113,7 +133,7 @@ def _a3c_grad_runner(task, weights, worker_id):
         _WORKER_STATE["fn"] = jax.jit(
             rollout_and_grad, static_argnames=("unroll",)
         )
-        key = jax.random.PRNGKey(1000 + worker_id)
+        key = jax.random.PRNGKey(int(task["seed"]) * 4096 + 1000 + worker_id)
         env_state, obs = venv.reset(key)
         B = int(task["num_envs"])
         import jax.numpy as jnp
@@ -137,7 +157,6 @@ def _a3c_grad_runner(task, weights, worker_id):
         "frames": T * B,
         "return_sum": float(ret_sum),
         "episode_count": float(ep_count),
-        "param_version": task.get("param_version", 0),
     }
 
 
@@ -147,7 +166,7 @@ def train_a3c_fleet(
     num_envs: int = 4,
     unroll: int = 32,
     learning_rate: float = 3e-3,
-    hidden_size: int = 64,
+    hidden_sizes: str = "128,128",
     entropy_coef: float = 0.01,
     seed: int = 0,
     on_window=None,
@@ -173,7 +192,7 @@ def train_a3c_fleet(
     from scalerl_tpu.fleet import FleetConfig, LocalCluster, WorkerServer
 
     args = A3CArguments(
-        hidden_size=hidden_size, learning_rate=learning_rate,
+        hidden_sizes=hidden_sizes, learning_rate=learning_rate,
         entropy_coef=entropy_coef, seed=seed,
     )
     model = build_model(args, obs_shape=(4,), num_actions=2)
@@ -196,7 +215,8 @@ def train_a3c_fleet(
     n_tasks = max(total_frames // frames_per_task, 1)
     task_template = {
         "role": "rollout", "env_id": "CartPole-v1", "num_envs": num_envs,
-        "unroll": unroll, "hidden_size": hidden_size, "gamma": args.gamma,
+        "unroll": unroll, "hidden_sizes": hidden_sizes, "seed": seed,
+        "gamma": args.gamma,
         "gae_lambda": args.gae_lambda,
         "value_loss_coef": args.value_loss_coef,
         "entropy_coef": entropy_coef,
@@ -224,14 +244,24 @@ def train_a3c_fleet(
     t0 = time.time()
     frames = 0
     applied = 0
+    idle = 0
     ret_sum = ep_count = 0.0
     prev_sum = prev_cnt = 0.0
     windowed = 0.0
     try:
         while applied < n_tasks:
-            r = server.get_result(timeout=120.0)
+            r = server.get_result(timeout=1.0)
             if r is None:
-                break  # workers went quiet: surface what we have
+                if not server.worker_errors.empty():
+                    err = server.worker_errors.get()
+                    raise RuntimeError(
+                        f"fleet worker failed: {err.get('error')}"
+                    )
+                idle += 1
+                if idle >= 120:
+                    break  # workers went quiet for ~2 min: surface what we have
+                continue
+            idle = 0
             grads = jax.tree_util.tree_map(jnp.asarray, r["grads"])
             params, opt_state = apply_grads(params, opt_state, grads)
             applied += 1
@@ -249,6 +279,14 @@ def train_a3c_fleet(
     finally:
         cluster.join()
         server.stop()
+    # final window: episodes since the last %20 tick must not be dropped
+    # (short runs would otherwise report 0.0 regardless of learning), and
+    # the curve hook must see it too — a crossing in the tail would
+    # otherwise record passed=False with final_return over the threshold
+    if ep_count > prev_cnt:
+        windowed = (ret_sum - prev_sum) / (ep_count - prev_cnt)
+        if on_window is not None:
+            on_window(frames, windowed)
     wall = time.time() - t0
     return {
         "applied_updates": applied,
