@@ -280,8 +280,18 @@ def _run_measurement(
             )
     # CPU-fallback mesh runs exist to prove the code path, not to measure
     # (8 virtual devices on one core): shrink so they finish in the
-    # parent's give-up window
-    B_chip = 512 if on_accel else (8 if mesh is None else 4)
+    # parent's give-up window.  BENCH_B overrides the accelerator batch
+    # (the watcher sweeps it on tunnel contact: the 98k fps witness used
+    # 512; more lanes may amortize the env scan further)
+    if on_accel:
+        try:
+            B_chip = int(os.environ.get("BENCH_B", "512"))
+        except ValueError:
+            # a malformed override must degrade to the known-good batch,
+            # not crash every post-ack attempt and forfeit the window
+            B_chip = 512
+    else:
+        B_chip = 8 if mesh is None else 4
     B = B_chip * (n_dev if mesh is not None else 1)
     T = 20
     iters_per_call = 5 if on_accel else 1
@@ -603,8 +613,11 @@ def main(
             # straight to the full bench — no duplicate BENCH_TPU.md rows,
             # no ~30 s of a possibly-short window re-measuring it.  Learn
             # mode has its own single program; no micro phase.
+            # BENCH_SKIP_MICRO: the dedup is process-local, so payload
+            # steps AFTER the banking bench-fast step set it to spend
+            # their whole post-ack window on their own measurement.
             fast=(
-                None if learn
+                None if learn or os.environ.get("BENCH_SKIP_MICRO")
                 else ("only" if fast_only else (None if micro_banked else "first"))
             ),
             learn=learn,

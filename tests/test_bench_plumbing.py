@@ -1,0 +1,139 @@
+"""bench.py orchestrator plumbing (the driver-facing artifact).
+
+The real measurement needs the TPU tunnel; these tests drive the
+PARENT's logic — probe/bank/escalate sequencing and the one-JSON-line
+contract — against a scripted child, so a regression in the orchestration
+(the part that must convert a brief tunnel window into a committed
+artifact) is caught on CPU.
+"""
+
+import importlib.util
+import io
+import contextlib
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench_mod", ROOT / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+MICRO = json.dumps({
+    "metric": "tpu_micro_witness_tflops", "value": 123.0,
+    "unit": "TFLOP/s bf16 matmul (tpu)", "device_kind": "TPU v5 lite",
+})
+HEAD = json.dumps({
+    "metric": "impala_atari_env_frames_per_sec_per_chip", "value": 90000.0,
+    "unit": "frames/sec/chip (tpu)", "vs_baseline": 14.4,
+})
+
+
+class _FakeChild:
+    """Scripted stand-in for the measurement subprocess: backend ack,
+    then micro line, then headline line, arriving over time."""
+
+    def __init__(self, cpu, mesh_spec=None, fast=None, learn=False):
+        self.cpu = cpu
+        self.fast = fast
+        self.lines = []
+        self.proc = type(
+            "P", (),
+            {"poll": lambda s: None, "returncode": None,
+             "kill": lambda s: None,
+             "wait": lambda s, timeout=None: 0},
+        )()
+        if not cpu:
+            script = [("backend: tpu", 0.0)]
+            if fast is not None:
+                script.append((MICRO, 0.05))
+            if fast != "only":
+                script.append((HEAD, 0.15))
+
+            def feed():
+                for line, dt in script:
+                    time.sleep(dt)
+                    self.lines.append(line)
+
+            threading.Thread(target=feed, daemon=True).start()
+
+    def wait_for(self, pred, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            for line in self.lines:
+                if pred(line):
+                    return line
+            time.sleep(0.01)
+        return None
+
+    def kill(self):
+        pass
+
+    def error_tail(self):
+        return ""
+
+
+def _run_main(bench, **kwargs):
+    banked = []
+    bench._Child = _FakeChild
+    bench._log_tpu_success = banked.append
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        bench.main(None, **kwargs)
+    printed = [l for l in out.getvalue().strip().splitlines() if l]
+    return printed, banked
+
+
+def test_full_mode_banks_micro_then_prints_headline():
+    bench = _load_bench()
+    printed, banked = _run_main(bench)
+    assert len(printed) == 1, printed  # the one-JSON-line contract
+    assert json.loads(printed[0])["metric"] == (
+        "impala_atari_env_frames_per_sec_per_chip"
+    )
+    # micro banked the moment it landed; headline banked at the end
+    assert len(banked) == 2 and "micro" in banked[0], banked
+
+
+def test_fast_only_mode_prints_and_banks_micro_once():
+    bench = _load_bench()
+    printed, banked = _run_main(bench, fast_only=True)
+    assert len(printed) == 1, printed
+    assert json.loads(printed[0])["metric"] == "tpu_micro_witness_tflops"
+    assert banked == [MICRO], banked  # exactly once, no double-log
+
+
+def test_cpu_backend_falls_through_to_pinned_cpu_child():
+    """When the probe answers 'backend: cpu' (no accelerator behind the
+    tunnel), the orchestrator must break to the CPU-fallback path rather
+    than waiting out the measurement window."""
+    bench = _load_bench()
+
+    class CpuAckChild(_FakeChild):
+        def __init__(self, cpu, mesh_spec=None, fast=None, learn=False):
+            super().__init__(True, mesh_spec, fast, learn)
+            if not cpu:
+                self.lines = ["backend: cpu"]
+            else:
+                # the pinned-CPU fallback banks a result immediately
+                self.lines = [HEAD.replace("tpu", "cpu")]
+
+    banked = []
+    bench._Child = CpuAckChild
+    bench._log_tpu_success = banked.append
+    out = io.StringIO()
+    t0 = time.monotonic()
+    with contextlib.redirect_stdout(out):
+        bench.main(None)
+    assert time.monotonic() - t0 < 30.0  # no measurement-window stall
+    printed = [l for l in out.getvalue().strip().splitlines() if l]
+    assert len(printed) == 1
+    assert json.loads(printed[0])["value"] == 90000.0
+    assert banked == []  # CPU results are not TPU artifacts

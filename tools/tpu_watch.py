@@ -83,7 +83,15 @@ def run_payload(n_devices: int = 1) -> None:
         # --fast first: banks a BENCH_TPU.md artifact within ~60 s of
         # contact, before the long steps gamble on the tunnel staying up
         ("bench-fast", [sys.executable, "bench.py", "--fast"], 450, fast_env),
-        ("bench", [sys.executable, "bench.py"], 1500, env),
+        # bench-fast above already banked the micro row: later bench
+        # steps skip the micro phase and spend their post-ack window on
+        # their own measurement (BENCH_SKIP_MICRO; process-local dedup)
+        ("bench", [sys.executable, "bench.py"], 1500,
+         dict(env, BENCH_SKIP_MICRO="1")),
+        # batch sweep: the 98k fps witness used B=512; if the tunnel holds,
+        # try more lanes (banked to BENCH_TPU.md like any TPU success)
+        ("bench-B1024", [sys.executable, "bench.py"], 1500,
+         dict(env, BENCH_B="1024", BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
         ("bench-learn", [sys.executable, "bench.py", "--learn"], 1500, env),
