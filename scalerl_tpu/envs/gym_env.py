@@ -11,7 +11,7 @@ infeed wants (SURVEY.md §2.2).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 import gymnasium as gym
 
@@ -24,6 +24,7 @@ def make_gym_env(
     video_dir: Optional[str] = None,
     atari: bool = False,
     normalize_obs: bool = False,
+    wrappers: Optional[Sequence[Callable[[gym.Env], gym.Env]]] = None,
     **env_kwargs,
 ) -> Callable[[], gym.Env]:
     """Return a thunk building one env (thunks are what vector ctors want).
@@ -32,6 +33,11 @@ def make_gym_env(
     ``"pkg.module:ClassName"`` path — the latter imports and constructs the
     class with ``env_kwargs``, no registration required (handy for custom
     envs in spawned actor processes, whose registries start fresh).
+
+    ``wrappers``: callables applied outermost-last, after the built-in
+    chain — the generic form of the reference's skill-wrapper factory
+    (``env_utils.py:109-120``, ``make_skill_vect_envs``).  Under async
+    vector envs they must be picklable (module-level classes/functions).
     """
 
     def thunk() -> gym.Env:
@@ -65,6 +71,8 @@ def make_gym_env(
             from scalerl_tpu.envs.atari import NormalizedEnv
 
             env = NormalizedEnv(env)
+        for wrap in wrappers or ():
+            env = wrap(env)
         env.action_space.seed(seed + idx)
         return env
 

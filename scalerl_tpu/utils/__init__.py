@@ -1,5 +1,9 @@
 from scalerl_tpu.utils.logging import get_logger  # noqa: F401
-from scalerl_tpu.utils.metrics import EpisodeMetrics, calculate_mean  # noqa: F401
+from scalerl_tpu.utils.metrics import (  # noqa: F401
+    EpisodeMetrics,
+    calculate_mean,
+    calculate_vectorized_scores,
+)
 from scalerl_tpu.utils.schedulers import (  # noqa: F401
     LinearDecayScheduler,
     MultiStepScheduler,

@@ -507,3 +507,27 @@ def test_breakout_render_size_upscales_without_changing_dynamics():
         b_state, b_obs, b_r, b_d = big.step(b_state, a, k)
         assert float(s_r) == float(b_r), f"step {i}"
         assert bool(s_d) == bool(b_d), f"step {i}"
+
+
+class _TimesTwoReward(gym.RewardWrapper):
+    """Module-level (picklable) custom wrapper for the wrappers= hook."""
+
+    def reward(self, reward):
+        return 2.0 * reward
+
+
+def test_make_vect_envs_custom_wrappers():
+    """The wrappers= hook applies user wrappers per env — the generic form
+    of the reference's skill-wrapper factory (env_utils.py:109-120)."""
+    from scalerl_tpu.envs import make_vect_envs
+
+    vec = make_vect_envs(
+        "CartPole-v1", num_envs=2, async_envs=False,
+        wrappers=[_TimesTwoReward],
+    )
+    try:
+        vec.reset(seed=0)
+        _, rew, *_ = vec.step(np.zeros(2, np.int64))
+        np.testing.assert_array_equal(rew, np.full(2, 2.0))  # 1.0 doubled
+    finally:
+        vec.close()
