@@ -191,15 +191,22 @@ def _run_learn_measurement() -> None:
         flops_per_step = _cost_analysis_flops(compiled)
     except Exception:  # noqa: BLE001 — whatever run_fn holds still works
         pass
+    from scalerl_tpu.runtime.dispatch import MetricsPipeline
+
     state, m = run_fn(agent.state, traj)
     float(m["total_loss"])  # sync through a host fetch (tunnel-safe)
     target_s = 15.0 if on_accel else 4.0
+    # pipelined driver: 2 steps in flight, ONE batched metric read per step
+    # (lagged — the read blocks on a step the device already finished);
+    # drain() is the final host-fetch sync before the clock stops
+    pipe = MetricsPipeline(depth=2)
     t0 = time.perf_counter()
     steps = 0
     while time.perf_counter() - t0 < target_s or steps < 2:
         state, m = run_fn(state, traj)
         steps += 1
-        float(m["total_loss"])
+        pipe.push(steps, m)
+    pipe.drain()
     elapsed = time.perf_counter() - t0
     frames = steps * T * B
     result = {
@@ -351,8 +358,16 @@ def _run_measurement(
     state, carry, m = run_fn(state, carry, jax.random.PRNGKey(1))
     float(m["total_loss"])
 
+    from scalerl_tpu.runtime.dispatch import MetricsPipeline
+
     target_s = 20.0 if on_accel else 4.0
     frames = 0
+    # pipelined driver: 2 chunks in flight, ONE batched metric read per
+    # chunk (lagged a chunk behind the device, so the host never stalls
+    # it); drain() is the final host-fetch sync before the clock stops —
+    # still a host transfer, which under the axon tunnel is the only
+    # trustworthy completion signal (block_until_ready is not)
+    pipe = MetricsPipeline(depth=2)
     t0 = time.perf_counter()
     i = 0
     while True:
@@ -360,9 +375,10 @@ def _run_measurement(
         state, carry, metrics = run_fn(state, carry, sub)
         i += 1
         frames += frames_per_call
-        float(metrics["total_loss"])
+        pipe.push(i, metrics)
         if time.perf_counter() - t0 >= target_s and i >= min_iters:
             break
+    pipe.drain()
     elapsed = time.perf_counter() - t0
 
     fps = frames / elapsed

@@ -133,9 +133,16 @@ def train_iql(
             fin = info.get("final_observation") if info else None
             if fin is not None:
                 for a in names:
+                    # PettingZoo early exit: an agent absent from the final
+                    # observation dict keeps its autoreset next_obs row
+                    # (fin[a] would KeyError); dead agents simply have no
+                    # terminal obs to patch in
+                    fin_a = fin.get(a)
+                    if fin_a is None:
+                        continue
                     if store_next[a] is next_obs[a]:
                         store_next[a] = np.array(next_obs[a])
-                    store_next[a][i] = fin[a]
+                    store_next[a][i] = fin_a
         team_step = np.zeros(num_envs)
         for a in names:
             samplers[a].add(

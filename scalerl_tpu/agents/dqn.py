@@ -397,7 +397,9 @@ class DQNAgent(BaseAgent):
             self.state, (metrics, td_abs) = self._learn_mesh(self.state, sharded)
         else:
             self.state, metrics, td_abs = self._learn(self.state, dict(batch))
-        out = {k: float(v) for k, v in metrics.items()}
+        from scalerl_tpu.runtime.dispatch import get_metrics
+
+        out = get_metrics(metrics)  # ONE batched device->host transfer
         out["td_abs"] = td_abs  # device array, for PER priority feedback
         out["eps"] = self.eps
         return out

@@ -159,7 +159,9 @@ class PolicyValueAgent(BaseAgent):
         return metrics
 
     def learn(self, traj) -> Dict[str, float]:
-        return {k: float(v) for k, v in self.learn_device(traj).items()}
+        from scalerl_tpu.runtime.dispatch import get_metrics
+
+        return get_metrics(self.learn_device(traj))  # one batched transfer
 
     def get_weights(self):
         return self.state.params

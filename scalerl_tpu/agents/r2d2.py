@@ -400,10 +400,12 @@ class R2D2Agent(BaseAgent):
         return metrics, prio
 
     def learn(self, batch) -> Dict[str, float]:
+        from scalerl_tpu.runtime.dispatch import get_metrics
+
         metrics, _ = self.learn_sequences(
             batch["fields"], batch["core"], batch["weights"]
         )
-        return {k: float(v) for k, v in metrics.items()}
+        return get_metrics(metrics)  # one batched device->host transfer
 
     def get_weights(self):
         return self.state.params

@@ -239,7 +239,9 @@ class TD3Agent(BaseAgent):
             self.state, (metrics, td_abs) = self._learn_mesh(self.state, sharded)
         else:
             self.state, metrics, td_abs = self._learn(self.state, dict(batch))
-        out: Dict[str, Any] = {k: float(v) for k, v in metrics.items()}
+        from scalerl_tpu.runtime.dispatch import get_metrics
+
+        out: Dict[str, Any] = get_metrics(metrics)  # one batched transfer
         out["td_abs"] = td_abs
         return out
 

@@ -186,7 +186,12 @@ class PrioritizedReplayBuffer:
         self.alpha = alpha
         self.n_step = n_step
         self.gamma = gamma
-        self.sample_method = sample_method
+        # resolve "auto" NOW (env var / backend at construction), not at
+        # first trace — a SCALERL_PER_METHOD change after tracing would
+        # otherwise be silently ignored by the cached program
+        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+        self.sample_method = resolve_sample_method(sample_method)
         self.state = per_init(self.spec, capacity, num_envs)
         self._add = jax.jit(per_add, donate_argnums=0)
         self._add_prio = jax.jit(per_add_with_priorities, donate_argnums=0)

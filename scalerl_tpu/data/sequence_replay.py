@@ -87,26 +87,32 @@ def seq_add(
     )
 
 
-@partial(jax.jit, static_argnums=(2,))
+@partial(jax.jit, static_argnums=(2,), static_argnames=("method",))
 def seq_sample(
     state: SequenceReplayState,
     key: jax.Array,
     batch_size: int,
     alpha: float = 0.6,
     beta: float = 0.4,
+    method: str = "auto",
 ) -> Tuple[Dict[str, jnp.ndarray], Tuple, jnp.ndarray, jnp.ndarray]:
     """Proportional sample of ``batch_size`` sequences.
 
     Returns (fields [B, T1, ...], core (c,h)[B,...] per layer,
     indices [B], importance weights [B] normalized by their max —
     the PER convention, ``scalerl/data/replay_buffer.py:370-381``).
+
+    ``method``: the ``ops/pallas_per`` search implementation.  Long-lived
+    callers (the R2D2 trainers) resolve ``"auto"`` at construction via
+    ``resolve_sample_method`` and pass the concrete method, so env-var /
+    backend changes after the first trace cannot be silently ignored.
     """
     scaled = jnp.power(state.priorities, alpha)  # empty slots: 0^a = 0
     total = jnp.sum(scaled)
     u = jax.random.uniform(key, (batch_size,))
     # stratified targets over the live mass
     targets = (jnp.arange(batch_size) + u) / batch_size * total
-    idx = proportional_sample(scaled, targets, method="auto")
+    idx = proportional_sample(scaled, targets, method=method)
 
     probs = scaled[idx] / jnp.maximum(total, 1e-9)
     n = jnp.maximum(state.size.astype(jnp.float32), 1.0)

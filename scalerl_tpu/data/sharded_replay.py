@@ -104,7 +104,13 @@ class ShardedPrioritizedReplay:
         extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
         action_shape: Tuple[int, ...] = (),
         action_dtype: jnp.dtype = jnp.int32,
+        sample_method: str = "auto",
     ) -> None:
+        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+        # "auto" resolves NOW (env var / backend at construction), not at
+        # first trace of the cached sample program
+        self.sample_method = resolve_sample_method(sample_method)
         self.mesh = mesh
         self.axes = replay_shard_axes(mesh)
         if not self.axes:
@@ -207,6 +213,7 @@ class ShardedPrioritizedReplay:
         n_shards = self.n_shards
         num_envs = self.num_envs
         n_step, gamma, alpha = self.n_step, self.gamma, self.alpha
+        method = self.sample_method  # resolved at construction, pinned here
 
         def local_sample(state: PrioritizedState, key, beta):
             # state leaves here are the LOCAL blocks: [capacity, envs/S, ...]
@@ -225,7 +232,7 @@ class ShardedPrioritizedReplay:
 
             u = jax.random.uniform(key, (b_local,))
             targets = (jnp.arange(b_local) + u) / b_local * m_local
-            flat_logical = proportional_sample(flat_p, targets, method="auto")
+            flat_logical = proportional_sample(flat_p, targets, method=method)
 
             # per-draw probability under the two-level scheme
             q = flat_p[flat_logical] / jnp.maximum(m_local, 1e-12) / n_shards
@@ -299,6 +306,7 @@ def seq_sample_sharded_local(
     alpha: float = 0.6,
     beta: float = 0.4,
     global_size: Optional[jnp.ndarray] = None,
+    method: str = "auto",
 ):
     """Per-shard sequence sample; call INSIDE ``shard_map`` over ``axes``.
 
@@ -313,6 +321,10 @@ def seq_sample_sharded_local(
     weight's ``N``.  Default ``state.size`` — correct when the cursor walks
     the GLOBAL ring (``ShardedSequenceReplay``); pass ``psum(size, axes)``
     when each shard keeps an independent local ring (fused loop).
+
+    ``method``: long-lived callers pass the concrete search method they
+    resolved at construction (``resolve_sample_method``), so env-var /
+    backend changes after the first trace are not silently ignored.
     """
     shard = jnp.zeros((), jnp.int32)
     for a in axes:
@@ -323,7 +335,7 @@ def seq_sample_sharded_local(
     m_local = jnp.sum(scaled)
     u = jax.random.uniform(key, (b_local,))
     targets = (jnp.arange(b_local) + u) / b_local * m_local
-    idx = proportional_sample(scaled, targets, method="auto")
+    idx = proportional_sample(scaled, targets, method=method)
 
     q = scaled[idx] / jnp.maximum(m_local, 1e-9) / n_shards
     size = state.size if global_size is None else global_size
@@ -360,7 +372,12 @@ class ShardedSequenceReplay:
         mesh,
         alpha: float = 0.6,
         beta: float = 0.4,
+        sample_method: str = "auto",
     ) -> None:
+        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+        # construction-time resolution (see PrioritizedReplayBuffer)
+        self.sample_method = resolve_sample_method(sample_method)
         self.mesh = mesh
         self.axes = replay_shard_axes(mesh)
         if not self.axes:
@@ -420,11 +437,13 @@ class ShardedSequenceReplay:
         local_capacity = self.capacity // self.n_shards
         alpha, beta = self.alpha, self.beta
 
+        method = self.sample_method
+
         def local(state, key):
             return seq_sample_sharded_local(
                 state, key, b_local,
                 axes=axes, n_shards=n_shards, local_capacity=local_capacity,
-                alpha=alpha, beta=beta,
+                alpha=alpha, beta=beta, method=method,
             )
 
         # fields/core: [b_local, T1/dim, ...] -> sharded dim 0; idx/weights 1-D

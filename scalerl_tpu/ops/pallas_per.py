@@ -118,12 +118,19 @@ def resolve_sample_method(method: str = "auto") -> str:
     TPU -> ``pallas`` (the scalar-prefetch kernel; top-level and
     shard_map'd legality covered by ``tests_tpu/test_compiled_kernels.py``),
     anything else -> ``hierarchical`` (pure XLA, runs everywhere).
-    Resolution happens at trace time — ``jax.default_backend()`` is the
-    backend the jitted program will run on in a single-backend process.
     The env var ``SCALERL_PER_METHOD`` overrides what ``auto`` resolves to
     (e.g. ``hierarchical`` to back out the kernel on TPU without touching
     call sites); an explicitly pinned method always wins, so tests that
     compare methods stay meaningful under the override.
+
+    Buffers resolve ``"auto"`` ONCE at construction time (the
+    ``PrioritizedReplayBuffer`` / sharded-replay constructors and the R2D2
+    trainers all call this in ``__init__``) rather than inside their traced
+    sample programs: trace-time resolution would silently pin whatever the
+    env var / backend happened to be at FIRST trace, and later changes to
+    ``SCALERL_PER_METHOD`` would be ignored without any signal.  A bare
+    ``proportional_sample(..., method="auto")`` still resolves at call
+    time for one-off use.
     """
     import os
 

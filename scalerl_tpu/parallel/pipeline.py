@@ -11,9 +11,12 @@ a non-cyclic ``ppermute`` shift.  The classic GPipe bubble applies:
 schedule with identity boundary stages.
 
 This is the correctness-first formulation (activations are dense every
-step; idle stages compute on zeros).  It exists so ``pp`` is a real,
-executable axis — RL-parity models are far too small to need it, which is
-why the flagship trainers default to dp/fsdp.
+step; idle stages run their *block* on zeros, but the boundary stages are
+``lax.cond``-gated: embed runs only on stage 0 and the head only on the
+last stage's active steps — ~M head applications instead of S*(M+S-1)).
+It exists so ``pp`` is a real, executable axis — RL-parity models are far
+too small to need it, which is why the flagship trainers default to
+dp/fsdp.
 """
 
 from __future__ import annotations
@@ -89,10 +92,11 @@ def make_hetero_pipeline_apply(
     (they are small, and only stage 0 / stage S-1 consume them).
 
     Shapes stay uniform without a stage-indexed ``lax.switch``: the raw
-    input only ever feeds ``embed_fn`` (computed from each device's local
-    copy of the microbatch, masked to stage 0 by the carry select), the
+    input only ever feeds ``embed_fn`` (a ``lax.cond`` runs it on stage 0
+    only, from that device's local copy of the microbatch), the
     inter-stage carry is always the block width, and ``head_fn``'s output
-    goes to a separate collection buffer, never onto the pipe.
+    (``lax.cond``-gated to the last stage's active steps) goes to a
+    separate collection buffer, never onto the pipe.
 
     Schedule: GPipe, ``M + S - 1`` steps (``M`` microbatches) — the bubble
     fraction is ``(S-1)/(M+S-1)``; ``tests/test_pipeline.py`` asserts the
@@ -121,16 +125,22 @@ def make_hetero_pipeline_apply(
             k = t - stage  # microbatch index flowing through this stage
             active = jnp.logical_and(k >= 0, k < M)
             k_safe = jnp.clip(k, 0, M - 1)
-            # stage 0 embeds fresh microbatches; others take the neighbor's
-            x_in = jnp.where(
-                stage == 0, embed_fn(params["embed"], mbs[k_safe]), cur
+            # boundary stages are lax.cond-gated, not computed-then-masked:
+            # a jnp.where would run embed on every stage and head on every
+            # (stage, step) pair — S*(M+S-1) head applications where only
+            # the last stage's M active steps carry real data.  cond skips
+            # the FLOPs entirely on the stages/steps that discard them.
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: embed_fn(params["embed"], mbs[k_safe]),
+                lambda: cur,
             )
             y = block_fn(block_local, x_in)
             y = jnp.where(active, y, jnp.zeros_like(y))
-            out = head_fn(params["head"], y)
-            outputs = jnp.where(
+            outputs = jax.lax.cond(
                 jnp.logical_and(active, stage == S - 1),
-                outputs.at[k_safe].set(out),
+                lambda o: o.at[k_safe].set(head_fn(params["head"], y)),
+                lambda o: o,
                 outputs,
             )
             # non-cyclic right shift: stage i -> i+1 (stage 0 receives zeros)

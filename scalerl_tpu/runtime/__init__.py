@@ -11,11 +11,19 @@ from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # static analyzers see the real symbols
     from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop  # noqa: F401
+    from scalerl_tpu.runtime.dispatch import (  # noqa: F401
+        MetricsPipeline,
+        get_metrics,
+        pipelined_drive,
+    )
     from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
     from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401
 
 _EXPORTS = {
     "DeviceActorLearnerLoop": "scalerl_tpu.runtime.device_loop",
+    "MetricsPipeline": "scalerl_tpu.runtime.dispatch",
+    "get_metrics": "scalerl_tpu.runtime.dispatch",
+    "pipelined_drive": "scalerl_tpu.runtime.dispatch",
     "ParameterServer": "scalerl_tpu.runtime.param_server",
     "RolloutQueue": "scalerl_tpu.runtime.rollout_queue",
 }

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from scalerl_tpu.agents.impala import ImpalaTrainState
 from scalerl_tpu.data.trajectory import Trajectory
 from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+from scalerl_tpu.runtime.dispatch import MetricsPipeline, get_metrics
 
 
 class ActorCarry(NamedTuple):
@@ -293,36 +294,56 @@ class DeviceActorLearnerLoop:
         threshold: float,
         max_calls: int,
         on_metrics: Optional[Callable[[int, float, Dict[str, float]], None]] = None,
+        chunks_in_flight: int = 2,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
         """Drive fused chunks until the *windowed* mean episode return (over
         episodes completed since the previous chunk) reaches ``threshold``,
         or ``max_calls`` chunks elapse.
 
-        ``on_metrics(frames, windowed_return, device_metrics)`` fires after
-        every chunk.  Returns ``(state, carry, summary)`` with summary keys
+        ``chunks_in_flight`` chunks stay dispatched ahead of the host's
+        metric reads (one batched device->host transfer per chunk), so the
+        threshold check and ``on_metrics`` lag the device by
+        ``chunks_in_flight - 1`` chunks instead of stalling it; a hit stops
+        further dispatch but the chunks already in flight still land (they
+        are counted in ``frames`` and folded into the returned state).  The
+        metric STREAM — chunk order, values, and the frame counts passed to
+        ``on_metrics(frames, windowed_return, chunk_metrics)`` — is
+        identical for every ``chunks_in_flight``; 1 is fully synchronous.
+        Returns ``(state, carry, summary)`` with summary keys
         ``windowed_return`` / ``frames`` / ``hit``.
         """
         frames_per_call = self.unroll_length * self.venv.num_envs * self.iters_per_call
-        prev_sum = float(jnp.sum(carry.return_sum))
-        prev_cnt = float(jnp.sum(carry.episode_count))
+        init = get_metrics(
+            {"s": jnp.sum(carry.return_sum), "c": jnp.sum(carry.episode_count)}
+        )
+        prev_sum, prev_cnt = init["s"], init["c"]
         windowed = float("nan")
         frames = 0
         hit = False
-        for _ in range(max_calls):
+        pipe = MetricsPipeline(depth=chunks_in_flight)
+
+        def consume(ready) -> None:
+            nonlocal windowed, prev_sum, prev_cnt, hit
+            for i, m in ready:
+                s = m["episode_return_sum"]
+                c = m["episode_count_sum"]
+                if c > prev_cnt:
+                    windowed = (s - prev_sum) / (c - prev_cnt)
+                    prev_sum, prev_cnt = s, c
+                if on_metrics is not None:
+                    on_metrics((i + 1) * frames_per_call, windowed, dict(m))
+                if windowed >= threshold:
+                    hit = True
+
+        for i in range(max_calls):
             key, sub = jax.random.split(key)
             state, carry, m = self.train_chunk(state, carry, sub)
             frames += frames_per_call
             # the sums ride the fused metrics — no extra host dispatches
-            s = float(m["episode_return_sum"])
-            c = float(m["episode_count_sum"])
-            if c > prev_cnt:
-                windowed = (s - prev_sum) / (c - prev_cnt)
-                prev_sum, prev_cnt = s, c
-            if on_metrics is not None:
-                on_metrics(frames, windowed, {k: float(v) for k, v in m.items()})
-            if windowed >= threshold:
-                hit = True
+            consume(pipe.push(i, m))
+            if hit:
                 break
+        consume(pipe.drain())
         summary = {"windowed_return": windowed, "frames": float(frames), "hit": hit}
         return state, carry, summary
 
@@ -334,26 +355,35 @@ class DeviceActorLearnerLoop:
         key: jax.Array,
         num_calls: int,
         on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        chunks_in_flight: int = 2,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
-        """Drive ``num_calls`` fused mega-steps; one host dispatch each."""
+        """Drive ``num_calls`` fused mega-steps; one host dispatch each.
+
+        Each chunk's metric dict is read back with ONE batched transfer,
+        lagging dispatch by ``chunks_in_flight - 1`` chunks so the device
+        never idles waiting on the host (``chunks_in_flight=1`` restores
+        the synchronous read-after-every-chunk path).  ``on_metrics(i,
+        metrics)`` still fires once per chunk, in order.
+        """
         metrics: Dict[str, float] = {}
+        pipe = MetricsPipeline(depth=chunks_in_flight)
+
+        def consume(ready) -> None:
+            nonlocal metrics
+            for i, host_m in ready:
+                m = dict(host_m)
+                m["episodes"] = m.pop("episode_count_sum")
+                m["return_mean"] = m.pop("episode_return_sum") / max(
+                    m["episodes"], 1.0
+                )
+                metrics = m
+                if on_metrics is not None:
+                    on_metrics(i, m)
+
         for i in range(num_calls):
             key, sub = jax.random.split(key)
             state, carry, dev_metrics = self.train_chunk(state, carry, sub)
-            if on_metrics is not None:
-                metrics = {k: float(v) for k, v in dev_metrics.items()}
-                metrics["episodes"] = metrics.pop("episode_count_sum")
-                metrics["return_mean"] = metrics.pop("episode_return_sum") / max(
-                    metrics["episodes"], 1.0
-                )
-                on_metrics(i, metrics)
+            consume(pipe.push(i, dev_metrics))
+        consume(pipe.drain())
         jax.block_until_ready(state.params)
-        if not metrics:
-            metrics = {
-                "episodes": float(jnp.sum(carry.episode_count)),
-                "return_mean": float(
-                    jnp.sum(carry.return_sum)
-                    / max(float(jnp.sum(carry.episode_count)), 1.0)
-                ),
-            }
         return state, carry, metrics

@@ -34,6 +34,7 @@ import numpy as np
 from scalerl_tpu.agents.impala import ImpalaAgent
 from scalerl_tpu.config import ImpalaArguments
 from scalerl_tpu.data.trajectory import TrajectorySpec, batch_to_trajectory
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
 from scalerl_tpu.trainer.base import BaseTrainer
@@ -432,7 +433,9 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                         for r in m.episode_returns[-20:]
                     ]
                     ret_mean = float(np.mean(rets)) if rets else float("nan")
-                    host_metrics = {k: float(v) for k, v in metrics.items()}
+                    # one batched device->host transfer for the whole dict
+                    # (per-key float() would pay a round trip per metric)
+                    host_metrics = get_metrics(metrics)
                     info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
                     self.logger.log_train_data(info, self.env_frames)
                     if self.is_main_process:
@@ -464,7 +467,7 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
         rets = [r for m in self.episode_metrics for r in m.episode_returns]
         return {
-            **{k: float(v) for k, v in metrics.items()},
+            **get_metrics(metrics),
             "env_frames": float(self.env_frames),
             "sps": float(sps),
             "return_mean": float(np.mean(rets[-100:])) if rets else float("nan"),
@@ -483,14 +486,19 @@ class DeviceActorLearnerTrainer(BaseTrainer):
         iters_per_call: int = 10,
         mesh=None,
         run_name: Optional[str] = None,
+        chunks_in_flight: int = 2,
     ) -> None:
         """``mesh``: run the fused loop data-parallel (Anakin) — env lanes
         sharded over the mesh's ``dp`` axis, params replicated, gradients
-        psum-ed inside the fused step."""
+        psum-ed inside the fused step.  ``chunks_in_flight``: how many
+        fused chunks stay dispatched ahead of the host's (batched) metric
+        reads — logging lags the device by ``chunks_in_flight - 1`` chunks
+        instead of stalling it; 1 restores the synchronous driver."""
         super().__init__(args, run_name=run_name)
         from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
 
         self.agent = agent
+        self.chunks_in_flight = chunks_in_flight
         # the agent owns the loss hyperparameters — never rebuild from the
         # trainer's args (which may be a different object)
         learn_fn = agent.make_learn_fn(grad_axis="dp" if mesh is not None else None)
@@ -548,7 +556,8 @@ class DeviceActorLearnerTrainer(BaseTrainer):
                 )
 
         state, carry, metrics = self.loop.run(
-            self.agent.state, carry, key, num_calls, on_metrics=on_metrics
+            self.agent.state, carry, key, num_calls, on_metrics=on_metrics,
+            chunks_in_flight=self.chunks_in_flight,
         )
         self.agent.state = state
         frames = done_frames + num_calls * frames_per_call

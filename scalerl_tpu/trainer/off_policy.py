@@ -22,6 +22,7 @@ import numpy as np
 from scalerl_tpu.agents.dqn import DQNAgent
 from scalerl_tpu.config import DQNArguments
 from scalerl_tpu.data.sampler import Sampler
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 from scalerl_tpu.utils.schedulers import LinearDecayScheduler
@@ -200,8 +201,10 @@ class OffPolicyTrainer(BaseTrainer):
                     (self.global_step - start_step) / max(time.time() - start, 1e-8)
                 )
                 summary = self.metrics.summary()
+                # one batched device->host transfer for the metric dict —
+                # any device scalars still un-materialized ride together
                 info = {
-                    **{k: v for k, v in train_info.items()},
+                    **get_metrics(train_info),
                     "rpm_size": len(self.sampler),
                     "fps": fps,
                     "learn_steps": self.learn_steps,

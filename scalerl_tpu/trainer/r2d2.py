@@ -36,6 +36,7 @@ from scalerl_tpu.data.sequence_replay import (
     seq_update_priorities,
 )
 from scalerl_tpu.data.trajectory import TrajectorySpec
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
 from scalerl_tpu.trainer.actor_learner import (
@@ -114,6 +115,11 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
         self._max_priority = 1.0
         self._rng = jax.random.PRNGKey(args.seed + 13)
+        # PER search method pinned at construction (not at first trace),
+        # so SCALERL_PER_METHOD / backend changes can't be silently ignored
+        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+        self._seq_method = resolve_sample_method("auto")
 
     # grant_actor_restart comes from HostPlaneMixin (shared with the IMPALA
     # thread plane); resume extends the mixin's (agent, env_frames) pytree
@@ -188,6 +194,7 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             fields, core, idx, weights = seq_sample(
                 self.replay, sub, self.args.batch_size,
                 alpha=self.args.per_alpha, beta=self.args.per_beta,
+                method=self._seq_method,
             )
             metrics, prio = self.agent.learn_sequences(fields, core, weights)
             self.replay = seq_update_priorities(self.replay, idx, prio)
@@ -248,7 +255,8 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
                         for r in m.episode_returns[-20:]
                     ]
                     ret_mean = float(np.mean(rets)) if rets else float("nan")
-                    host_metrics = {k: float(v) for k, v in metrics.items()}
+                    # one batched device->host transfer for the whole dict
+                    host_metrics = get_metrics(metrics)
                     info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
                     self.logger.log_train_data(info, self.env_frames)
                     if self.is_main_process:
@@ -272,7 +280,7 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         sps = (self.env_frames - start_frames) / max(time.time() - start, 1e-8)
         rets = [r for m in self.episode_metrics for r in m.episode_returns]
         return {
-            **{k: float(v) for k, v in metrics.items()},
+            **get_metrics(metrics),
             "env_frames": float(self.env_frames),
             "sps": float(sps),
             "learn_steps": int(self.agent.state.step),

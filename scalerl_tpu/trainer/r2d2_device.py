@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from scalerl_tpu.agents.r2d2 import R2D2Agent
 from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.data.sequence_replay import (
     seq_add,
     seq_init,
@@ -145,6 +146,12 @@ class DeviceR2D2Trainer(BaseTrainer):
             self._collect_insert = None
         self._max_priority = 1.0
         self.env_frames = 0
+        # PER search method pinned at construction (not at first trace of
+        # the fused program), so SCALERL_PER_METHOD / backend changes
+        # can't be silently ignored
+        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+
+        self._seq_method = resolve_sample_method("auto")
 
     # ------------------------------------------------------------------
     def init_carry(self, key: jax.Array) -> _CollectCarry:
@@ -252,6 +259,7 @@ class DeviceR2D2Trainer(BaseTrainer):
             f, c, idx, w = seq_sample(
                 replay, k_s, args.batch_size,
                 alpha=args.per_alpha, beta=args.per_beta,
+                method=self._seq_method,
             )
             agent_state, metrics, new_prio = learn_raw(agent_state, f, c, w)
             replay = seq_update_priorities(replay, idx, new_prio)
@@ -292,6 +300,7 @@ class DeviceR2D2Trainer(BaseTrainer):
                 replay, k_s, args.batch_size // n,
                 axes=(axis,), n_shards=n, local_capacity=local_cap,
                 alpha=args.per_alpha, beta=args.per_beta, global_size=gsize,
+                method=self._seq_method,
             )
             agent_state, metrics, new_prio = self._learn_shard(
                 agent_state, f, c, w
@@ -424,6 +433,7 @@ class DeviceR2D2Trainer(BaseTrainer):
                         f, c, idx, w = seq_sample(
                             self.replay, k_l, args.batch_size,
                             alpha=args.per_alpha, beta=args.per_beta,
+                            method=self._seq_method,
                         )
                         metrics, new_prio = self.agent.learn_sequences(f, c, w)
                         self.replay = seq_update_priorities(
@@ -433,21 +443,28 @@ class DeviceR2D2Trainer(BaseTrainer):
                             self._max_priority, float(jnp.max(new_prio))
                         )
             if final_mark is None and self.env_frames >= 0.75 * total_frames:
-                final_mark = (
-                    float(jnp.sum(carry.return_sum)),
-                    float(jnp.sum(carry.episode_count)),
+                # one batched transfer for the pair (not two blocking reads)
+                mark = get_metrics(
+                    {"s": jnp.sum(carry.return_sum),
+                     "c": jnp.sum(carry.episode_count)}
                 )
+                final_mark = (mark["s"], mark["c"])
             if self.env_frames - last_log >= args.logger_frequency:
                 last_log = self.env_frames
-                s = float(jnp.sum(carry.return_sum))
-                c = float(jnp.sum(carry.episode_count))
+                # episode sums ride the same batched transfer as the learn
+                # metrics: ONE device->host round trip per log boundary
+                host = get_metrics(
+                    {**metrics, "_ret_sum": jnp.sum(carry.return_sum),
+                     "_ep_cnt": jnp.sum(carry.episode_count)}
+                )
+                s = host.pop("_ret_sum")
+                c = host.pop("_ep_cnt")
                 if c > prev_cnt:
                     # windowed: episodes completed since the previous log —
                     # the learning signal (the cumulative mean drags the
                     # random-policy prefix along forever)
                     windowed = (s - prev_sum) / (c - prev_cnt)
                     prev_sum, prev_cnt = s, c
-                host = {k: float(v) for k, v in metrics.items()}
                 self.logger.log_train_data(
                     {**host, "return_windowed": windowed, "eps": eps},
                     self.env_frames,
@@ -462,14 +479,18 @@ class DeviceR2D2Trainer(BaseTrainer):
             # piecewise mode self._max_priority was maintained on the host
             # (overwriting it here would reset it to the entry value)
             self._max_priority = float(max_prio)
-        s = float(jnp.sum(carry.return_sum))
-        c = float(jnp.sum(carry.episode_count))
+        final = get_metrics(
+            {**metrics, "_ret_sum": jnp.sum(carry.return_sum),
+             "_ep_cnt": jnp.sum(carry.episode_count)}
+        )
+        s = final.pop("_ret_sum")
+        c = final.pop("_ep_cnt")
         mark_s, mark_c = final_mark if final_mark is not None else (0.0, 0.0)
         if c > mark_c:
             windowed = (s - mark_s) / (c - mark_c)
         sps = self.env_frames / max(time.time() - start, 1e-8)
         return {
-            **{k: float(v) for k, v in metrics.items()},
+            **final,
             "env_frames": float(self.env_frames),
             "sps": float(sps),
             "learn_steps": int(self.agent.state.step),

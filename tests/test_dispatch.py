@@ -1,0 +1,226 @@
+"""Pipelined host dispatch: batched metric transfer + K chunks in flight.
+
+Covers the ISSUE-1 driver contract:
+
+- ``get_metrics`` materializes a whole metric dict with ONE batched
+  device->host transfer (counted through the ``dispatch._device_get`` seam);
+- ``MetricsPipeline`` holds ``depth`` payloads in flight and releases them
+  in order, one transfer each;
+- ``DeviceActorLearnerLoop.run`` / ``run_until`` produce IDENTICAL final
+  state and metric streams at K=1 (synchronous) and K>1 (pipelined), with
+  exactly one batched transfer per chunk;
+- ``run_until``'s threshold check lags the device by K-1 chunks: a hit
+  stops dispatch, but the chunks already in flight land and are counted.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.impala import ImpalaAgent, make_impala_learn_fn
+from scalerl_tpu.config import ImpalaArguments
+from scalerl_tpu.envs import make_jax_vec_env
+from scalerl_tpu.runtime import dispatch
+from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
+from scalerl_tpu.runtime.dispatch import MetricsPipeline, get_metrics
+
+
+# ---------------------------------------------------------------------------
+# unit: get_metrics / MetricsPipeline
+
+
+def test_get_metrics_one_batched_transfer(monkeypatch):
+    calls = []
+    real = dispatch._device_get
+
+    def counting(tree):
+        calls.append(tree)
+        return real(tree)
+
+    monkeypatch.setattr(dispatch, "_device_get", counting)
+    metrics = {
+        "a": jnp.float32(1.5),
+        "b": jnp.int32(3),
+        "c": 2.0,  # host leaf passes through
+    }
+    out = get_metrics(metrics)
+    assert len(calls) == 1  # ONE batched get for the whole dict
+    assert out == {"a": 1.5, "b": 3.0, "c": 2.0}
+    assert all(isinstance(v, float) for v in out.values())
+
+
+def test_get_metrics_mixed_vector_leaves(monkeypatch):
+    calls = []
+    real = dispatch._device_get
+    monkeypatch.setattr(
+        dispatch, "_device_get", lambda t: (calls.append(t), real(t))[1]
+    )
+    out = get_metrics({"loss": jnp.float32(0.5), "td_abs": jnp.ones((4,))})
+    assert len(calls) == 1
+    assert out["loss"] == 0.5
+    np.testing.assert_array_equal(np.asarray(out["td_abs"]), np.ones(4))
+
+
+def test_pipeline_depth_and_order():
+    pipe = MetricsPipeline(depth=3)
+    # filling: nothing ready until `depth` payloads are pending
+    assert pipe.push(0, {"v": jnp.float32(0)}) == []
+    assert pipe.push(1, {"v": jnp.float32(1)}) == []
+    ready = pipe.push(2, {"v": jnp.float32(2)})
+    assert [t for t, _ in ready] == [0]
+    assert ready[0][1] == {"v": 0.0}
+    assert len(pipe) == 2  # two still in flight
+    drained = pipe.drain()
+    assert [t for t, _ in drained] == [1, 2]
+    assert [m["v"] for _, m in drained] == [1.0, 2.0]
+    assert len(pipe) == 0
+    assert pipe.transfers == 3  # one batched get per payload, ever
+
+
+def test_pipeline_depth_one_is_synchronous():
+    pipe = MetricsPipeline(depth=1)
+    ready = pipe.push(7, {"v": jnp.float32(9)})
+    assert ready == [(7, {"v": 9.0})]
+    with pytest.raises(ValueError):
+        MetricsPipeline(depth=0)
+
+
+# ---------------------------------------------------------------------------
+# the fused driver at K=1 vs K>1
+
+
+def _make_loop(iters_per_call=2, T=4, B=4):
+    args = ImpalaArguments(
+        env_id="CartPole-v1", rollout_length=T, batch_size=B,
+        use_lstm=False, hidden_size=32, logger_backend="none",
+    )
+    venv = make_jax_vec_env("CartPole-v1", num_envs=B)
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    learn = make_impala_learn_fn(agent.model, agent.optimizer, args)
+    loop = DeviceActorLearnerLoop(
+        agent.model, venv, learn, T, iters_per_call=iters_per_call
+    )
+    return loop, agent
+
+
+def _fresh_state(agent):
+    # train_chunk donates its inputs: every run gets its own state copy
+    return jax.tree_util.tree_map(jnp.copy, agent.state)
+
+
+def _run_stream(loop, agent, num_calls, chunks_in_flight):
+    stream = []
+    state, carry, metrics = loop.run(
+        _fresh_state(agent),
+        loop.init_carry(jax.random.PRNGKey(1)),
+        jax.random.PRNGKey(2),
+        num_calls=num_calls,
+        on_metrics=lambda i, m: stream.append((i, dict(m))),
+        chunks_in_flight=chunks_in_flight,
+    )
+    return state, metrics, stream
+
+
+def test_run_parity_k1_vs_k3():
+    """Pipelining must not change state, metrics, or the metric stream."""
+    loop, agent = _make_loop()
+    s1, m1, stream1 = _run_stream(loop, agent, 5, chunks_in_flight=1)
+    s3, m3, stream3 = _run_stream(loop, agent, 5, chunks_in_flight=3)
+    assert [i for i, _ in stream1] == [0, 1, 2, 3, 4]
+    assert stream1 == stream3
+    assert m1 == m3
+    assert int(s1.step) == int(s3.step) == 5 * loop.iters_per_call
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s1.params, s3.params,
+    )
+
+
+def test_run_one_batched_transfer_per_chunk(monkeypatch):
+    """The acceptance invariant: exactly one batched device->host metrics
+    transfer per dispatched chunk, no per-key float() reads."""
+    loop, agent = _make_loop()
+    num_calls = 4
+    calls = []
+    real = dispatch._device_get
+    monkeypatch.setattr(
+        dispatch, "_device_get", lambda t: (calls.append(t), real(t))[1]
+    )
+    _run_stream(loop, agent, num_calls, chunks_in_flight=2)
+    assert len(calls) == num_calls
+
+
+def test_run_until_parity_when_threshold_never_hits():
+    loop, agent = _make_loop()
+    streams = {}
+    results = {}
+    for k in (1, 2):
+        stream = []
+        state, carry, summary = loop.run_until(
+            _fresh_state(agent),
+            loop.init_carry(jax.random.PRNGKey(1)),
+            jax.random.PRNGKey(2),
+            threshold=float("inf"),
+            max_calls=4,
+            on_metrics=lambda f, w, m: stream.append((f, w, dict(m))),
+            chunks_in_flight=k,
+        )
+        streams[k] = stream
+        results[k] = (int(state.step), summary)
+    # assert_equal: nan-tolerant (windowed is nan until an episode lands)
+    np.testing.assert_equal(streams[1], streams[2])
+    np.testing.assert_equal(results[1], results[2])
+    assert results[1][1]["hit"] is False
+    assert results[1][1]["frames"] == float(
+        4 * loop.unroll_length * loop.venv.num_envs * loop.iters_per_call
+    )
+
+
+def test_run_until_lagged_threshold_keeps_in_flight_chunks():
+    """A hit detected at (materialized) chunk j stops dispatch; the K-1
+    chunks already in flight still land and are counted in ``frames``."""
+    loop, agent = _make_loop()
+    fpc = loop.unroll_length * loop.venv.num_envs * loop.iters_per_call
+    max_calls = 8
+
+    def run(k):
+        stream = []
+        _, _, summary = loop.run_until(
+            _fresh_state(agent),
+            loop.init_carry(jax.random.PRNGKey(1)),
+            jax.random.PRNGKey(2),
+            threshold=1.0,  # random CartPole episodes return >= 1 quickly
+            max_calls=max_calls,
+            on_metrics=lambda f, w, m: stream.append((f, w)),
+            chunks_in_flight=k,
+        )
+        return summary, stream
+
+    s1, stream1 = run(1)
+    assert s1["hit"]
+    hit_chunk = len(stream1)  # chunks materialized before the K=1 stop
+    for k in (2, 3):
+        sk, streamk = run(k)
+        assert sk["hit"]
+        # identical lagged metric stream up to the synchronous hit point
+        np.testing.assert_equal(streamk[:hit_chunk], stream1)
+        # dispatch ran exactly K-1 chunks past the hit (capped by budget)
+        expect = min(hit_chunk + (k - 1), max_calls)
+        assert sk["frames"] == float(expect * fpc)
+
+
+def test_pipelined_drive_helper():
+    payloads = [{"v": jnp.float32(i)} for i in range(6)]
+    seen = []
+    n = dispatch.pipelined_drive(
+        lambda i: payloads[i],
+        num_calls=6,
+        on_ready=lambda i, m: seen.append((i, m["v"])),
+        depth=2,
+        stop=lambda: len(seen) >= 3,
+    )
+    # stop() observed true after the 3rd materialization; one more chunk
+    # was already in flight and still drained
+    assert n == 4
+    assert seen == [(0, 0.0), (1, 1.0), (2, 2.0), (3, 3.0)]

@@ -104,6 +104,25 @@ def test_auto_method_resolution(monkeypatch):
     assert resolve_sample_method("auto") == "hierarchical"
 
 
+def test_method_resolved_at_buffer_construction(monkeypatch):
+    """Buffers pin the method when BUILT, not when first traced: an env-var
+    set at construction sticks even after it is unset, and one set after
+    construction is (correctly) ignored by the existing buffer."""
+    monkeypatch.setenv("SCALERL_PER_METHOD", "cumsum")
+    buf = PrioritizedReplayBuffer(obs_shape=(4,), capacity=32, num_envs=1)
+    assert buf.sample_method == "cumsum"
+    monkeypatch.setenv("SCALERL_PER_METHOD", "hierarchical")
+    assert buf.sample_method == "cumsum"  # pinned at construction
+    buf2 = PrioritizedReplayBuffer(obs_shape=(4,), capacity=32, num_envs=1)
+    assert buf2.sample_method == "hierarchical"
+    monkeypatch.delenv("SCALERL_PER_METHOD")
+    # explicit pins always win over the env var
+    buf3 = PrioritizedReplayBuffer(
+        obs_shape=(4,), capacity=32, num_envs=1, sample_method="cumsum"
+    )
+    assert buf3.sample_method == "cumsum"
+
+
 def test_auto_equals_hierarchical_on_cpu(monkeypatch):
     """The flipped defaults are behavior-preserving off-TPU: a per_sample
     with method='auto' returns the identical batch to 'hierarchical'."""

@@ -56,10 +56,14 @@ def ensure_header() -> None:
             )
 
 
-def _run_step(cmd, env, bl, timeout_s: float) -> None:
+def _run_step(cmd, env, bl, timeout_s: float) -> str:
     """Run one payload step; on timeout SIGTERM first (bench.py's handler
     prints its banked JSON and reaps its JAX children — a straight SIGKILL
-    would orphan a TPU-holding grandchild that then starves the next step)."""
+    would orphan a TPU-holding grandchild that then starves the next step).
+
+    Returns the step outcome: ``"ok"`` (exit 0), ``"rc=N"``, or
+    ``"timeout"`` — the per-step evidence the witness commit summarizes.
+    """
     p = subprocess.Popen(cmd, env=env, stdout=bl, stderr=bl, cwd=REPO)
     try:
         p.wait(timeout=timeout_s)
@@ -71,6 +75,8 @@ def _run_step(cmd, env, bl, timeout_s: float) -> None:
             p.kill()
             p.wait()
         bl.write(f"[watcher] step timed out after {timeout_s:.0f}s\n")
+        return "timeout"
+    return "ok" if p.returncode == 0 else f"rc={p.returncode}"
 
 
 def run_payload(n_devices: int = 1) -> None:
@@ -113,14 +119,25 @@ def run_payload(n_devices: int = 1) -> None:
                 env,
             ),
         )
+    outcomes: list = []
     with open(PAYLOG, "a", buffering=1) as bl:
         for name, cmd, tmo, step_env in steps:
             bl.write(f"=== {name} {time.strftime('%H:%M:%S')} ===\n")
             try:
-                _run_step(cmd, step_env, bl, tmo)
+                outcomes.append((name, _run_step(cmd, step_env, bl, tmo)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
-    log_probe(f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done (see BENCH_TPU.md)")
+                outcomes.append((name, "error"))
+    summary = " ".join(f"{name}:{status}" for name, status in outcomes)
+    log_probe(
+        f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done [{summary}] "
+        "(see BENCH_TPU.md)"
+    )
+    if not any(status == "ok" for _, status in outcomes):
+        # nothing succeeded: there is no witnessed artifact to record — a
+        # commit here would just stamp noise over the probe log
+        log_probe("[watcher] no payload step succeeded; skipping witness commit")
+        return
     try:
         subprocess.run(
             # summary.json lives under gitignored work_dirs/ but is
@@ -132,7 +149,9 @@ def run_payload(n_devices: int = 1) -> None:
             cwd=REPO,
         )
         subprocess.run(
-            ["git", "commit", "-m", "Record witnessed TPU bench artifacts"], cwd=REPO
+            ["git", "commit", "-m",
+             f"Record witnessed TPU bench artifacts\n\nsteps: {summary}"],
+            cwd=REPO,
         )
     except Exception as e:  # noqa: BLE001
         log_probe(f"[watcher] auto-commit failed: {e}")
