@@ -87,6 +87,24 @@ class RLArguments:
     # the reference had but its trainers never surfaced as a flag).
     resume: str = ""
 
+    # Supervision (runtime/supervisor.py)
+    # Wall-clock resume-save cadence alongside the frame-gated
+    # save_frequency: whichever fires first triggers save_resume, bounding
+    # work lost to a preemption on slow-frame runs.  <= 0 disables the
+    # wall-clock gate.
+    checkpoint_interval_s: float = 600.0
+    # How many displaced resume checkpoints to retain (resume.prev,
+    # resume.prev2, ...); load falls back through the chain when the latest
+    # is corrupt/partial.  0 keeps only the latest (no fallback).
+    checkpoint_keep_last: int = 1
+    # Stall watchdog deadline: if no trainer progress counter advances for
+    # this many seconds, dump all-thread stacks + queue/ring occupancy and
+    # fail fast (or invoke a recovery callback).  <= 0 disables.
+    watchdog_timeout_s: float = 0.0
+    # SIGTERM/SIGINT trigger save_resume at the next safe point and a clean
+    # exit (TPU preemption safety); a second signal force-quits.
+    handle_preemption: bool = True
+
     def validate(self) -> None:
         if self.batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {self.batch_size}")
@@ -425,9 +443,10 @@ class ImpalaArguments(RLArguments):
     rmsprop_eps: float = 0.01
     rmsprop_momentum: float = 0.0
     max_grad_norm: float = 40.0
-    # Run (the frame budget is the inherited ``max_timesteps`` field)
+    # Run (the frame budget is the inherited ``max_timesteps`` field; the
+    # wall-clock save cadence is the inherited ``checkpoint_interval_s``,
+    # default 600 s — the reference's 10-minute IMPALA checkpoints)
     max_timesteps: int = 30_000_000
-    checkpoint_interval_s: float = 600.0
 
     # Reference-vocabulary aliases (read-only; the CLI flags are --gamma and
     # --max-timesteps — one knob per quantity, no config drift)

@@ -48,6 +48,7 @@ from scalerl_tpu.fleet.transport import (
     wait_readable,
 )
 from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.supervisor import is_heartbeat, make_pong
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -69,6 +70,20 @@ class FleetConfig:
     entry_port: int = ENTRY_PORT
     worker_port: int = WORKER_PORT
     server_host: str = "127.0.0.1"
+    # Liveness plane (runtime/supervisor.py): the server pings every gather
+    # link on this cadence and declares a SILENT (not closed) peer dead
+    # after heartbeat_timeout_s (0 → 2 x interval, the detection bound);
+    # gathers treat a server link with no traffic for the same window as
+    # dead and reconnect.  0 disables heartbeats entirely (pre-supervision
+    # behavior: only closed connections are detected).
+    heartbeat_interval_s: float = 5.0
+    heartbeat_timeout_s: float = 0.0
+    # Socket-gather reconnect: capped exponential backoff
+    # (supervisor.exp_backoff) after a lost server link, up to max_reconnects
+    # attempts across the gather's lifetime before it gives up and exits.
+    reconnect_backoff_s: float = 0.5
+    reconnect_backoff_cap_s: float = 10.0
+    max_reconnects: int = 5
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @property
@@ -77,6 +92,10 @@ class FleetConfig:
 
     def prefetch(self, workers: int) -> int:
         return self.task_prefetch or 1 + workers // 4
+
+    @property
+    def heartbeat_timeout(self) -> float:
+        return self.heartbeat_timeout_s or 2.0 * self.heartbeat_interval_s
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +155,17 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
 
 
 class Gather:
-    """Per-host fan-in proxy: parity with ``Gather.run`` (``hpc/worker.py:153-232``)."""
+    """Per-host fan-in proxy: parity with ``Gather.run`` (``hpc/worker.py:153-232``).
+
+    Liveness (runtime/supervisor.py): the gather answers server pings in its
+    select loop, treats a server link silent past ``config.heartbeat_timeout``
+    as dead, and — given a ``reconnect`` factory (socket gathers) — replaces
+    the link with capped exponential backoff instead of dying, resending the
+    in-flight upload/RPC on the fresh link (at-least-once delivery: the
+    server may see a duplicate result batch after a mid-upload cut, which is
+    harmless for rollout streams).  Pipe gathers (``LocalCluster``) keep the
+    old die-on-error behavior: a dead pipe means a dead parent.
+    """
 
     def __init__(
         self,
@@ -145,9 +174,13 @@ class Gather:
         runner: EpisodeRunner,
         base_worker_id: int,
         num_workers: int,
+        reconnect: Optional[Callable[[], Connection]] = None,
     ) -> None:
         self.server = server_conn
         self.config = config
+        self.reconnect = reconnect
+        self.reconnects_used = 0
+        self._server_seen = time.monotonic()
         self.tasks: "queue.Queue[Any]" = queue.Queue()
         self.results: List[Dict[str, Any]] = []
         self._params_version = -1
@@ -161,19 +194,137 @@ class Gather:
         # running until every worker has drained its final result and closed
         self._exhausted = False
 
+    # -- server link ---------------------------------------------------
+    def _replace_server_conn(self, why: Exception) -> None:
+        """Reconnect with capped exponential backoff, or re-raise ``why``."""
+        if self.reconnect is None:
+            raise why if isinstance(why, Exception) else ConnectionError(str(why))
+        from scalerl_tpu.runtime.supervisor import exp_backoff
+
+        try:
+            self.server.close()
+        except Exception:  # noqa: BLE001 — link already broken
+            pass
+        while self.reconnects_used < self.config.max_reconnects:
+            delay = exp_backoff(
+                self.reconnects_used,
+                self.config.reconnect_backoff_s,
+                self.config.reconnect_backoff_cap_s,
+            )
+            self.reconnects_used += 1
+            logger.warning(
+                "gather: server link lost (%r); reconnecting in %.2fs "
+                "(attempt %d/%d)",
+                why, delay, self.reconnects_used, self.config.max_reconnects,
+            )
+            time.sleep(delay)
+            try:
+                self.server = self.reconnect()
+                self._server_seen = time.monotonic()
+                return
+            except (ConnectionError, OSError) as e:
+                why = e
+        raise ConnectionError(
+            f"gather: server unreachable after {self.reconnects_used} "
+            "reconnect attempts"
+        ) from why
+
+    def _recv_from_server(self) -> Any:
+        """One server frame, heartbeats filtered (pings answered inline).
+
+        On a reconnectable (socket) link with heartbeats enabled the wait is
+        bounded by the liveness timeout — a silently-dead server surfaces as
+        ``TimeoutError`` for the reconnect path instead of a forever-block.
+        Pipe links keep unbounded waits: a pipe cannot die silently (peer
+        death closes the fd), and a timeout would only convert a slow server
+        on a loaded host into a dead gather.
+        """
+        timeout = (
+            self.config.heartbeat_timeout
+            if self.config.heartbeat_interval_s > 0 and self.reconnect is not None
+            else None
+        )
+        while True:
+            msg = self.server.recv(timeout=timeout)
+            self._server_seen = time.monotonic()
+            if is_heartbeat(msg):
+                if msg.get("kind") == "ping":
+                    self.server.send(make_pong(msg))
+                continue
+            return msg
+
+    def _server_rpc(self, msg: Dict[str, Any], compress: bool = False) -> Any:
+        """send+recv with heartbeat filtering and reconnect-with-retry."""
+        while True:
+            try:
+                self.server.send(msg, compress=compress)
+                return self._recv_from_server()
+            except (ConnectionError, EOFError, OSError, TimeoutError) as e:
+                self._replace_server_conn(e)
+
+    def _server_send(self, msg: Dict[str, Any], compress: bool = False) -> None:
+        while True:
+            try:
+                self.server.send(msg, compress=compress)
+                return
+            except (ConnectionError, BrokenPipeError, OSError) as e:
+                self._replace_server_conn(e)
+
+    def _pump_server(self) -> None:
+        """Drain unsolicited server frames (pings) outside any RPC."""
+        try:
+            while self.server.poll(0):
+                msg = self.server.recv()
+                self._server_seen = time.monotonic()
+                if is_heartbeat(msg):
+                    if msg.get("kind") == "ping":
+                        self.server.send(make_pong(msg))
+                else:
+                    logger.warning(
+                        "gather: unsolicited server message %r",
+                        msg.get("kind") if isinstance(msg, dict) else type(msg),
+                    )
+        except (ConnectionError, EOFError, OSError) as e:
+            self._replace_server_conn(e)
+
+    def _check_server_liveness(self) -> None:
+        # silent-death is a TCP pathology: pipe links (reconnect=None) skip
+        # the staleness verdict — their failure mode is EOF, caught above
+        if self.config.heartbeat_interval_s <= 0 or self.reconnect is None:
+            return
+        if time.monotonic() - self._server_seen > self.config.heartbeat_timeout:
+            self._replace_server_conn(
+                TimeoutError(
+                    "no server traffic for "
+                    f"{self.config.heartbeat_timeout:.1f}s"
+                )
+            )
+
+    # -- main loop -----------------------------------------------------
     def run(self) -> None:
         try:
             while self.worker_conns:
-                ready, dead = wait_readable(self.worker_conns, timeout=0.02)
+                ready, dead = wait_readable(
+                    self.worker_conns + [self.server], timeout=0.02
+                )
                 for conn in dead:
-                    self.worker_conns.remove(conn)
+                    if conn is self.server:
+                        self._replace_server_conn(
+                            ConnectionError("server connection invalid")
+                        )
+                    else:
+                        self.worker_conns.remove(conn)
                 for conn in ready:
+                    if conn is self.server:
+                        self._pump_server()
+                        continue
                     try:
                         msg = conn.recv()
                     except (EOFError, OSError, ConnectionError):
                         self.worker_conns.remove(conn)
                         continue
                     self._handle(conn, msg)
+                self._check_server_liveness()
         finally:
             self._flush_results()
             for c in self.worker_conns:
@@ -184,7 +335,7 @@ class Gather:
         if kind == "task":
             if self.tasks.empty() and not self._exhausted:
                 n = self.config.prefetch(len(self.worker_conns))
-                batch = send_recv(self.server, {"kind": "task_batch", "n": n})
+                batch = self._server_rpc({"kind": "task_batch", "n": n})
                 for t in batch["v"]:
                     self.tasks.put(t)
             task = None if self._exhausted else self.tasks.get()
@@ -199,8 +350,8 @@ class Gather:
                 or have == self._params_version   # worker already at cache
                 or want > self._params_version    # task needs newer weights
             ):
-                reply = send_recv(
-                    self.server, {"kind": "params", "have": self._params_version}
+                reply = self._server_rpc(
+                    {"kind": "params", "have": self._params_version}
                 )
                 if reply is not None:
                     self._params_version = int(reply["version"])
@@ -216,13 +367,13 @@ class Gather:
         elif kind == "worker_error":
             # forward immediately (ahead of batched results) so the server
             # learns about the dead worker without waiting for a batch
-            self.server.send({"kind": "worker_error", "v": msg["v"]})
+            self._server_send({"kind": "worker_error", "v": msg["v"]})
         else:
             logger.warning("gather: unknown message kind %r", kind)
 
     def _flush_results(self) -> None:
         if self.results:
-            self.server.send(
+            self._server_send(
                 {"kind": "result_batch", "v": self.results},
                 compress=self.config.compress_uplink,
             )
@@ -235,9 +386,13 @@ def gather_main(
     runner: EpisodeRunner,
     base_worker_id: int,
     num_workers: int,
+    reconnect: Optional[Callable[[], Connection]] = None,
 ) -> None:
     try:
-        Gather(server_conn, config, runner, base_worker_id, num_workers).run()
+        Gather(
+            server_conn, config, runner, base_worker_id, num_workers,
+            reconnect=reconnect,
+        ).run()
     except (KeyboardInterrupt, ConnectionError, EOFError, OSError):
         pass
 
@@ -265,7 +420,17 @@ class WorkerServer:
         self.config = config
         self.task_source = task_source
         self.params = ParameterServer()
-        self.hub = QueueHub()
+        # heartbeat plane: the hub pings every gather link and reports a
+        # silently-dead one (socket open, peer gone) here within
+        # ~2 heartbeat intervals — closed sockets were already detected,
+        # silent ones previously hung the fleet forever
+        self.hub = QueueHub(
+            heartbeat_interval=config.heartbeat_interval_s,
+            heartbeat_timeout=config.heartbeat_timeout
+            if config.heartbeat_interval_s > 0
+            else 0.0,
+            on_dead=self._on_dead_connection,
+        )
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
         self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.total_results = 0
@@ -275,6 +440,17 @@ class WorkerServer:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._server_socks: List[Any] = []
+
+    def _on_dead_connection(self, conn: Connection, reason: str) -> None:
+        """Hub liveness verdict: mark the gather's workers dead so the
+        trainer sees it (``worker_errors``) instead of silently losing
+        throughput.  A socket gather that survived (e.g. network partition
+        healed) reconnects on its own and re-registers via the accept
+        loop."""
+        logger.error("fleet: gather connection declared dead (%s)", reason)
+        self.worker_errors.put(
+            {"worker_id": None, "task": None, "error": f"gather link dead: {reason}"}
+        )
 
     # -- trainer API ---------------------------------------------------
     def publish(self, weights: Any) -> int:
@@ -331,6 +507,11 @@ class WorkerServer:
                             "workers_per_gather": self.config.workers_per_gather,
                             "upload_batch": self.config.upload_batch,
                             "worker_port": self.config.worker_port,
+                            # liveness policy is the learner's call: remote
+                            # hosts adopt its heartbeat cadence so detection
+                            # bounds match on both ends of every link
+                            "heartbeat_interval_s": self.config.heartbeat_interval_s,
+                            "heartbeat_timeout_s": self.config.heartbeat_timeout_s,
                             "extra": self.config.extra,
                         },
                     }
@@ -593,6 +774,16 @@ class RemoteCluster:
             upload_batch=int(
                 remote_cfg.get("upload_batch", self.config.upload_batch)
             ),
+            heartbeat_interval_s=float(
+                remote_cfg.get(
+                    "heartbeat_interval_s", self.config.heartbeat_interval_s
+                )
+            ),
+            heartbeat_timeout_s=float(
+                remote_cfg.get(
+                    "heartbeat_timeout_s", self.config.heartbeat_timeout_s
+                )
+            ),
             extra={**self.config.extra, **remote_cfg.get("extra", {})},
         )
         from scalerl_tpu.utils.platform import safe_mp_context
@@ -629,4 +820,7 @@ class RemoteCluster:
 
 def _remote_gather_main(host, port, config, runner, base, n) -> None:
     conn = connect_socket(host, port)
-    gather_main(conn, config, runner, base, n)
+    # one attempt per call: Gather._replace_server_conn owns the capped
+    # exponential backoff schedule and the max_reconnects budget
+    reconnect = lambda: connect_socket(host, port, retries=1)  # noqa: E731
+    gather_main(conn, config, runner, base, n, reconnect=reconnect)

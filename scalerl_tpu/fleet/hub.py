@@ -8,6 +8,15 @@ Parity targets (``scalerl/hpc/connection.py``):
 - ``MultiProcessJobExecutor`` (:207-268) → ``JobExecutor``: dispatches jobs
   from a generator to idle worker processes and funnels (optionally
   post-processed) results into a bounded output queue.
+
+Heartbeats (runtime/supervisor.py vocabulary): with ``heartbeat_interval``
+set, the hub pings every connection on that cadence and drops peers whose
+uplink stays SILENT past the timeout — a closed socket already surfaces via
+select/EOF, but a silently-dead one (yanked cable, wedged peer, half-open
+TCP after a NAT reboot) previously hung forever.  Ping/pong frames are
+swallowed inside the hub (pings answered in the recv pump, pongs counted as
+liveness), so every protocol built on the hub gets liveness for free without
+seeing a new message kind.
 """
 
 from __future__ import annotations
@@ -21,14 +30,45 @@ from scalerl_tpu.fleet.transport import (
     open_worker_pipes,
     wait_readable,
 )
+from scalerl_tpu.runtime.supervisor import (
+    LivenessTracker,
+    is_heartbeat,
+    make_ping,
+    make_pong,
+)
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class QueueHub:
-    """Pumps a dynamic set of connections through in/out queues."""
+    """Pumps a dynamic set of connections through in/out queues.
 
-    def __init__(self, maxsize: int = 256) -> None:
+    ``heartbeat_interval`` > 0 arms the liveness plane: ping every
+    connection each interval; a connection with no inbound traffic (results,
+    RPCs, or pongs all count) for ``heartbeat_timeout`` seconds (default
+    2 x interval — the detection bound) is disconnected and reported via
+    ``on_dead(conn, reason)``.  A connection that has never spoken gets
+    ``first_contact_grace`` instead — spawned gather processes pay seconds
+    of interpreter+import boot before their pump starts answering.
+    """
+
+    def __init__(
+        self,
+        maxsize: int = 256,
+        heartbeat_interval: float = 0.0,
+        heartbeat_timeout: float = 0.0,
+        first_contact_grace: float = 120.0,
+        on_dead: Optional[Callable[[Connection, str], None]] = None,
+    ) -> None:
         self.input_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
         self.output_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout or 2.0 * heartbeat_interval
+        self.first_contact_grace = max(first_contact_grace, self.heartbeat_timeout)
+        self.on_dead = on_dead
+        self._liveness = LivenessTracker()
+        self._greeted: Set[Connection] = set()
         self._conns: Set[Connection] = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -36,6 +76,10 @@ class QueueHub:
             threading.Thread(target=self._recv_loop, daemon=True),
             threading.Thread(target=self._send_loop, daemon=True),
         ]
+        if heartbeat_interval > 0:
+            self._threads.append(
+                threading.Thread(target=self._heartbeat_loop, daemon=True)
+            )
         for t in self._threads:
             t.start()
 
@@ -46,10 +90,13 @@ class QueueHub:
     def add_connection(self, conn: Connection) -> None:
         with self._lock:
             self._conns.add(conn)
+        self._liveness.beat(conn)
 
     def disconnect(self, conn: Connection) -> None:
         with self._lock:
             self._conns.discard(conn)
+            self._greeted.discard(conn)
+        self._liveness.forget(conn)
         try:
             conn.close()
         except Exception:
@@ -88,6 +135,15 @@ class QueueHub:
                 except (EOFError, OSError, ConnectionError, ValueError):
                     self.disconnect(conn)
                     continue
+                self._liveness.beat(conn)
+                with self._lock:
+                    self._greeted.add(conn)
+                if is_heartbeat(msg):
+                    # swallowed here: pings answered in-pump, pongs are pure
+                    # liveness — consumers never see a heartbeat kind
+                    if msg.get("kind") == "ping":
+                        self.send(conn, make_pong(msg))
+                    continue
                 self.input_queue.put((conn, msg))
 
     def _send_loop(self) -> None:
@@ -100,6 +156,36 @@ class QueueHub:
                 conn.send(msg, compress=compress)
             except (BrokenPipeError, OSError, ConnectionError):
                 self.disconnect(conn)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            with self._lock:
+                conns = list(self._conns)
+                greeted = set(self._greeted)
+            now_stale = set(self._liveness.stale(self.heartbeat_timeout))
+            grace_stale = set(self._liveness.stale(self.first_contact_grace))
+            for conn in conns:
+                # detection bound: a peer that answers no ping for
+                # heartbeat_timeout (= 2 intervals by default) is dead even
+                # though its socket never closed
+                stale = now_stale if conn in greeted else grace_stale
+                if conn in stale:
+                    reason = (
+                        "heartbeat timeout: no traffic for "
+                        f"{self.heartbeat_timeout:.1f}s"
+                        if conn in greeted
+                        else "heartbeat timeout: peer never spoke within "
+                        f"{self.first_contact_grace:.1f}s of connecting"
+                    )
+                    logger.warning("hub: dropping silent connection (%s)", reason)
+                    self.disconnect(conn)
+                    if self.on_dead is not None:
+                        try:
+                            self.on_dead(conn, reason)
+                        except Exception:  # noqa: BLE001 — reporter must not kill the pump
+                            logger.exception("hub: on_dead callback failed")
+                else:
+                    self.send(conn, make_ping())
 
 
 class JobExecutor:

@@ -164,18 +164,34 @@ def accept_connection(server_sock: socket.socket, timeout: Optional[float] = Non
 
 
 def connect_socket(
-    host: str, port: int, retries: int = 30, delay: float = 0.2
+    host: str,
+    port: int,
+    retries: int = 30,
+    delay: float = 0.2,
+    backoff_cap: Optional[float] = None,
 ) -> SocketConnection:
-    """Connect with retry — fleet bring-up order is not deterministic."""
+    """Connect with retry — fleet bring-up order is not deterministic.
+
+    ``backoff_cap``: when set, the retry delay grows exponentially from
+    ``delay`` up to the cap (``supervisor.exp_backoff``) instead of staying
+    fixed — the reconnect-after-server-loss schedule, where hammering a
+    recovering learner at a fixed high rate helps nobody.
+    """
+    from scalerl_tpu.runtime.supervisor import exp_backoff
+
     last: Optional[Exception] = None
-    for _ in range(retries):
+    for attempt in range(retries):
         try:
             sock = socket.create_connection((host, port), timeout=10.0)
             sock.settimeout(None)
             return SocketConnection(sock)
         except OSError as e:  # server not up yet
             last = e
-            time.sleep(delay)
+            time.sleep(
+                exp_backoff(attempt, delay, backoff_cap)
+                if backoff_cap is not None
+                else delay
+            )
     raise ConnectionError(f"could not connect to {host}:{port}") from last
 
 

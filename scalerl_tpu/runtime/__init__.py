@@ -18,6 +18,12 @@ if TYPE_CHECKING:  # static analyzers see the real symbols
     )
     from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
     from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401
+    from scalerl_tpu.runtime.supervisor import (  # noqa: F401
+        CheckpointCadence,
+        PreemptionGuard,
+        StallError,
+        StallWatchdog,
+    )
 
 _EXPORTS = {
     "DeviceActorLearnerLoop": "scalerl_tpu.runtime.device_loop",
@@ -26,6 +32,10 @@ _EXPORTS = {
     "pipelined_drive": "scalerl_tpu.runtime.dispatch",
     "ParameterServer": "scalerl_tpu.runtime.param_server",
     "RolloutQueue": "scalerl_tpu.runtime.rollout_queue",
+    "CheckpointCadence": "scalerl_tpu.runtime.supervisor",
+    "PreemptionGuard": "scalerl_tpu.runtime.supervisor",
+    "StallError": "scalerl_tpu.runtime.supervisor",
+    "StallWatchdog": "scalerl_tpu.runtime.supervisor",
 }
 
 __all__ = list(_EXPORTS)

@@ -295,10 +295,18 @@ class DeviceActorLearnerLoop:
         max_calls: int,
         on_metrics: Optional[Callable[[int, float, Dict[str, float]], None]] = None,
         chunks_in_flight: int = 2,
+        progress=None,
+        should_stop: Optional[Callable[[], bool]] = None,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
         """Drive fused chunks until the *windowed* mean episode return (over
         episodes completed since the previous chunk) reaches ``threshold``,
         or ``max_calls`` chunks elapse.
+
+        ``progress``: a supervisor ``ProgressCounter`` bumped once per
+        dispatched chunk (stall-watchdog liveness for the host driver).
+        ``should_stop``: polled before each dispatch; True stops cleanly
+        with in-flight chunks drained and counted — the preemption-guard
+        safe point for the fused path.
 
         ``chunks_in_flight`` chunks stay dispatched ahead of the host's
         metric reads (one batched device->host transfer per chunk), so the
@@ -336,9 +344,13 @@ class DeviceActorLearnerLoop:
                     hit = True
 
         for i in range(max_calls):
+            if should_stop is not None and should_stop():
+                break
             key, sub = jax.random.split(key)
             state, carry, m = self.train_chunk(state, carry, sub)
             frames += frames_per_call
+            if progress is not None:
+                progress.bump()
             # the sums ride the fused metrics — no extra host dispatches
             consume(pipe.push(i, m))
             if hit:
@@ -356,6 +368,8 @@ class DeviceActorLearnerLoop:
         num_calls: int,
         on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None,
         chunks_in_flight: int = 2,
+        progress=None,
+        should_stop: Optional[Callable[[], bool]] = None,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
         """Drive ``num_calls`` fused mega-steps; one host dispatch each.
 
@@ -364,6 +378,12 @@ class DeviceActorLearnerLoop:
         never idles waiting on the host (``chunks_in_flight=1`` restores
         the synchronous read-after-every-chunk path).  ``on_metrics(i,
         metrics)`` still fires once per chunk, in order.
+
+        ``progress``/``should_stop``: supervision hooks (see ``run_until``).
+        The returned metrics carry ``chunks_done`` — with an early
+        ``should_stop`` the frame count is ``chunks_done *
+        frames_per_call``, which the preemption checkpoint must record
+        instead of the requested budget.
         """
         metrics: Dict[str, float] = {}
         pipe = MetricsPipeline(depth=chunks_in_flight)
@@ -380,10 +400,17 @@ class DeviceActorLearnerLoop:
                 if on_metrics is not None:
                     on_metrics(i, m)
 
+        chunks_done = 0
         for i in range(num_calls):
+            if should_stop is not None and should_stop():
+                break
             key, sub = jax.random.split(key)
             state, carry, dev_metrics = self.train_chunk(state, carry, sub)
+            chunks_done += 1
+            if progress is not None:
+                progress.bump()
             consume(pipe.push(i, dev_metrics))
         consume(pipe.drain())
         jax.block_until_ready(state.params)
+        metrics["chunks_done"] = float(chunks_done)
         return state, carry, metrics

@@ -123,5 +123,18 @@ class RolloutQueue:
         for i in idxs:
             self.free.put(i)
 
+    def stats(self) -> Dict[str, int]:
+        """Occupancy snapshot for watchdog stall reports: free/full queue
+        depths (approximate under concurrency — qsize is advisory), total
+        slots, and how many are in flight (acquired or being consumed)."""
+        free, full = self.free.qsize(), self.full.qsize()
+        return {
+            "slots": self.num_slots,
+            "free": free,
+            "full": full,
+            "in_flight": max(self.num_slots - free - full, 0),
+            "closed": int(self._closed.is_set()),
+        }
+
     def close(self) -> None:
         self._closed.set()

@@ -225,6 +225,20 @@ class ShmRolloutRing:
                 out[name][b] = view
         return out
 
+    def stats(self) -> Dict[str, int]:
+        """Occupancy snapshot for watchdog stall reports.
+
+        Fallback mode reports approximate free/full depths (qsize is
+        advisory); the native ring exposes no depth API, so only slot count
+        and the closed flag are reported there — still enough to tell "ring
+        closed under us" from "producers wedged".
+        """
+        out = {"slots": self.num_slots, "closed": int(self.closed)}
+        if not self.native:
+            out["free"] = self._free.qsize()
+            out["full"] = self._full.qsize()
+        return out
+
     # -- lifecycle -----------------------------------------------------
     @property
     def closed(self) -> bool:

@@ -37,6 +37,11 @@ from scalerl_tpu.data.trajectory import TrajectorySpec, batch_to_trajectory
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+from scalerl_tpu.runtime.supervisor import (
+    CheckpointCadence,
+    PreemptionGuard,
+    StallWatchdog,
+)
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 from scalerl_tpu.utils.timers import Timings
@@ -337,13 +342,32 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
             envs = self._probe_env if i == 0 else fn()
             actors.append(_ActorThread(i, self, envs))
         self.actors = actors  # exposed for phase-timing inspection (bench)
+        # supervision: SIGTERM/SIGINT -> save_resume at the next learn-step
+        # boundary; watchdog dumps all-thread stacks + queue occupancy when
+        # neither env frames nor learn steps advance for the deadline.
+        # Installed after env construction so a failing factory cannot leak
+        # signal handlers (the finally below owns the teardown).
+        guard = PreemptionGuard().install() if args.handle_preemption else None
+        watchdog: Optional[StallWatchdog] = None
+        learn_progress = None
+        if args.watchdog_timeout_s > 0:
+            watchdog = StallWatchdog(
+                args.watchdog_timeout_s, name="host-actor-learner"
+            )
+            watchdog.watch("env_frames", lambda: self.env_frames)
+            learn_progress = watchdog.counter("learn_steps")
+            watchdog.add_probe("rollout_queue", self.queue.stats)
+            watchdog.add_probe("actor_restarts", lambda: self.actor_restarts)
+            watchdog.start()
         for a in actors:
             a.start()
 
         start = time.time()
         start_frames = self.env_frames  # nonzero after resume
         last_log_frames = start_frames
-        last_save_frames = start_frames
+        cadence = CheckpointCadence(
+            args.save_frequency, args.checkpoint_interval_s, start_frames
+        )
         n_slots = max(args.batch_size // self.envs_per_actor, 1)
         metrics: Dict = {}
 
@@ -404,11 +428,21 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
 
         try:
             while self.env_frames < total_frames and not self.stop_event.is_set():
+                if watchdog is not None:
+                    watchdog.check()
+                if guard is not None and guard.triggered:
+                    # preemption safe point: the previous learn step is
+                    # complete, no slot is half-consumed
+                    if args.save_model and not args.disable_checkpoint:
+                        self.save_resume()
+                    break
                 traj = next_traj()
                 # device metrics stay un-materialized: float() only at log
                 # time, so the loop dispatches the next step without a sync
                 metrics = self.agent.learn_device(traj)
                 self.learn_timings.time("learn")
+                if learn_progress is not None:
+                    learn_progress.bump()
                 # version bump only — actors do central inference on the
                 # live device params; a to_host push would force a full
                 # device->host param fetch (a sync) every learn step
@@ -417,9 +451,9 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                 if (
                     args.save_model
                     and not args.disable_checkpoint
-                    and self.env_frames - last_save_frames >= args.save_frequency
+                    and cadence.due(self.env_frames)
                 ):
-                    last_save_frames = self.env_frames
+                    cadence.mark_saved(self.env_frames)
                     self.save_resume()
 
                 if self.env_frames - last_log_frames >= args.logger_frequency:
@@ -445,6 +479,10 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                         )
         finally:
             self.stop_event.set()
+            if watchdog is not None:
+                watchdog.stop()
+            if guard is not None:
+                guard.restore()
             self.queue.close()
             for t in assemble_threads:
                 t.join(timeout=3.0)
@@ -555,12 +593,36 @@ class DeviceActorLearnerTrainer(BaseTrainer):
                     f"frames {frames} | sps {sps:.0f} | return {m.get('return_mean', float('nan')):.2f}"
                 )
 
-        state, carry, metrics = self.loop.run(
-            self.agent.state, carry, key, num_calls, on_metrics=on_metrics,
-            chunks_in_flight=self.chunks_in_flight,
-        )
+        # supervision: a preemption signal stops dispatch at the next chunk
+        # boundary (in-flight chunks drain and count); the watchdog's
+        # progress counter is bumped by the loop per dispatched chunk
+        guard = PreemptionGuard().install() if args.handle_preemption else None
+        watchdog: Optional[StallWatchdog] = None
+        progress = None
+        if args.watchdog_timeout_s > 0:
+            watchdog = StallWatchdog(
+                args.watchdog_timeout_s, name="device-actor-learner"
+            )
+            progress = watchdog.counter("fused_chunks")
+            watchdog.start()
+        try:
+            state, carry, metrics = self.loop.run(
+                self.agent.state, carry, key, num_calls, on_metrics=on_metrics,
+                chunks_in_flight=self.chunks_in_flight,
+                progress=progress,
+                should_stop=(lambda: guard.triggered) if guard is not None else None,
+            )
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+            if guard is not None:
+                guard.restore()
         self.agent.state = state
-        frames = done_frames + num_calls * frames_per_call
+        # chunks_done < num_calls after a preemption: checkpoint the frames
+        # actually trained, not the requested budget, so resume restores
+        # matching counters
+        chunks_done = int(metrics.pop("chunks_done", num_calls))
+        frames = done_frames + chunks_done * frames_per_call
         if args.save_model and not args.disable_checkpoint:
             self.save_resume_checkpoint(
                 {"agent": state, "env_frames": np.asarray(frames, np.int64)},

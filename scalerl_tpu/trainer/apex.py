@@ -30,6 +30,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from contextlib import nullcontext
 from typing import Dict, Optional
 
 import jax
@@ -40,6 +41,11 @@ from scalerl_tpu.agents.dqn import DQNAgent, make_dqn_learn_fn, make_dqn_priorit
 from scalerl_tpu.config import ApexArguments
 from scalerl_tpu.data.prioritized import PrioritizedReplayBuffer
 from scalerl_tpu.runtime.param_server import ParameterServer
+from scalerl_tpu.runtime.supervisor import (
+    CheckpointCadence,
+    PreemptionGuard,
+    StallWatchdog,
+)
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 from scalerl_tpu.utils.schedulers import LinearDecayScheduler
@@ -141,14 +147,15 @@ class _ApexActorThread(threading.Thread):
             trunc_buf = np.zeros((T, W), bool)
             self.timings.reset()
             for t in range(T):
-                actions = np.asarray(
-                    agent._act(
-                        agent.state.params,
-                        jnp.asarray(obs, jnp.float32),
-                        self.eps,
-                        self._next_key(),
+                with tr._dispatch_guard():
+                    actions = np.asarray(
+                        agent._act(
+                            agent.state.params,
+                            jnp.asarray(obs, jnp.float32),
+                            self.eps,
+                            self._next_key(),
+                        )
                     )
-                )
                 next_obs, reward, term, trunc, infos = self.envs.step(actions)
                 real_next = np.asarray(next_obs).copy()
                 final_obs = infos.get("final_obs") if isinstance(infos, dict) else None
@@ -179,17 +186,23 @@ class _ApexActorThread(threading.Thread):
                 "done": jnp.asarray(slab["done"]),
                 "n_steps": jnp.asarray(slab["n_steps"]),
             }
-            st = agent.state  # one snapshot: params/target_params stay paired
-            prio = tr._priority(
-                st.params,
-                st.target_params,
-                dev_slab["obs"],
-                dev_slab["action"],
-                dev_slab["reward"],
-                dev_slab["next_obs"],
-                dev_slab["done"],
-                dev_slab["n_steps"],
-            )
+            with tr._dispatch_guard():
+                st = agent.state  # one snapshot: params/target_params stay paired
+                prio = tr._priority(
+                    st.params,
+                    st.target_params,
+                    dev_slab["obs"],
+                    dev_slab["action"],
+                    dev_slab["reward"],
+                    dev_slab["next_obs"],
+                    dev_slab["done"],
+                    dev_slab["n_steps"],
+                )
+                if tr._mesh_lock is not None:
+                    # drain before releasing the lock: a meshed priority
+                    # program still in flight while the learner enqueues its
+                    # own multi-device program re-opens the ordering hazard
+                    prio.block_until_ready()
             self.timings.time("priority")
             # stop-aware put: if the learner exits while the queue is full,
             # a bare put() would deadlock this thread past teardown
@@ -265,6 +278,19 @@ class ApexTrainer(BaseTrainer):
             self.buffer = ShardedPrioritizedReplay(mesh=mesh, **buffer_kw)
         else:
             self.buffer = PrioritizedReplayBuffer(**buffer_kw)
+        # Meshed state makes EVERY jitted call here (actor _act, priority,
+        # learn, PER insert/sample) a multi-device program.  XLA runs each
+        # device's queue in enqueue order, so two threads dispatching
+        # multi-device programs concurrently can enqueue them in different
+        # orders on different devices and deadlock the whole client — the
+        # exact wedge the seed suite hit in
+        # test_apex_sharded_replay_mesh_e2e (actors inside _act, learner
+        # inside the pjit'd add_with_priorities, forever).  One lock around
+        # every dispatch site serializes enqueue ordering; single-device
+        # runs keep the lock-free fast path.
+        self._mesh_lock: Optional[threading.Lock] = (
+            threading.Lock() if mesh is not None else None
+        )
         self._priority = jax.jit(
             make_dqn_priority_fn(agent.network, args.gamma, args.double_dqn)
         )
@@ -300,6 +326,10 @@ class ApexTrainer(BaseTrainer):
         self.timings = Timings()
 
     # ------------------------------------------------------------------
+    def _dispatch_guard(self):
+        """Serialize multi-device dispatch under a mesh (see __init__)."""
+        return self._mesh_lock if self._mesh_lock is not None else nullcontext()
+
     def _actor_error(self, actor_id: int, err: BaseException) -> None:
         self._errors.put((actor_id, err))
 
@@ -311,7 +341,8 @@ class ApexTrainer(BaseTrainer):
                 slab, prio = self._slab_queue.get(block=block and drained == 0, timeout=1.0)
             except queue.Empty:
                 break
-            self.buffer.add_with_priorities(slab, prio)
+            with self._dispatch_guard():
+                self.buffer.add_with_priorities(slab, prio)
             self.timings.time("insert")
             drained += 1
             block = False
@@ -320,12 +351,13 @@ class ApexTrainer(BaseTrainer):
     def train_step(self) -> Dict[str, float]:
         beta = self.per_beta.value(self.global_step)
         self.timings.reset()
-        batch = self.buffer.sample(self.args.batch_size, beta=beta)
-        self.timings.time("sample")
-        info = self.agent.learn(batch)
-        self.timings.time("learn")
-        self.buffer.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
-        self.timings.time("update_prio")
+        with self._dispatch_guard():
+            batch = self.buffer.sample(self.args.batch_size, beta=beta)
+            self.timings.time("sample")
+            info = self.agent.learn(batch)
+            self.timings.time("learn")
+            self.buffer.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
+            self.timings.time("update_prio")
         info.pop("td_abs", None)
         self.learn_steps += 1
         if self.learn_steps % self.args.actor_update_frequency == 0:
@@ -382,7 +414,8 @@ class ApexTrainer(BaseTrainer):
         ep_ret = np.zeros(num_envs)
         prev_done = np.ones(num_envs, bool)
         while len(returns) < n_episodes:
-            actions = self.agent.predict(obs, done=prev_done)
+            with self._dispatch_guard():  # actors dispatch concurrently
+                actions = self.agent.predict(obs, done=prev_done)
             obs, reward, term, trunc, _ = envs.step(np.asarray(actions))
             ep_ret += reward
             done = np.logical_or(term, trunc)
@@ -398,6 +431,21 @@ class ApexTrainer(BaseTrainer):
         args = self.args
         if self.resuming:
             self.try_resume()
+        # preemption (SIGTERM/SIGINT) -> save_resume at the next loop
+        # boundary; stall watchdog dumps all-thread stacks + queue depths
+        # when neither env steps nor learn steps advance for the deadline
+        guard = PreemptionGuard().install() if args.handle_preemption else None
+        watchdog: Optional[StallWatchdog] = None
+        if args.watchdog_timeout_s > 0:
+            watchdog = StallWatchdog(args.watchdog_timeout_s, name="apex")
+            watchdog.watch("global_step", lambda: self.global_step)
+            watchdog.watch("learn_steps", lambda: self.learn_steps)
+            watchdog.add_probe("slab_queue_depth", self._slab_queue.qsize)
+            watchdog.add_probe("replay_size", lambda: len(self.buffer))
+            watchdog.add_probe(
+                "actor_errors_pending", lambda: self._errors.qsize()
+            )
+            watchdog.start()
         actors = [
             _ApexActorThread(i, self, env) for i, env in enumerate(self._actor_envs)
         ]
@@ -410,10 +458,18 @@ class ApexTrainer(BaseTrainer):
         # eval sweep at the restored step
         last_log = self.global_step
         last_eval = self.global_step
-        last_save = self.global_step
+        cadence = CheckpointCadence(
+            args.save_frequency, args.checkpoint_interval_s, self.global_step
+        )
         train_info: Dict[str, float] = {}
         try:
             while self.global_step < args.max_timesteps:
+                if watchdog is not None:
+                    watchdog.check()
+                if guard is not None and guard.triggered:
+                    if args.save_model and not args.disable_checkpoint:
+                        self.save_resume()
+                    break
                 if not self._errors.empty():
                     actor_id, err = self._errors.get()
                     raise RuntimeError(f"apex actor {actor_id} crashed") from err
@@ -450,12 +506,16 @@ class ApexTrainer(BaseTrainer):
                 if (
                     args.save_model
                     and not args.disable_checkpoint
-                    and self.global_step - last_save >= args.save_frequency
+                    and cadence.due(self.global_step)
                 ):
-                    last_save = self.global_step
+                    cadence.mark_saved(self.global_step)
                     self.save_resume()
         finally:
             self._stop.set()
+            if watchdog is not None:
+                watchdog.stop()
+            if guard is not None:
+                guard.restore()
             for a in actors:
                 a.join(timeout=10.0)
             if args.save_model and not args.disable_checkpoint and self.is_main_process:

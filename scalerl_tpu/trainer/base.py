@@ -78,7 +78,14 @@ class BaseTrainer:
             return
         from scalerl_tpu.utils.checkpoint import save_checkpoint
 
-        save_checkpoint(self.resume_ckpt_path, state)
+        # keep-last-N retention: the displaced checkpoint survives as
+        # resume.prev (…prevN) and load falls back to it when the latest is
+        # corrupt — a preemption mid-save can never cost the run
+        save_checkpoint(
+            self.resume_ckpt_path,
+            state,
+            keep_last=getattr(self.args, "checkpoint_keep_last", 1),
+        )
         self.logger.save_data(0, env_step, grad_step)
 
     def load_resume_checkpoint(self, target: dict) -> Optional[dict]:

@@ -39,6 +39,11 @@ from scalerl_tpu.config import ImpalaArguments
 from scalerl_tpu.fleet.transport import PipeConnection, send_recv, wait_readable
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
+from scalerl_tpu.runtime.supervisor import (
+    CheckpointCadence,
+    PreemptionGuard,
+    StallWatchdog,
+)
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.logging import get_logger
 
@@ -485,14 +490,39 @@ class ProcessActorLearnerTrainer(BaseTrainer):
         self.param_server.push(self.agent.get_weights())
         if not self.procs:
             self.start_actors()
+        # supervision: preemption saves at the next slot boundary; watchdog
+        # dumps stacks + ring occupancy when frames stop advancing (a wedged
+        # actor fleet or a dead weight service both freeze this counter)
+        guard = PreemptionGuard().install() if args.handle_preemption else None
+        watchdog: Optional[StallWatchdog] = None
+        if args.watchdog_timeout_s > 0:
+            watchdog = StallWatchdog(
+                args.watchdog_timeout_s, name="process-actor-learner"
+            )
+            watchdog.watch("env_frames", lambda: self.env_frames)
+            watchdog.add_probe("shm_ring", self.ring.stats)
+            watchdog.add_probe("actor_restarts", lambda: self.actor_restarts)
+            watchdog.add_probe(
+                "actors_alive",
+                lambda: sum(1 for p in self.procs if p.is_alive()),
+            )
+            watchdog.start()
         start = time.time()
         start_frames = self.env_frames  # nonzero after resume
         last_log = start_frames
-        last_save = start_frames
+        cadence = CheckpointCadence(
+            args.save_frequency, args.checkpoint_interval_s, start_frames
+        )
         metrics: Dict[str, float] = {}
         self._lag = float("nan")
         try:
             while self.env_frames < total_frames:
+                if watchdog is not None:
+                    watchdog.check()
+                if guard is not None and guard.triggered:
+                    if args.save_model and not args.disable_checkpoint:
+                        self.save_resume()
+                    break
                 idxs = self._pop_batch(n_slots)
                 if idxs is None:
                     break
@@ -507,9 +537,9 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                 if (
                     args.save_model
                     and not args.disable_checkpoint
-                    and self.env_frames - last_save >= args.save_frequency
+                    and cadence.due(self.env_frames)
                 ):
-                    last_save = self.env_frames
+                    cadence.mark_saved(self.env_frames)
                     self.save_resume()
 
                 if self.env_frames - last_log >= args.logger_frequency:
@@ -531,6 +561,10 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                             f"return {ret:.1f} | lag {self._lag:.1f}"
                         )
         finally:
+            if watchdog is not None:
+                watchdog.stop()
+            if guard is not None:
+                guard.restore()
             self.stop()
         if args.save_model and not args.disable_checkpoint:
             self.save_resume()

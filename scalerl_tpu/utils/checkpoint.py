@@ -6,26 +6,65 @@ Parity target: per-agent ``save_checkpoint``/``load_checkpoint``
 (``impala_atari.py:496-515``), upgraded to Orbax: atomic directory writes,
 async-friendly, and shard-aware for multi-host meshes (the reference's
 ``torch.save`` has none of these).
+
+Crash-safety contract (the supervision layer leans on this):
+
+- a save NEVER has a window where no complete checkpoint exists on disk:
+  the new state lands in ``path.tmp`` first, the previous checkpoint is
+  *retained* as ``path.prev`` (…``path.prevK`` up to ``keep_last``) while the
+  new one swaps in — never deleted before the swap;
+- a restore that finds the latest dir corrupt/partial (a preemption mid-swap,
+  a torn filesystem) falls back through the retained ``.prev`` chain instead
+  of failing the run.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from scalerl_tpu.utils.logging import get_logger
 
-def save_checkpoint(path: str, state: Any) -> str:
-    """Save a pytree to ``path`` (write-new-then-swap). Returns the path.
+logger = get_logger(__name__)
 
-    The full save lands in a ``.tmp`` sibling first, so a crash mid-save
-    never destroys the previous checkpoint — the only unprotected window is
-    the final rmtree+rename metadata swap.
+
+def _prev_path(path: str, k: int) -> str:
+    """k-th displaced checkpoint: ``path.prev``, ``path.prev2``, ..."""
+    return path + (".prev" if k == 1 else f".prev{k}")
+
+
+def checkpoint_fallbacks(path: str) -> List[str]:
+    """Existing retained predecessors of ``path``, newest first."""
+    out: List[str] = []
+    k = 1
+    while True:
+        p = _prev_path(path, k)
+        if not os.path.exists(p):
+            break
+        out.append(p)
+        k += 1
+    return out
+
+
+def save_checkpoint(path: str, state: Any, keep_last: int = 1) -> str:
+    """Save a pytree to ``path`` (write-new-then-rotate). Returns the path.
+
+    The full save lands in a ``.tmp`` sibling first; the previous checkpoint
+    is then ROTATED to ``path.prev`` (not deleted) before the atomic
+    ``rename(tmp, path)``, so every instant of the sequence has at least one
+    complete checkpoint on disk — a preemption mid-save costs nothing, and a
+    corrupt latest restores from ``.prev`` (``load_checkpoint`` falls back
+    automatically).
+
+    ``keep_last``: how many displaced checkpoints to retain
+    (``path.prev`` … ``path.prevN``); 0 deletes the predecessor after the
+    new checkpoint has landed (still no unprotected window — the delete
+    happens strictly after the rename).
     """
-    import shutil
-
     path = os.path.abspath(path)
     tmp = path + ".tmp"
     checkpointer = ocp.StandardCheckpointer()
@@ -33,15 +72,53 @@ def save_checkpoint(path: str, state: Any) -> str:
         shutil.rmtree(tmp)
     checkpointer.save(tmp, state)
     checkpointer.wait_until_finished()
+    # rotate the retention chain oldest-first so each rename target is free
     if os.path.exists(path):
-        shutil.rmtree(path)
+        oldest = _prev_path(path, max(keep_last, 1))
+        if os.path.exists(oldest):
+            shutil.rmtree(oldest)
+        for k in range(max(keep_last, 1) - 1, 0, -1):
+            src = _prev_path(path, k)
+            if os.path.exists(src):
+                os.rename(src, _prev_path(path, k + 1))
+        os.rename(path, _prev_path(path, 1))
     os.rename(tmp, path)
+    if keep_last <= 0:
+        prev = _prev_path(path, 1)
+        if os.path.exists(prev):
+            shutil.rmtree(prev)
     return path
 
 
-def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
-    """Restore a pytree from ``path``; ``target`` provides structure/dtypes."""
+def load_checkpoint(
+    path: str, target: Optional[Any] = None, fallback: bool = True
+) -> Any:
+    """Restore a pytree from ``path``; ``target`` provides structure/dtypes.
+
+    ``fallback``: when the latest checkpoint is corrupt or partial (restore
+    raises), fall back through the retained ``path.prev`` chain — the
+    preemption-safety contract of ``save_checkpoint``.  The original error
+    is chained if every candidate fails.
+    """
     path = os.path.abspath(path)
+    candidates = [path] + (checkpoint_fallbacks(path) if fallback else [])
+    first_err: Optional[Exception] = None
+    for cand in candidates:
+        try:
+            return _restore(cand, target)
+        except Exception as e:  # noqa: BLE001 — try the retained predecessor
+            if first_err is None:
+                first_err = e
+            if fallback and cand != candidates[-1]:
+                logger.warning(
+                    "checkpoint %s failed to restore (%r); falling back to %s",
+                    cand, e, candidates[candidates.index(cand) + 1],
+                )
+    assert first_err is not None
+    raise first_err
+
+
+def _restore(path: str, target: Optional[Any]) -> Any:
     checkpointer = ocp.StandardCheckpointer()
     if target is not None:
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)

@@ -10,7 +10,15 @@ NOTE: under the axon TPU tunnel the ``JAX_PLATFORMS`` env var is *ignored*
 "CPU" tests silently run over the TPU network tunnel at ~100ms/call.
 """
 
+import faulthandler
 import os
+
+# Hang diagnosis for the WHOLE suite: crashes (SIGSEGV etc.) dump all-thread
+# stacks, and per-test stall dumps come from pytest's faulthandler plugin
+# (``faulthandler_timeout`` in pytest.ini).  pytest enables faulthandler for
+# its own run; this covers spawned helpers that import conftest and any
+# runner invoking the tests without the plugin.
+faulthandler.enable()
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:

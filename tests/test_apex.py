@@ -170,7 +170,18 @@ def test_apex_trainer_e2e_learns_cartpole(tmp_path):
 def test_apex_sharded_replay_mesh_e2e(tmp_path):
     """Pod-shape Ape-X: dp/fsdp-meshed learner + lane-sharded PER (the
     BASELINE "replay sharded across TPU HBM" row) trains end to end, with
-    priorities flowing back through global physical indices."""
+    priorities flowing back through global physical indices.
+
+    This test used to deadlock the whole suite: meshed state makes every
+    jitted call a multi-device program, and actor threads dispatching
+    ``_act`` concurrently with the learner's pjit'd PER insert could enqueue
+    two programs in different orders on different devices — XLA runs each
+    device's queue in order, so the client wedged forever (seed tier-1 died
+    at 12 dots eating the full budget).  ``ApexTrainer`` now serializes
+    multi-device dispatch behind a mesh lock; the watchdog below is the
+    regression net — if the wedge ever returns, the run dumps all-thread
+    stacks and dies inside the test budget instead of eating it.
+    """
     from scalerl_tpu.data.sharded_replay import ShardedPrioritizedReplay
 
     args = _args(
@@ -178,6 +189,7 @@ def test_apex_sharded_replay_mesh_e2e(tmp_path):
         logger_frequency=10**9,
         eval_frequency=10**9,
         work_dir=str(tmp_path),
+        watchdog_timeout_s=120.0,
     )
 
     def make_envs(actor_id):

@@ -299,6 +299,101 @@ def test_remote_cluster_over_sockets():
 
 
 # ---------------------------------------------------------------------------
+# heartbeats + reconnect (runtime/supervisor.py liveness plane)
+
+
+def test_heartbeat_detects_silent_peer_and_keeps_responsive_one():
+    """A gather link that goes SILENT (socket open, peer wedged) is declared
+    dead within ~2 heartbeat intervals and surfaced in ``worker_errors``;
+    a link that keeps answering pings stays registered."""
+    config = FleetConfig(num_workers=1, heartbeat_interval_s=0.2)
+    server = WorkerServer(config, _make_task_source(0))
+    server.start(listen=False)
+
+    # peer A: speaks once (so first-contact grace does not apply), then wedges
+    a_parent, a_child = mp.Pipe(duplex=True)
+    silent = PipeConnection(a_child)
+    server.add_gather_connection(PipeConnection(a_parent))
+    silent.send({"kind": "task_batch", "n": 1})
+    assert silent.recv(timeout=10.0)["kind"] == "task_batch"  # greeted
+
+    # peer B: a responsive pump that answers every ping
+    b_parent, b_child = mp.Pipe(duplex=True)
+    responsive = PipeConnection(b_child)
+    server.add_gather_connection(PipeConnection(b_parent))
+    responsive.send({"kind": "task_batch", "n": 1})
+    assert responsive.recv(timeout=10.0)["kind"] == "task_batch"
+    stop = threading.Event()
+
+    def pong_pump():
+        while not stop.is_set():
+            try:
+                if responsive.poll(0.05):
+                    msg = responsive.recv()
+                    if isinstance(msg, dict) and msg.get("kind") == "ping":
+                        responsive.send({"kind": "pong", "t": msg.get("t", 0.0)})
+            except (EOFError, OSError):
+                return
+
+    pump = threading.Thread(target=pong_pump, daemon=True)
+    pump.start()
+    try:
+        # detection bound: 2 x interval (+ scheduling slack on loaded CI)
+        err = server.worker_errors.get(timeout=30.0)
+        assert "heartbeat" in err["error"]
+        # only the silent peer was dropped; the responsive one survived
+        deadline = time.monotonic() + 5.0
+        while server.hub.connection_count() > 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.hub.connection_count() == 1
+        assert server.worker_errors.empty()
+    finally:
+        stop.set()
+        pump.join(timeout=2.0)
+        server.stop()
+
+
+def test_remote_gather_reconnects_after_link_cut():
+    """Sever every gather link server-side mid-run: socket gathers reconnect
+    with capped exponential backoff (instead of dying) and results keep
+    flowing — the elastic half of the acceptance criterion."""
+    entry_port, worker_port = _free_port(), _free_port()
+    config = FleetConfig(
+        num_workers=2,
+        workers_per_gather=2,
+        upload_batch=1,
+        entry_port=entry_port,
+        worker_port=worker_port,
+        heartbeat_interval_s=0.2,
+        reconnect_backoff_s=0.05,
+        reconnect_backoff_cap_s=0.5,
+        max_reconnects=10,
+    )
+    server = WorkerServer(config, _make_task_source(60, lambda: server.params.version))
+    server.publish({"w": np.array([1.0, 2.0], np.float32)})
+    server.start(listen=True)
+    remote = RemoteCluster(config, _bandit_runner)
+    remote.start()
+    try:
+        pre = _drain(server, 5)
+        assert len(pre) == 5
+        # cut every established gather link at the server (simulated network
+        # blip: the accept loop stays up, so reconnects land)
+        with server.hub._lock:
+            conns = list(server.hub._conns)
+        assert conns, "no gather links established"
+        for c in conns:
+            server.hub.disconnect(c)
+        post = _drain(server, 10)
+        assert len(post) == 10, f"only {len(post)} results after link cut"
+        # reconnected gathers still serve the published weights
+        assert all(r["param_version"] == 1 for r in post)
+    finally:
+        remote.join()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # generation
 
 

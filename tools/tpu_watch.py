@@ -56,14 +56,38 @@ def ensure_header() -> None:
             )
 
 
+def _watchdog_dump_marker(bl, start_offset: int) -> str:
+    """Scan the step's log segment for supervision-layer stall evidence.
+
+    Returns ``"+stall-dump"`` when the segment contains a StallWatchdog
+    report (``runtime/supervisor.py``) or a pytest/faulthandler timeout
+    dump — the per-step summary then records that the hang was *diagnosed*
+    (stacks + queue depths are in the payload log), not just killed.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        if "StallWatchdog" in segment or "Timeout (" in segment:
+            return "+stall-dump"
+    except Exception:  # noqa: BLE001 - diagnosis must not fail the watcher
+        pass
+    return ""
+
+
 def _run_step(cmd, env, bl, timeout_s: float) -> str:
     """Run one payload step; on timeout SIGTERM first (bench.py's handler
     prints its banked JSON and reaps its JAX children — a straight SIGKILL
     would orphan a TPU-holding grandchild that then starves the next step).
 
     Returns the step outcome: ``"ok"`` (exit 0), ``"rc=N"``, or
-    ``"timeout"`` — the per-step evidence the witness commit summarizes.
+    ``"timeout"`` — plus a ``+stall-dump`` suffix when the step's log
+    carries a watchdog/faulthandler stack dump (the supervision layer
+    diagnosed the stall) — the per-step evidence the witness commit
+    summarizes.
     """
+    start_offset = bl.tell()
     p = subprocess.Popen(cmd, env=env, stdout=bl, stderr=bl, cwd=REPO)
     try:
         p.wait(timeout=timeout_s)
@@ -75,8 +99,10 @@ def _run_step(cmd, env, bl, timeout_s: float) -> str:
             p.kill()
             p.wait()
         bl.write(f"[watcher] step timed out after {timeout_s:.0f}s\n")
-        return "timeout"
-    return "ok" if p.returncode == 0 else f"rc={p.returncode}"
+        return "timeout" + _watchdog_dump_marker(bl, start_offset)
+    if p.returncode == 0:
+        return "ok"
+    return f"rc={p.returncode}" + _watchdog_dump_marker(bl, start_offset)
 
 
 def run_payload(n_devices: int = 1) -> None:
