@@ -94,8 +94,15 @@ class PolicyValueAgent(BaseAgent):
         return sub
 
     def act(self, obs, last_action, reward, done, core_state):
-        """Central batched inference for a [B, ...] slab of actor lanes."""
-        return self._act(
+        """Central batched inference for a [B, ...] slab of actor lanes.
+
+        Thread-safety note: under ``enable_mesh`` this is a multi-device
+        dispatch — the trainers enter their mesh dispatch guard around the
+        call site (``fill_rollout_slot(dispatch_guard=...)``), which is why
+        the dispatches below carry graftlint JG002 suppressions: the lock
+        is owned one level up, shared with the learner's dispatch sites.
+        """
+        return self._act(  # graftlint: disable=JG002 (guarded at call site)
             self.state.params,
             jnp.asarray(obs),
             jnp.asarray(last_action, jnp.int32),
@@ -118,7 +125,7 @@ class PolicyValueAgent(BaseAgent):
         """Greedy actions, same persistent-core contract as get_action."""
         B = np.asarray(obs).shape[0]
         core, prev_a, prev_r, done_in = self._eval_state.step_inputs("greedy", B, done)
-        a, new_core = self._act_greedy(
+        a, new_core = self._act_greedy(  # graftlint: disable=JG002 (eval path; guarded by callers that run actor threads)
             self.state.params,
             jnp.asarray(obs),
             jnp.asarray(prev_a, jnp.int32),
@@ -155,7 +162,9 @@ class PolicyValueAgent(BaseAgent):
         """
         if self._shard_batch is not None:
             traj = self._shard_batch(traj)
-        self.state, metrics = self._learn(self.state, traj)
+        # the hot learner loops enter the trainer's mesh dispatch guard
+        # around this call (HostPlaneMixin._dispatch_guard)
+        self.state, metrics = self._learn(self.state, traj)  # graftlint: disable=JG002 (guarded at call site)
         return metrics
 
     def learn(self, traj) -> Dict[str, float]:

@@ -19,6 +19,7 @@ the on-policy special case); the *host* actor plane
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -28,6 +29,7 @@ import jax.numpy as jnp
 from scalerl_tpu.agents.impala import ImpalaTrainState
 from scalerl_tpu.data.trajectory import Trajectory
 from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
+from scalerl_tpu.runtime import dispatch
 from scalerl_tpu.runtime.dispatch import MetricsPipeline, get_metrics
 
 
@@ -346,13 +348,18 @@ class DeviceActorLearnerLoop:
         for i in range(max_calls):
             if should_stop is not None and should_stop():
                 break
-            key, sub = jax.random.split(key)
-            state, carry, m = self.train_chunk(state, carry, sub)
-            frames += frames_per_call
-            if progress is not None:
-                progress.bump()
-            # the sums ride the fused metrics — no extra host dispatches
-            consume(pipe.push(i, m))
+            # steady state (chunk 1+) runs under the transfer guard: the
+            # only host transfer allowed per chunk is get_metrics' explicit
+            # batched device_get; a stray implicit sync raises at its line.
+            # Chunk 0 is exempt — tracing/compilation may place constants.
+            with dispatch.steady_state_guard() if i > 0 else nullcontext():
+                key, sub = jax.random.split(key)
+                state, carry, m = self.train_chunk(state, carry, sub)
+                frames += frames_per_call
+                if progress is not None:
+                    progress.bump()
+                # the sums ride the fused metrics — no extra host dispatches
+                consume(pipe.push(i, m))
             if hit:
                 break
         consume(pipe.drain())
@@ -404,12 +411,15 @@ class DeviceActorLearnerLoop:
         for i in range(num_calls):
             if should_stop is not None and should_stop():
                 break
-            key, sub = jax.random.split(key)
-            state, carry, dev_metrics = self.train_chunk(state, carry, sub)
-            chunks_done += 1
-            if progress is not None:
-                progress.bump()
-            consume(pipe.push(i, dev_metrics))
+            # steady-state transfer guard (see run_until): implicit host
+            # syncs raise; get_metrics' one explicit batched get passes
+            with dispatch.steady_state_guard() if i > 0 else nullcontext():
+                key, sub = jax.random.split(key)
+                state, carry, dev_metrics = self.train_chunk(state, carry, sub)
+                chunks_done += 1
+                if progress is not None:
+                    progress.bump()
+                consume(pipe.push(i, dev_metrics))
         consume(pipe.drain())
         jax.block_until_ready(state.params)
         metrics["chunks_done"] = float(chunks_done)

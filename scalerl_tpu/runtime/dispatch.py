@@ -24,7 +24,9 @@ references to K of them while later chunks run is safe by construction.
 
 from __future__ import annotations
 
+import os
 from collections import deque
+from contextlib import contextmanager, nullcontext
 from typing import Any, Callable, Deque, List, Optional, Tuple
 
 import jax
@@ -33,6 +35,44 @@ import numpy as np
 
 # Module-level seam: tests monkeypatch this to count host transfers.
 _device_get = jax.device_get
+
+
+@contextmanager
+def _host_boundary_disallow():
+    # both directions of the HOST boundary; device->device stays allowed
+    # (resharding a scalar argument onto a mesh is legitimate and free of
+    # host involvement)
+    with jax.transfer_guard_host_to_device("disallow"), \
+            jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+def steady_state_guard():
+    """Transfer-guard context for the fused drivers' steady state.
+
+    Arms ``transfer_guard("disallow")`` on both directions of the *host
+    boundary* around a steady-state chunk (dispatch + pipelined metric
+    read): *implicit* transfers — a stray ``float()``/``np.asarray()`` on a
+    device value, a Python scalar or numpy array leaking into a jitted
+    call — raise immediately, while the one *explicit* batched
+    ``jax.device_get`` in :func:`get_metrics` is still allowed.
+    Device->device traffic (e.g. replicating a scalar argument onto a
+    mesh) never touches the host and stays allowed.  This is the runtime
+    enforcement of graftlint's JG001: the dispatch pipeline performs
+    exactly one (explicit) host transfer per chunk, and anything else is a
+    bug at the line that did it.
+
+    Backend note: the CPU backend's device buffers are host memory, so the
+    device->host direction never registers as a transfer there — on CPU the
+    guard catches stray host->device traffic only; on TPU/GPU it catches
+    both directions.  Escape hatch: ``SCALERL_NO_TRANSFER_GUARD=1``.
+
+    Drivers skip the guard for a branch's FIRST call: tracing/compilation
+    may legitimately materialize host constants onto the device.
+    """
+    if os.environ.get("SCALERL_NO_TRANSFER_GUARD") == "1":
+        return nullcontext()
+    return _host_boundary_disallow()
 
 
 def get_metrics(metrics: Any) -> Any:

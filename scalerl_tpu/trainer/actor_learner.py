@@ -26,6 +26,7 @@ from __future__ import annotations
 import queue as queue_mod
 import threading
 import time
+from contextlib import nullcontext
 from typing import Dict, Optional
 
 import jax
@@ -59,6 +60,7 @@ def fill_rollout_slot(
     unroll_length: int,
     on_step=None,
     timings: Optional[Timings] = None,
+    dispatch_guard=None,
 ):
     """Write one ``[T+1, B]`` trajectory slot — the protocol shared by the
     thread (SEED) and process (monobeast) actor planes.
@@ -72,7 +74,12 @@ def fill_rollout_slot(
     Returns the carried ``(obs, last_action, reward, done, core_state)``.
     ``on_step(reward, done)`` fires after every env step (episode metrics);
     ``timings`` (optional) records the ``model``/``step`` phase split.
+    ``dispatch_guard`` (optional, a context-manager factory): entered around
+    each central-inference call — the thread planes pass the trainer's mesh
+    dispatch guard so actor-thread dispatch cannot interleave multi-device
+    program enqueues with the learner's (graftlint JG002).
     """
+    _dispatch_guard = dispatch_guard if dispatch_guard is not None else nullcontext
     for i, (c, h) in enumerate(core_state):
         slot[f"core_{i}_c"][:] = np.asarray(c)
         slot[f"core_{i}_h"][:] = np.asarray(h)
@@ -88,9 +95,10 @@ def fill_rollout_slot(
         if t == unroll_length:
             slot["logits"][t] = 0.0
             break
-        action, logits, core_state = agent.act(
-            obs, last_action, reward, done, core_state
-        )
+        with _dispatch_guard():
+            action, logits, core_state = agent.act(
+                obs, last_action, reward, done, core_state
+            )
         slot["logits"][t] = np.asarray(logits)
         if timings is not None:
             timings.time("model")
@@ -180,6 +188,7 @@ class _ActorThread(threading.Thread):
                     T,
                     on_step=metrics.step,
                     timings=self.timings,
+                    dispatch_guard=getattr(tr, "_dispatch_guard", None),
                 )
                 q.commit(idx)
                 committed = True
@@ -203,8 +212,27 @@ class HostPlaneMixin:
 
     Expects the trainer to define: ``agent`` / ``env_frames`` /
     ``param_server`` / ``max_actor_restarts`` / ``actor_restarts`` /
-    ``_restart_lock`` plus BaseTrainer's resume plumbing.
+    ``_restart_lock`` / ``_mesh_lock`` plus BaseTrainer's resume plumbing.
     """
+
+    def _dispatch_guard(self):
+        """Serialize multi-device dispatch when the agent is meshed.
+
+        Same hazard ApexTrainer locks against (the PR 2
+        ``test_apex_sharded_replay_mesh_e2e`` deadlock): with
+        ``agent.enable_mesh`` active, actor threads' central inference and
+        the learner's update are all multi-device programs; two threads
+        enqueueing them concurrently can order the per-device queues
+        differently and wedge the whole XLA client.  One lock around every
+        dispatch site serializes enqueue order; single-device runs keep the
+        lock-free fast path (the mesh check is a cheap attribute read).
+        """
+        if (
+            getattr(self.agent, "mesh", None) is not None
+            or getattr(self.agent, "_learn_mesh", None) is not None
+        ):
+            return self._mesh_lock
+        return nullcontext()
 
     def grant_actor_restart(self, actor_id: int, exc: BaseException) -> bool:
         """Consume one unit of the elastic-actor budget; False = fail fast."""
@@ -292,6 +320,9 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         self.max_actor_restarts = max_actor_restarts
         self.actor_restarts = 0
         self._restart_lock = threading.Lock()
+        # serializes multi-device dispatch under agent.enable_mesh — see
+        # HostPlaneMixin._dispatch_guard
+        self._mesh_lock = threading.Lock()
         self.param_server = ParameterServer()
 
         probe_env = env_fns[0]()
@@ -438,15 +469,21 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                     break
                 traj = next_traj()
                 # device metrics stay un-materialized: float() only at log
-                # time, so the loop dispatches the next step without a sync
-                metrics = self.agent.learn_device(traj)
+                # time, so the loop dispatches the next step without a sync.
+                # Guarded: actor threads dispatch central inference
+                # concurrently, and under enable_mesh both sides are
+                # multi-device programs (HostPlaneMixin._dispatch_guard)
+                with self._dispatch_guard():
+                    metrics = self.agent.learn_device(traj)
                 self.learn_timings.time("learn")
                 if learn_progress is not None:
                     learn_progress.bump()
                 # version bump only — actors do central inference on the
                 # live device params; a to_host push would force a full
-                # device->host param fetch (a sync) every learn step
-                self.param_server.push(self.agent.get_weights(), to_host=False)
+                # device->host param fetch (a sync) every learn step.  The
+                # device-side snapshot copy is itself a program: guard it
+                with self._dispatch_guard():
+                    self.param_server.push(self.agent.get_weights(), to_host=False)
 
                 if (
                     args.save_model

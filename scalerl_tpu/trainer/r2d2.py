@@ -113,13 +113,27 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         else:
             self._sharded_replay = None
             self.replay = seq_init(field_shapes, core_shapes, args.replay_capacity)
-        self._max_priority = 1.0
+        # running max priority lives ON DEVICE: a host-side
+        # float(jnp.max(...)) mirror would block the learner on every learn
+        # step (graftlint JG001); it is materialized with one explicit
+        # device_get only at checkpoint time
+        self._max_prio_dev = jnp.asarray(1.0, jnp.float32)
         self._rng = jax.random.PRNGKey(args.seed + 13)
+        # serializes multi-device dispatch when the agent is meshed — see
+        # HostPlaneMixin._dispatch_guard (the apex mesh e2e deadlock class)
+        self._mesh_lock = threading.Lock()
         # PER search method pinned at construction (not at first trace),
         # so SCALERL_PER_METHOD / backend changes can't be silently ignored
         from scalerl_tpu.ops.pallas_per import resolve_sample_method
 
         self._seq_method = resolve_sample_method("auto")
+
+    @property
+    def _max_priority(self) -> float:
+        """Host view of the device-resident running max priority — ONE
+        explicit transfer; diagnostic/checkpoint accessor, never the hot
+        path (the learn loop reduces on device via ``_max_prio_dev``)."""
+        return float(jax.device_get(self._max_prio_dev))
 
     # grant_actor_restart comes from HostPlaneMixin (shared with the IMPALA
     # thread plane); resume extends the mixin's (agent, env_frames) pytree
@@ -135,7 +149,10 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             if self._sharded_replay is not None
             else self.replay
         )
-        tree["max_priority"] = np.asarray(self._max_priority, np.float64)
+        # one explicit transfer at checkpoint time (cold path)
+        tree["max_priority"] = np.asarray(
+            jax.device_get(self._max_prio_dev), np.float64
+        )
         return tree
 
     def try_resume(self) -> bool:
@@ -151,7 +168,9 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
             )
         else:
             self.replay = state["replay"]
-        self._max_priority = float(state["max_priority"])
+        self._max_prio_dev = jnp.asarray(
+            float(state["max_priority"]), jnp.float32
+        )
         self.param_server.push(self.agent.get_weights())
         if self.is_main_process:
             self.text_logger.info(
@@ -176,29 +195,33 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
         )
         self.queue.recycle(idxs)
         B = fields["action"].shape[0]
-        prio = np.full(B, self._max_priority, np.float32)
-        if self._sharded_replay is not None:
-            self._sharded_replay.add(fields, core, prio)
-        else:
-            self.replay = seq_add(self.replay, fields, core, jnp.asarray(prio))
+        # broadcast of the device-side running max: no host read here
+        prio = jnp.full((B,), self._max_prio_dev, jnp.float32)
+        with self._dispatch_guard():  # actors dispatch _act concurrently
+            if self._sharded_replay is not None:
+                self._sharded_replay.add(fields, core, prio)
+            else:
+                self.replay = seq_add(self.replay, fields, core, prio)
 
     def _learn_once(self) -> Dict[str, jnp.ndarray]:
         self._rng, sub = jax.random.split(self._rng)
-        if self._sharded_replay is not None:
-            fields, core, idx, weights = self._sharded_replay.sample(
-                self.args.batch_size, key=sub
-            )
-            metrics, prio = self.agent.learn_sequences(fields, core, weights)
-            self._sharded_replay.update_priorities(idx, prio)
-        else:
-            fields, core, idx, weights = seq_sample(
-                self.replay, sub, self.args.batch_size,
-                alpha=self.args.per_alpha, beta=self.args.per_beta,
-                method=self._seq_method,
-            )
-            metrics, prio = self.agent.learn_sequences(fields, core, weights)
-            self.replay = seq_update_priorities(self.replay, idx, prio)
-        self._max_priority = max(self._max_priority, float(jnp.max(prio)))
+        with self._dispatch_guard():  # actors dispatch _act concurrently
+            if self._sharded_replay is not None:
+                fields, core, idx, weights = self._sharded_replay.sample(
+                    self.args.batch_size, key=sub
+                )
+                metrics, prio = self.agent.learn_sequences(fields, core, weights)
+                self._sharded_replay.update_priorities(idx, prio)
+            else:
+                fields, core, idx, weights = seq_sample(
+                    self.replay, sub, self.args.batch_size,
+                    alpha=self.args.per_alpha, beta=self.args.per_beta,
+                    method=self._seq_method,
+                )
+                metrics, prio = self.agent.learn_sequences(fields, core, weights)
+                self.replay = seq_update_priorities(self.replay, idx, prio)
+            # async device-side reduction — no per-learn-step host sync
+            self._max_prio_dev = jnp.maximum(self._max_prio_dev, jnp.max(prio))
         return metrics
 
     # ------------------------------------------------------------------
@@ -233,8 +256,13 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
                     for _ in range(args.train_intensity):
                         metrics = self._learn_once()
                     # version bump for off-host pullers; thread actors read
-                    # the live params directly (central inference)
-                    self.param_server.push(self.agent.get_weights(), to_host=False)
+                    # the live params directly (central inference).  The
+                    # device-side snapshot copy is itself a (multi-device
+                    # when meshed) program — keep it behind the guard too
+                    with self._dispatch_guard():
+                        self.param_server.push(
+                            self.agent.get_weights(), to_host=False
+                        )
                 if (
                     args.save_model
                     and not args.disable_checkpoint

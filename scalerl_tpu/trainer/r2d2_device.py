@@ -19,6 +19,7 @@ the regime the stored-state + burn-in machinery exists for.
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from typing import Dict, NamedTuple, Optional
 
 import jax
@@ -26,6 +27,7 @@ import jax.numpy as jnp
 
 from scalerl_tpu.agents.r2d2 import R2D2Agent
 from scalerl_tpu.config import R2D2Arguments
+from scalerl_tpu.runtime import dispatch
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.data.sequence_replay import (
     seq_add,
@@ -396,34 +398,53 @@ class DeviceR2D2Trainer(BaseTrainer):
         # return_windowed covers the LAST quarter of training, never the
         # lifetime mean (which drags the eps=1 random warmup along)
         final_mark = None
-        # in fused mode the running max priority lives ON DEVICE: it chains
-        # through consecutive fused calls without any host reduction
+        # the running max priority lives ON DEVICE for BOTH paths: it chains
+        # through consecutive iterations without any host reduction — a
+        # per-step float(jnp.max(...)) read would block the host on every
+        # learn step (graftlint JG001); one explicit device_get at the end
+        # of train() persists it back to the host mirror
         max_prio = jnp.asarray(self._max_priority, jnp.float32)
+        # per-branch first-call flags: compilation may place host constants
+        # on device, so only steady-state calls run under the transfer guard
+        steady = {"warm": False, "cold": False}
         while self.env_frames < total_frames:
             key, k_c, k_s = jax.random.split(key, 3)
+            # eps rides as a device scalar: uploading it here (outside the
+            # guard) keeps the guarded fused dispatch free of implicit
+            # host->device traffic
             eps = self._eps(self.env_frames)
+            eps_dev = jnp.asarray(eps, jnp.float32)
             # count THIS iteration's insert: learning must start on the
             # iteration that reaches warmup (the pre-fusion semantics)
             warm = inserted + B >= args.warmup_sequences
             if self.fused:
-                if warm:
-                    (
-                        self.agent.state, self.replay, carry, max_prio, metrics
-                    ) = self._fused_iter(
-                        self.agent.state, self.replay, carry, max_prio, eps, k_c
-                    )
-                else:
-                    self.replay, carry = self._collect_insert(
-                        self.agent.state.params, self.replay, carry,
-                        max_prio, eps, k_c,
-                    )
+                branch = "warm" if warm else "cold"
+                guard = (
+                    dispatch.steady_state_guard()
+                    if steady[branch]
+                    else nullcontext()
+                )
+                with guard:
+                    if warm:
+                        (
+                            self.agent.state, self.replay, carry, max_prio, metrics
+                        ) = self._fused_iter(
+                            self.agent.state, self.replay, carry, max_prio,
+                            eps_dev, k_c,
+                        )
+                    else:
+                        self.replay, carry = self._collect_insert(
+                            self.agent.state.params, self.replay, carry,
+                            max_prio, eps_dev, k_c,
+                        )
+                steady[branch] = True
                 self.env_frames += frames_per_chunk
                 inserted += B
             else:
                 carry, fields, entry_core = self._collect(
-                    self.agent.state.params, carry, eps, k_c
+                    self.agent.state.params, carry, eps_dev, k_c
                 )
-                prio = jnp.full((B,), self._max_priority, jnp.float32)
+                prio = jnp.full((B,), max_prio, jnp.float32)
                 self.replay = seq_add(self.replay, fields, entry_core, prio)
                 self.env_frames += frames_per_chunk
                 inserted += B
@@ -439,9 +460,8 @@ class DeviceR2D2Trainer(BaseTrainer):
                         self.replay = seq_update_priorities(
                             self.replay, idx, new_prio
                         )
-                        self._max_priority = max(
-                            self._max_priority, float(jnp.max(new_prio))
-                        )
+                        # async device-side reduction — no per-step host sync
+                        max_prio = jnp.maximum(max_prio, jnp.max(new_prio))
             if final_mark is None and self.env_frames >= 0.75 * total_frames:
                 # one batched transfer for the pair (not two blocking reads)
                 mark = get_metrics(
@@ -474,11 +494,9 @@ class DeviceR2D2Trainer(BaseTrainer):
                         f"frames {self.env_frames} | eps {eps:.2f} | "
                         f"return {windowed:.2f}"
                     )
-        if self.fused:
-            # persist the device-side running max across train() calls; in
-            # piecewise mode self._max_priority was maintained on the host
-            # (overwriting it here would reset it to the entry value)
-            self._max_priority = float(max_prio)
+        # persist the device-side running max across train() calls — ONE
+        # explicit end-of-run transfer (both paths now keep it on device)
+        self._max_priority = float(jax.device_get(max_prio))
         final = get_metrics(
             {**metrics, "_ret_sum": jnp.sum(carry.return_sum),
              "_ep_cnt": jnp.sum(carry.episode_count)}

@@ -30,6 +30,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Opt-in runtime sanitizer (docs/LINTING.md): SCALERL_SANITIZE=1 turns on
+# jax's tracer-leak checking (JG004's runtime twin — leaked tracers raise at
+# the leak site instead of exploding later) and NaN debugging (re-runs the
+# offending primitive un-jitted and points at it) for the whole fast suite.
+# Off by default: both disable async dispatch and slow the suite down.
+if os.environ.get("SCALERL_SANITIZE") == "1":
+    jax.config.update("jax_check_tracer_leaks", True)
+    jax.config.update("jax_debug_nans", True)
+
 assert jax.default_backend() == "cpu", (
     "tests must run on CPU; got " + jax.default_backend()
 )

@@ -210,6 +210,86 @@ def test_run_until_lagged_threshold_keeps_in_flight_chunks():
         assert sk["frames"] == float(expect * fpc)
 
 
+# ---------------------------------------------------------------------------
+# the steady-state transfer guard (runtime sanitizer half of graftlint JG001)
+
+
+def test_steady_state_guard_blocks_implicit_host_transfers():
+    """Inside the armed guard a host value leaking into device compute —
+    the exact bug class JG001 lints for, from the runtime side — raises at
+    the offending line instead of silently serializing the pipeline."""
+    dev = jnp.arange(3.0)
+    host = np.ones(3)
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with dispatch.steady_state_guard():
+            _ = dev + host  # implicit host->device transfer
+
+    # a python scalar fed to a jitted call is the same violation (the
+    # r2d2_device eps case: upload it OUTSIDE the guard as a device scalar)
+    f = jax.jit(lambda a, b: a * b)
+    f(dev, 0.5)  # compile outside the guard
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with dispatch.steady_state_guard():
+            f(dev, 0.25)
+
+
+def test_steady_state_guard_allows_the_one_explicit_transfer():
+    """get_metrics' batched jax.device_get is explicit — the sanctioned
+    single host transfer per chunk passes under the armed guard."""
+    m = {"loss": jnp.float32(0.5), "entropy": jnp.float32(0.1)}
+    with dispatch.steady_state_guard():
+        out = get_metrics(m)
+    assert out == {"loss": 0.5, "entropy": pytest.approx(0.1)}
+
+
+def test_steady_state_guard_escape_hatch(monkeypatch):
+    monkeypatch.setenv("SCALERL_NO_TRANSFER_GUARD", "1")
+    dev = jnp.arange(3.0)
+    with dispatch.steady_state_guard():
+        _ = dev + np.ones(3)  # guard disabled: implicit transfer tolerated
+
+
+def test_run_steady_state_is_transfer_guarded_with_one_transfer_per_chunk(
+    monkeypatch,
+):
+    """The acceptance invariant, both halves at once: the fused driver's
+    steady state (every chunk after the first) runs under the armed
+    transfer guard — so it performs NO implicit host transfers — and the
+    batched-get seam counts EXACTLY one explicit device->host transfer per
+    dispatched chunk."""
+    loop, agent = _make_loop()
+    num_calls = 4
+    entered = []
+    real_guard = dispatch.steady_state_guard
+
+    def counting_guard():
+        entered.append(True)
+        return real_guard()
+
+    monkeypatch.setattr(dispatch, "steady_state_guard", counting_guard)
+    calls = []
+    real_get = dispatch._device_get
+    monkeypatch.setattr(
+        dispatch, "_device_get", lambda t: (calls.append(t), real_get(t))[1]
+    )
+    _run_stream(loop, agent, num_calls, chunks_in_flight=2)
+    # chunk 0 is the compilation exemption; all later chunks are guarded
+    assert len(entered) == num_calls - 1
+    assert len(calls) == num_calls  # one explicit batched get per chunk
+
+    # run_until drives the same guarded path
+    entered.clear()
+    loop.run_until(
+        _fresh_state(agent),
+        loop.init_carry(jax.random.PRNGKey(1)),
+        jax.random.PRNGKey(2),
+        threshold=float("inf"),
+        max_calls=3,
+        chunks_in_flight=2,
+    )
+    assert len(entered) == 2
+
+
 def test_pipelined_drive_helper():
     payloads = [{"v": jnp.float32(i)} for i in range(6)]
     seen = []

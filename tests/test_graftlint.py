@@ -1,0 +1,454 @@
+"""graftlint rule fixtures: every known-bad snippet must flag with the
+right rule id, and its known-good twin must pass clean.
+
+The linter is jax-free stdlib ast (tools/graftlint), so these tests run in
+milliseconds and carry the rule semantics as executable documentation:
+each fixture is the minimal reproduction of the bug class the rule exists
+to stop (see docs/LINTING.md for the incident history).
+"""
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftlint.engine import (  # noqa: E402
+    collect_suppressions,
+    lint_source,
+    load_baseline,
+    partition_new,
+    write_baseline,
+)
+
+HOT = "scalerl_tpu/trainer/fixture.py"  # JG001 applies to hot packages only
+COLD = "scalerl_tpu/models/fixture.py"
+
+
+def lint(src: str, relpath: str = HOT):
+    return lint_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# JG001 — blocking transfer in hot-path loops
+
+
+BAD_JG001_FLOAT_LOOP = """
+    import jax.numpy as jnp
+
+    def train(replay, agent):
+        for _ in range(10):
+            prio = agent.learn(replay)
+            best = float(jnp.max(prio))  # per-step host sync
+        return best
+"""
+
+GOOD_JG001_DEVICE_REDUCTION = """
+    import jax
+    import jax.numpy as jnp
+
+    def train(replay, agent):
+        best = jnp.float32(0.0)
+        for _ in range(10):
+            prio = agent.learn(replay)
+            best = jnp.maximum(best, jnp.max(prio))  # stays on device
+        return float(jax.device_get(best))  # ONE explicit end-of-run read
+"""
+
+
+def test_jg001_flags_float_on_jax_value_in_loop():
+    findings = lint(BAD_JG001_FLOAT_LOOP)
+    assert rules_of(findings) == ["JG001"]
+    assert "float()" in findings[0].message
+    assert "loop" in findings[0].message
+
+
+def test_jg001_good_twin_device_reduction_passes():
+    assert lint(GOOD_JG001_DEVICE_REDUCTION) == []
+
+
+def test_jg001_taint_through_local_names():
+    src = """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.sum(x)
+            return float(y)  # y is device-valued via the local assignment
+    """
+    assert rules_of(lint(src)) == ["JG001"]
+
+
+def test_jg001_item_and_device_get_in_loop():
+    src = """
+        import jax
+
+        def f(metrics):
+            out = {}
+            for k, v in metrics.items():
+                out[k] = jax.device_get(v)  # per-key transfer
+                _ = v.item()
+            return out
+    """
+    assert sorted(rules_of(lint(src))) == ["JG001", "JG001"]
+
+
+def test_jg001_host_numpy_not_flagged():
+    src = """
+        import numpy as np
+
+        def f(rets):
+            for _ in range(3):
+                m = float(np.mean(rets))  # host numpy: no device involved
+            return m
+    """
+    assert lint(src) == []
+
+
+def test_jg001_only_hot_packages():
+    assert lint(BAD_JG001_FLOAT_LOOP, relpath=COLD) == []
+
+
+# ---------------------------------------------------------------------------
+# JG002 — unguarded mesh dispatch from threaded modules
+
+
+BAD_JG002 = """
+    import threading
+
+    class Trainer:
+        def __init__(self, agent, mesh):
+            self.agent = agent
+            self.mesh = mesh
+            self._mesh_lock = threading.Lock()
+
+        def _actor(self):
+            while True:
+                self.agent._act(self.agent.state.params)  # unguarded
+
+        def learner(self):
+            return self.agent.learn(self.sample())
+"""
+
+GOOD_JG002 = """
+    import threading
+
+    class Trainer:
+        def __init__(self, agent, mesh):
+            self.agent = agent
+            self.mesh = mesh
+            self._mesh_lock = threading.Lock()
+
+        def _dispatch_guard(self):
+            return self._mesh_lock
+
+        def _actor(self):
+            while True:
+                with self._dispatch_guard():
+                    self.agent._act(self.agent.state.params)
+
+        def learner(self):
+            with self._dispatch_guard():
+                return self.agent.learn(self.buffer.sample(32))
+"""
+
+
+def test_jg002_flags_unguarded_dispatch():
+    findings = lint(BAD_JG002)
+    # actor _act + learner learn (the sample() call has no dispatch
+    # receiver, so only the two agent dispatches flag)
+    assert rules_of(findings) == ["JG002", "JG002"]
+    assert "_dispatch_guard" in findings[0].hint
+
+
+def test_jg002_guarded_twin_passes():
+    assert lint(GOOD_JG002) == []
+
+
+def test_jg002_needs_threads_and_mesh():
+    # same dispatches, no threading: single-threaded drivers are exempt
+    src = BAD_JG002.replace("import threading", "import queue").replace(
+        "threading.Lock()", "None"
+    )
+    assert lint(src) == []
+
+
+def test_jg002_jit_assigned_names_count_as_dispatch():
+    src = """
+        import threading
+        import jax
+
+        class T:
+            def __init__(self, mesh):
+                self._priority = jax.jit(lambda x: x)
+
+            def worker(self):
+                return self._priority(1)  # jit-wrapped attr, unguarded
+    """
+    assert rules_of(lint(src)) == ["JG002"]
+
+
+# ---------------------------------------------------------------------------
+# JG003 — retrace hazards
+
+
+BAD_JG003_STATIC = """
+    import jax
+
+    def f(x, n):
+        return x * n
+
+    jf = jax.jit(f, static_argnums=(1,))
+
+    def train(x):
+        for i in range(100):
+            x = jf(x, i)  # new static value every iteration: retrace x100
+        return x
+"""
+
+GOOD_JG003_STATIC = """
+    import jax
+
+    def f(x, n):
+        return x * n
+
+    jf = jax.jit(f, static_argnums=(1,))
+
+    def train(x, args):
+        for _ in range(100):
+            x = jf(x, args.batch_size)  # trace-stable config value
+        return x
+"""
+
+
+def test_jg003_flags_varying_static_arg_in_loop():
+    findings = lint(BAD_JG003_STATIC)
+    assert rules_of(findings) == ["JG003"]
+    assert "retrace" in findings[0].message
+
+
+def test_jg003_stable_static_arg_passes():
+    assert lint(GOOD_JG003_STATIC) == []
+
+
+def test_jg003_flags_host_state_in_jitted_body():
+    src = """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + time.time()  # baked in at trace time
+    """
+    findings = lint(src)
+    assert rules_of(findings) == ["JG003"]
+    assert "trace time" in findings[0].message
+
+
+def test_jg003_static_argnames_kwarg():
+    src = """
+        import jax
+
+        def f(x, method="auto"):
+            return x
+
+        jf = jax.jit(f, static_argnames=("method",))
+
+        def train(x, modes):
+            for m in modes:
+                x = jf(x, method=m)  # varying static kwarg
+            return x
+    """
+    assert rules_of(lint(src)) == ["JG003"]
+
+
+# ---------------------------------------------------------------------------
+# JG004 — tracer leaks
+
+
+BAD_JG004 = """
+    import jax
+
+    class Agent:
+        def _learn_impl(self, state, batch):
+            loss = batch.sum()
+            self.last_loss = loss  # tracer assigned to self inside jit
+            return state, loss
+
+        def __init__(self):
+            self._learn = jax.jit(self._learn_impl)
+"""
+
+GOOD_JG004 = """
+    import jax
+
+    class Agent:
+        def _learn_impl(self, state, batch):
+            loss = batch.sum()
+            return state, loss  # loss returned, assigned host-side
+
+        def __init__(self):
+            self._learn = jax.jit(self._learn_impl)
+
+        def learn(self, state, batch):
+            state, loss = self._learn(state, batch)
+            self.last_loss = loss  # host side: fine
+            return state
+"""
+
+
+def test_jg004_flags_self_assignment_in_jitted_code():
+    findings = lint(BAD_JG004)
+    assert rules_of(findings) == ["JG004"]
+    assert "self.last_loss" in findings[0].message
+
+
+def test_jg004_host_side_assignment_passes():
+    assert lint(GOOD_JG004) == []
+
+
+def test_jg004_decorated_jit_and_global():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            global LAST
+            LAST = x
+            return x
+    """
+    assert rules_of(lint(src)) == ["JG004"]
+
+
+# ---------------------------------------------------------------------------
+# JG005 — use after donation
+
+
+BAD_JG005 = """
+    import jax
+
+    def f(state, batch):
+        return state
+
+    step = jax.jit(f, donate_argnums=(0,))
+
+    def train(state, batch):
+        new_state = step(state, batch)
+        check = state.sum()  # state's buffer was donated: deleted array
+        return new_state, check
+"""
+
+GOOD_JG005 = """
+    import jax
+
+    def f(state, batch):
+        return state
+
+    step = jax.jit(f, donate_argnums=(0,))
+
+    def train(state, batch):
+        state = step(state, batch)  # rebinds over the donated name
+        check = state.sum()
+        return state, check
+"""
+
+
+def test_jg005_flags_use_after_donation():
+    findings = lint(BAD_JG005)
+    assert rules_of(findings) == ["JG005"]
+    assert "donated" in findings[0].message
+
+
+def test_jg005_rebind_over_donated_name_passes():
+    assert lint(GOOD_JG005) == []
+
+
+def test_jg005_known_data_plane_donators():
+    src = """
+        def insert(replay, fields, core, prio):
+            updated = seq_add(replay, fields, core, prio)
+            size = replay.size  # replay donated by seq_add
+            return updated, size
+    """
+    findings = lint(src)
+    assert rules_of(findings) == ["JG005"]
+
+    good = """
+        def insert(replay, fields, core, prio):
+            replay = seq_add(replay, fields, core, prio)
+            return replay, replay.size
+    """
+    assert lint(good) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline machinery
+
+
+def test_inline_suppression_and_file_suppression():
+    suppressed = BAD_JG001_FLOAT_LOOP.replace(
+        "# per-step host sync", "# graftlint: disable=JG001"
+    )
+    assert lint(suppressed) == []
+
+    next_line = """
+        import jax.numpy as jnp
+
+        def f(x):
+            y = jnp.sum(x)
+            # graftlint: disable-next-line=JG001
+            return float(y)
+    """
+    assert lint(next_line) == []
+
+    file_wide = "# graftlint: disable-file=JG001\n" + textwrap.dedent(
+        BAD_JG001_FLOAT_LOOP
+    )
+    assert lint_source(file_wide, HOT) == []
+
+
+def test_suppression_parsing():
+    by_line, file_wide = collect_suppressions(
+        [
+            "x = 1  # graftlint: disable=JG001,JG005",
+            "# graftlint: disable-next-line=JG002",
+            "y = 2",
+            "# graftlint: disable-file=JG004",
+        ]
+    )
+    assert by_line[1] == {"JG001", "JG005"}
+    assert by_line[3] == {"JG002"}
+    assert file_wide == {"JG004"}
+
+
+def test_baseline_absorbs_exact_findings_but_not_new_ones(tmp_path):
+    findings = lint(BAD_JG001_FLOAT_LOOP)
+    assert len(findings) == 1
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), findings)
+    baseline = load_baseline(str(path))
+    assert json.loads(path.read_text())["version"] == 1
+
+    old, new = partition_new(findings, baseline)
+    assert len(old) == 1 and new == []
+
+    # a second, different finding is NOT absorbed
+    two = findings + lint(
+        BAD_JG001_FLOAT_LOOP.replace("jnp.max", "jnp.min")
+    )
+    old, new = partition_new(two, baseline)
+    assert len(old) == 1 and len(new) == 1
+
+
+def test_baseline_key_survives_line_drift():
+    shifted = "\n\n\n" + textwrap.dedent(BAD_JG001_FLOAT_LOOP)
+    a = lint(BAD_JG001_FLOAT_LOOP)[0]
+    b = lint_source(shifted, HOT)[0]
+    assert a.line != b.line
+    assert a.key == b.key  # file::rule::snippet, not line numbers

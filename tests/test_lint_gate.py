@@ -1,0 +1,53 @@
+"""The standing correctness gate: graftlint over ``scalerl_tpu/`` must be
+clean (every finding fixed, inline-suppressed, or baselined).
+
+This is the tier-1 twin of ``python -m tools.graftlint scalerl_tpu`` — it
+runs the same engine in-process so a hot-path host sync (JG001), an
+unguarded mesh dispatch (JG002), a retrace hazard (JG003), a tracer leak
+(JG004), or a use-after-donation (JG005) introduced by any later PR fails
+the fast suite with the offending ``file:line`` in the assertion message.
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:  # `python -m pytest` from elsewhere
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftlint import DEFAULT_BASELINE, gate  # noqa: E402
+
+
+def test_graftlint_gate_scalerl_tpu_is_clean():
+    findings, new = gate(
+        [str(REPO_ROOT / "scalerl_tpu")], repo_root=str(REPO_ROOT)
+    )
+    assert not new, (
+        "graftlint found new (non-baselined) findings — fix them, or "
+        "suppress deliberate ones inline (# graftlint: disable=JGnnn), or "
+        "re-baseline consciously (python -m tools.graftlint scalerl_tpu "
+        "--write-baseline):\n" + "\n".join(f.render() for f in new)
+    )
+
+
+def test_graftlint_gate_also_covers_tools_and_runtime_cli():
+    # the linter must at least parse everything it gates (a syntax error
+    # surfaces as a JG000 parse finding rather than a crash)
+    findings, new = gate(
+        [str(REPO_ROOT / "scalerl_tpu"), str(REPO_ROOT / "tools")],
+        repo_root=str(REPO_ROOT),
+    )
+    assert not [f for f in findings if f.rule == "JG000"], [
+        f.render() for f in findings if f.rule == "JG000"
+    ]
+    assert not new, "\n".join(f.render() for f in new)
+
+
+def test_baseline_file_is_checked_in_and_valid():
+    import json
+
+    path = Path(DEFAULT_BASELINE)
+    assert path.exists(), "tools/graftlint/baseline.json must be committed"
+    data = json.loads(path.read_text())
+    assert data["version"] == 1
+    assert isinstance(data["entries"], dict)

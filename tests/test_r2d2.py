@@ -292,6 +292,55 @@ def test_r2d2_trainer_resume_roundtrip(tmp_path):
     tr_b.close()
 
 
+def test_r2d2_host_plane_meshed_dispatch_guard_e2e(tmp_path):
+    """Host actor plane + DDP-meshed agent end to end: actor threads'
+    central inference and the learner's meshed update/replay ops are all
+    multi-device programs dispatching concurrently — the exact XLA
+    enqueue-order deadlock class the apex mesh e2e hit (graftlint JG002).
+    ``HostPlaneMixin._dispatch_guard`` must be the mesh lock here (and a
+    no-op context for unmeshed agents), and a short training run must
+    complete rather than wedge; ``watchdog_timeout_s`` is the regression
+    net that turns a reintroduced deadlock into a diagnosed failure."""
+    from contextlib import nullcontext
+
+    from scalerl_tpu.trainer.r2d2 import R2D2Trainer
+
+    args = _args(
+        work_dir=str(tmp_path), rollout_length=8, burn_in=2, n_steps=1,
+        num_actors=2, warmup_sequences=4, batch_size=8, replay_capacity=64,
+        hidden_size=16, watchdog_timeout_s=120,
+    )
+    agent = R2D2Agent(args, obs_shape=(4,), num_actions=2)
+    agent.enable_mesh("dp=4,fsdp=2")
+    env_fns = [
+        (lambda s=s: make_vect_envs(
+            "CartPole-v1", num_envs=4, seed=s, async_envs=False
+        ))
+        for s in range(2)
+    ]
+    tr = R2D2Trainer(args, agent, env_fns)
+    assert tr._dispatch_guard() is tr._mesh_lock  # meshed: lock armed
+    try:
+        tr.train(total_frames=256)
+        assert tr.env_frames >= 256
+        assert int(agent.state.step) > 0  # the meshed learner really ran
+    finally:
+        tr.close()
+
+    # unmeshed twin keeps the lock-free fast path
+    plain_args = _args(work_dir=str(tmp_path))
+    plain = R2D2Trainer(
+        plain_args,
+        R2D2Agent(plain_args, obs_shape=(4,), num_actions=2),
+        [lambda: make_vect_envs("CartPole-v1", num_envs=4, seed=9,
+                                async_envs=False)],
+    )
+    try:
+        assert isinstance(plain._dispatch_guard(), nullcontext)
+    finally:
+        plain.close()
+
+
 @pytest.mark.parametrize("fused", [True, False])
 def test_device_r2d2_trainer_smoke(tmp_path, fused):
     """The device-native loop runs end to end and counts frames/learn

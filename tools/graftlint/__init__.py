@@ -1,0 +1,56 @@
+"""graftlint: jax-free static analyzer for this repo's dispatch/transfer
+discipline (rules JG001-JG005) plus the baseline/suppression gate.
+
+Run: ``python -m tools.graftlint scalerl_tpu``
+Programmatic: :func:`gate` returns (all_findings, new_findings) — the
+in-process entry the tier-1 ``tests/test_lint_gate.py`` uses.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.graftlint.engine import (
+    Finding,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    partition_new,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def gate(
+    paths: Sequence[str],
+    baseline_path: Optional[str] = None,
+    repo_root: Optional[str] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Lint ``paths`` and split findings against the baseline.
+
+    Returns ``(all_findings, new_findings)``; a clean gate is
+    ``new_findings == []``.  ``baseline_path=None`` uses the checked-in
+    default; pass ``""`` to gate with no baseline at all.
+    """
+    if baseline_path is None:
+        baseline_path = DEFAULT_BASELINE
+    findings = lint_paths(paths, repo_root=repo_root)
+    baseline: Dict[str, int] = {}
+    if baseline_path and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+    _old, new = partition_new(findings, baseline)
+    return findings, new
+
+
+__all__ = [
+    "Finding",
+    "DEFAULT_BASELINE",
+    "gate",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "partition_new",
+    "write_baseline",
+]

@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.graftlint scalerl_tpu [paths...]``.
+
+Exit 0 when every finding is baselined/suppressed; exit 1 when new
+findings exist (the CI gate); exit 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.graftlint import DEFAULT_BASELINE, gate, write_baseline
+from tools.graftlint.engine import lint_paths, load_baseline, partition_new
+from tools.graftlint.rules import RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX dispatch/transfer static analyzer (rules JG001-JG005)",
+    )
+    parser.add_argument("paths", nargs="*", default=["scalerl_tpu"],
+                        help="files/packages to lint (default: scalerl_tpu)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON (default: tools/graftlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept all current findings into the baseline")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also print findings the baseline absorbs")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, title, fn in RULES:
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{rule_id}  {title}" + (f" — {doc[0]}" if doc else ""))
+        return 0
+
+    paths = args.paths or ["scalerl_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths)
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"graftlint: wrote {len(findings)} finding(s) to {args.baseline}"
+        )
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = load_baseline(args.baseline)
+    old, new = partition_new(findings, baseline)
+
+    shown = findings if args.no_baseline else new
+    if args.show_baselined and not args.no_baseline:
+        for f in old:
+            print(f"[baselined] {f.render()}")
+    for f in shown:
+        print(f.render())
+
+    n_files = len({f.file for f in findings})
+    print(
+        f"graftlint: {len(findings)} finding(s) across {n_files} file(s): "
+        f"{len(old)} baselined, {len(new)} new"
+    )
+    if args.no_baseline:
+        return 1 if findings else 0
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

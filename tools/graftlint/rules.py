@@ -1,0 +1,581 @@
+"""graftlint rules JG001-JG005: the dispatch/transfer discipline this repo
+learned the hard way.
+
+Each rule encodes one class of silent performance/correctness bug that a
+previous PR root-caused at runtime (faulthandler dumps, fps regressions) and
+that nothing previously prevented from being reintroduced:
+
+- **JG001 blocking-transfer-in-loop** — per-key host syncs (``float()``,
+  ``.item()``, ``np.asarray()``, per-iteration ``jax.device_get``) on jax
+  values in the hot packages serialize the host against the device and
+  defeat async dispatch (the PR 1 class).  Metric reads must go through
+  ``runtime.dispatch.get_metrics`` / one batched ``device_get`` per chunk.
+- **JG002 unguarded-mesh-dispatch** — multi-device (pjit/meshed) programs
+  dispatched concurrently from actor threads and the learner enqueue in
+  different per-device orders and deadlock the XLA client (the PR 2
+  ``test_apex_sharded_replay_mesh_e2e`` hang).  Every dispatch site in a
+  threaded + meshed module must sit behind the mesh dispatch lock.
+- **JG003 retrace-hazard** — a ``static_argnums`` slot fed a value that
+  varies per loop iteration recompiles every call; a jitted function that
+  reads host state (``time.time``, ``np.random``, ``os.environ``) bakes it
+  in at trace time.
+- **JG004 tracer-leak** — assigning to ``self.*``/globals inside jitted
+  code leaks tracers (or silently freezes a side effect at trace time).
+- **JG005 donation-misuse** — reusing an argument after it was donated
+  (``donate_argnums``) reads a deleted buffer.
+
+Rules are deliberately heuristic: high-precision syntactic + local-taint
+checks, with inline suppressions and the checked-in baseline absorbing the
+deliberate exceptions (see ``docs/LINTING.md``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding,
+    ModuleContext,
+    assign_target_paths,
+    attr_path,
+    root_name,
+)
+
+# packages whose loops are device hot paths (relative path segments)
+HOT_DIRS = {"runtime", "trainer", "agents"}
+
+# jax module aliases whose call results live on device
+JAX_ROOTS = {"jax", "jnp"}
+
+# method names that dispatch jitted/meshed device programs in this codebase
+DISPATCH_METHODS = {
+    "learn",
+    "learn_device",
+    "learn_sequences",
+    "act",
+    "predict",
+    "get_action",
+    "_act",
+    "_act_greedy",
+    "_priority",
+    "sample",
+    "add",
+    "add_with_priorities",
+    "update_priorities",
+}
+
+# receivers those methods count on (dotted-path segments)
+DISPATCH_RECEIVERS = {"agent", "policy", "buffer", "replay", "sampler", "_sharded_replay"}
+
+# module-level jitted data-plane entry points (defined with @partial(jax.jit)
+# in scalerl_tpu.data.*) and their donated positions
+KNOWN_JITTED_FNS: Dict[str, Tuple[int, ...]] = {
+    "seq_add": (0,),
+    "seq_sample": (),
+    "seq_update_priorities": (0,),
+    "seq_update_priorities_keep_empty": (0,),
+    "per_add_with_priorities": (0,),
+}
+
+# host-state calls that must not be captured inside jitted code
+IMPURE_CALLS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "os.environ.get",
+    "os.getenv",
+}
+IMPURE_ROOT_PREFIXES = ("np.random.", "numpy.random.", "random.")
+
+
+def _is_hot_path(relpath: str) -> bool:
+    return any(part in HOT_DIRS for part in relpath.split("/")[:-1])
+
+
+def _jit_wrapper_info(call: ast.Call) -> Optional[Dict]:
+    """If ``call`` is jax.jit/pjit/shard_map(...), return its metadata."""
+    path = attr_path(call.func)
+    if path is None:
+        return None
+    name = path.split(".")[-1]
+    if name not in {"jit", "pjit", "shard_map"}:
+        return None
+    info: Dict = {"kind": name, "static": set(), "static_names": set(), "donate": ()}
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames", "donate_argnums"):
+            vals: List = []
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant):
+                    vals.append(e.value)
+            if kw.arg == "static_argnums":
+                info["static"] = {v for v in vals if isinstance(v, int)}
+            elif kw.arg == "static_argnames":
+                info["static_names"] = {v for v in vals if isinstance(v, str)}
+            else:
+                info["donate"] = tuple(v for v in vals if isinstance(v, int))
+    return info
+
+
+class _JitIndex:
+    """Module-wide map of jit-wrapped callables.
+
+    ``wrapped``: assigned name / attribute name -> jit info (e.g.
+    ``self._priority = jax.jit(...)`` registers ``_priority``).
+    ``impl_funcs``: names of local functions handed to jax.jit/shard_map
+    (``jax.jit(self._fused_iter_impl)`` registers ``_fused_iter_impl``) —
+    their *bodies* are traced, so JG003/JG004 inspect them.
+    """
+
+    def __init__(self, ctx: ModuleContext) -> None:
+        self.wrapped: Dict[str, Dict] = {}
+        self.impl_funcs: Set[str] = set()
+        self.decorated: Dict[str, Dict] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = self._info_through_partial(node.value)
+                if info is None:
+                    continue
+                for path in assign_target_paths(node):
+                    self.wrapped[path.split(".")[-1]] = info
+                self._collect_impls(node.value)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info = None
+                    if isinstance(dec, ast.Call):
+                        dec_path = attr_path(dec.func) or ""
+                        if dec_path.split(".")[-1] == "partial" and dec.args:
+                            inner = attr_path(dec.args[0]) or ""
+                            if inner.split(".")[-1] in {"jit", "pjit"}:
+                                info = _jit_wrapper_info(
+                                    ast.Call(
+                                        func=dec.args[0],
+                                        args=[],
+                                        keywords=dec.keywords,
+                                    )
+                                )
+                        else:
+                            info = _jit_wrapper_info(dec)
+                    elif (attr_path(dec) or "").split(".")[-1] in {"jit", "pjit"}:
+                        info = {"kind": "jit", "static": set(), "static_names": set(), "donate": ()}
+                    if info is not None:
+                        self.decorated[node.name] = info
+                        self.impl_funcs.add(node.name)
+                        break
+
+    def _info_through_partial(self, call: ast.Call) -> Optional[Dict]:
+        return _jit_wrapper_info(call)
+
+    def _collect_impls(self, call: ast.Call) -> None:
+        """Record local function/method names traced by this wrapper —
+        including through nested partial()/shard_map() calls."""
+        stack: List[ast.AST] = list(call.args)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                stack.extend(node.args)
+            else:
+                path = attr_path(node)
+                if path is not None:
+                    self.impl_funcs.add(path.split(".")[-1])
+
+
+def _jitted_defs(ctx: ModuleContext, index: _JitIndex) -> List[ast.FunctionDef]:
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in index.impl_funcs or node.name in index.decorated:
+                out.append(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JG001 — blocking transfer in hot-path loops
+
+
+def _tainted_names(ctx: ModuleContext, func: Optional[ast.AST]) -> Set[str]:
+    """Names bound (within ``func``, or at module level) to values produced
+    by jnp./jax. expressions — a local, two-pass taint."""
+    body_owner = func if func is not None else ctx.tree
+    tainted: Set[str] = set()
+    assigns: List[Tuple[List[str], ast.AST]] = []
+    for node in ast.walk(body_owner):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if ctx.enclosing_function(node) is not (
+                func if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            ):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            names = [p for p in assign_target_paths(node) if "." not in p]
+            if names:
+                assigns.append((names, value))
+    for _ in range(2):  # two passes: one hop of name-to-name propagation
+        for names, value in assigns:
+            root = root_name(value)
+            if root in JAX_ROOTS or root in tainted:
+                tainted.update(names)
+            elif isinstance(value, ast.BinOp):
+                for side in (value.left, value.right):
+                    r = root_name(side)
+                    if r in JAX_ROOTS or r in tainted:
+                        tainted.update(names)
+    return tainted
+
+
+def _is_jax_valued(node: ast.AST, tainted: Set[str]) -> bool:
+    if isinstance(node, ast.Call):
+        # jax.device_get IS the sanctioned explicit transfer: its result is
+        # host memory, so float(jax.device_get(x)) at a cold path is the
+        # idiom the rule steers code toward, not a violation
+        if attr_path(node.func) == "jax.device_get":
+            return False
+        return root_name(node) in JAX_ROOTS
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, (ast.Subscript, ast.Attribute)):
+        return root_name(node) in JAX_ROOTS
+    return False
+
+
+def rule_jg001(ctx: ModuleContext) -> Iterator[Finding]:
+    if not _is_hot_path(ctx.relpath):
+        return
+    taint_cache: Dict[Optional[ast.AST], Set[str]] = {}
+
+    def tainted_for(node: ast.AST) -> Set[str]:
+        func = ctx.enclosing_function(node)
+        if func not in taint_cache:
+            taint_cache[func] = _tainted_names(ctx, func)
+        return taint_cache[func]
+
+    hint = (
+        "route metric/scalar reads through runtime.dispatch.get_metrics (one "
+        "batched device->host transfer per chunk) or hoist the read out of "
+        "the loop; keep running reductions on device"
+    )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        in_loop = ctx.enclosing_loop(node) is not None
+        where = " inside a loop body" if in_loop else ""
+        # float(X) / int(X) on a jax value
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int")
+            and len(node.args) == 1
+            and not node.keywords
+            and _is_jax_valued(node.args[0], tainted_for(node))
+        ):
+            yield ctx.finding(
+                node,
+                "JG001",
+                f"blocking host sync: {node.func.id}() on a jax value{where}",
+                hint,
+            )
+            continue
+        # np.asarray/np.array on a jax value
+        fpath = attr_path(node.func)
+        if (
+            fpath in ("np.asarray", "numpy.asarray", "np.array", "numpy.array")
+            and node.args
+            and _is_jax_valued(node.args[0], tainted_for(node))
+        ):
+            yield ctx.finding(
+                node,
+                "JG001",
+                f"blocking host sync: {fpath}() on a jax value{where}",
+                hint,
+            )
+            continue
+        # .item() — the canonical scalar sync
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+            and (in_loop or _is_jax_valued(node.func.value, tainted_for(node)))
+        ):
+            yield ctx.finding(
+                node, "JG001", f".item() host sync{where}", hint
+            )
+            continue
+        # per-iteration jax.device_get
+        if fpath == "jax.device_get" and in_loop:
+            yield ctx.finding(
+                node,
+                "JG001",
+                "jax.device_get inside a loop body (per-key/per-iteration "
+                "transfer)",
+                "batch the whole pytree into ONE device_get per chunk "
+                "(runtime.dispatch.get_metrics does this)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# JG002 — unguarded mesh dispatch in threaded modules
+
+
+def _guarded(ctx: ModuleContext, node: ast.AST) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                seg = ctx.segment(item.context_expr)
+                if "_dispatch_guard" in seg or "_mesh_lock" in seg:
+                    return True
+    return False
+
+
+def _dispatch_site(node: ast.Call, jit_names: Set[str]) -> Optional[str]:
+    """Return a short label if ``node`` dispatches a device program."""
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in KNOWN_JITTED_FNS or func.id in jit_names:
+            return func.id
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in jit_names:
+            return attr_path(func) or func.attr
+        if func.attr in DISPATCH_METHODS:
+            recv = attr_path(func.value)
+            if recv is not None and any(
+                seg in DISPATCH_RECEIVERS for seg in recv.split(".")
+            ):
+                return f"{recv}.{func.attr}"
+    return None
+
+
+def rule_jg002(ctx: ModuleContext) -> Iterator[Finding]:
+    # trigger: the module both runs threads and touches a mesh — the only
+    # combination where concurrent multi-device dispatch can interleave
+    if "threading" not in ctx.source or "mesh" not in ctx.source:
+        return
+    index = _JitIndex(ctx)
+    jit_names = set(index.wrapped)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        label = _dispatch_site(node, jit_names)
+        if label is None:
+            continue
+        func = ctx.enclosing_function(node)
+        if func is not None and func.name == "__init__":
+            continue  # construction happens before any thread starts
+        if _guarded(ctx, node):
+            continue
+        yield ctx.finding(
+            node,
+            "JG002",
+            f"meshed/jitted dispatch `{label}` outside the mesh dispatch "
+            "lock in a threaded module",
+            "wrap the call in `with self._dispatch_guard():` — concurrent "
+            "multi-device programs enqueued in different per-device orders "
+            "deadlock the XLA client (the apex mesh e2e hang)",
+        )
+
+
+# ---------------------------------------------------------------------------
+# JG003 — retrace hazards
+
+
+def _loop_bound_names(loop: ast.AST) -> Set[str]:
+    bound: Set[str] = set()
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        stack = [loop.target]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.Tuple, ast.List)):
+                stack.extend(cur.elts)
+            elif isinstance(cur, ast.Name):
+                bound.add(cur.id)
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            bound.update(p for p in assign_target_paths(node) if "." not in p)
+        elif isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
+            bound.add(node.target.id)
+    return bound
+
+
+def _references(expr: ast.AST, names: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in names:
+            return True
+    return False
+
+
+def rule_jg003(ctx: ModuleContext) -> Iterator[Finding]:
+    index = _JitIndex(ctx)
+    static_callables: Dict[str, Dict] = {
+        name: info
+        for name, info in {**index.wrapped, **index.decorated}.items()
+        if info["static"] or info["static_names"]
+    }
+    # (a) per-call-varying value fed to a static slot inside a loop
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        info = static_callables.get(name or "")
+        if info is None:
+            continue
+        loop = ctx.enclosing_loop(node)
+        if loop is None:
+            continue
+        varying = _loop_bound_names(loop)
+        static_args: List[Tuple[str, ast.AST]] = []
+        for pos in sorted(info["static"]):
+            if pos < len(node.args):
+                static_args.append((f"positional {pos}", node.args[pos]))
+        for kw in node.keywords:
+            if kw.arg in info["static_names"]:
+                static_args.append((f"`{kw.arg}=`", kw.value))
+        for slot, expr in static_args:
+            if _references(expr, varying) and not isinstance(expr, ast.Constant):
+                yield ctx.finding(
+                    node,
+                    "JG003",
+                    f"static argument {slot} of `{name}` varies per loop "
+                    "iteration — every call retraces and recompiles",
+                    "pass per-call-varying values as traced (device) "
+                    "arguments, or hoist the value out of the loop",
+                )
+    # (b) jitted body capturing mutable host state
+    for fn in _jitted_defs(ctx, index):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            path = attr_path(node.func) or ""
+            if path in IMPURE_CALLS or path.startswith(IMPURE_ROOT_PREFIXES):
+                yield ctx.finding(
+                    node,
+                    "JG003",
+                    f"jitted function `{fn.name}` calls `{path}` — the value "
+                    "is baked in at trace time and never refreshed",
+                    "compute host state outside the jitted function and pass "
+                    "it in as an argument (traced, or static if trace-stable)",
+                )
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript):
+                if (attr_path(node.value) or "") == "os.environ":
+                    yield ctx.finding(
+                        node,
+                        "JG003",
+                        f"jitted function `{fn.name}` reads os.environ — "
+                        "baked in at trace time",
+                        "resolve environment knobs at construction time "
+                        "(see pallas_per.resolve_sample_method)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# JG004 — tracer leaks out of jitted code
+
+
+def rule_jg004(ctx: ModuleContext) -> Iterator[Finding]:
+    index = _JitIndex(ctx)
+    for fn in _jitted_defs(ctx, index):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for path in assign_target_paths(node):
+                    if "." in path and path.split(".")[0] == "self":
+                        yield ctx.finding(
+                            node,
+                            "JG004",
+                            f"jitted function `{fn.name}` assigns to "
+                            f"`{path}` — tracer leak / side effect frozen at "
+                            "trace time",
+                            "return the value from the jitted function and "
+                            "assign it on the host side",
+                        )
+            elif isinstance(node, ast.Global):
+                yield ctx.finding(
+                    node,
+                    "JG004",
+                    f"jitted function `{fn.name}` writes module globals — "
+                    "tracer leak / trace-time side effect",
+                    "thread state through the function's inputs/outputs",
+                )
+
+
+# ---------------------------------------------------------------------------
+# JG005 — use after donation
+
+
+def _donating_callables(index: _JitIndex) -> Dict[str, Tuple[int, ...]]:
+    out: Dict[str, Tuple[int, ...]] = {
+        name: donate for name, donate in KNOWN_JITTED_FNS.items() if donate
+    }
+    for name, info in {**index.wrapped, **index.decorated}.items():
+        if info["donate"]:
+            out[name] = info["donate"]
+    return out
+
+
+def rule_jg005(ctx: ModuleContext) -> Iterator[Finding]:
+    index = _JitIndex(ctx)
+    donating = _donating_callables(index)
+    if not donating:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        donate = donating.get(name or "")
+        if not donate:
+            continue
+        stmt = ctx.enclosing_statement(node)
+        rebinds = set(assign_target_paths(stmt))
+        func = ctx.enclosing_function(node)
+        scope = func if func is not None else ctx.tree
+        for pos in donate:
+            if pos >= len(node.args):
+                continue
+            path = attr_path(node.args[pos])
+            if path is None or path in rebinds:
+                continue
+            # linear scan of the enclosing scope for a read of the donated
+            # binding after the call, before any rebind (source order —
+            # good enough for a linter, suppressions cover the rest)
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            events: List[Tuple[int, str]] = []
+            for n in ast.walk(scope):
+                p = attr_path(n) if isinstance(n, (ast.Name, ast.Attribute)) else None
+                if p != path:
+                    continue
+                lineno = getattr(n, "lineno", 0)
+                if lineno <= end:
+                    continue
+                is_store = isinstance(getattr(n, "ctx", None), (ast.Store, ast.Del))
+                events.append((lineno, "store" if is_store else "load"))
+            for lineno, kind in sorted(events):
+                if kind == "store":
+                    break
+                yield ctx.finding(
+                    node,
+                    "JG005",
+                    f"`{path}` is donated to `{name}` (donate_argnums "
+                    f"position {pos}) but read again at line {lineno} — "
+                    "use of a deleted buffer",
+                    "rebind the result over the donated name "
+                    "(`x = fn(x, ...)`) or copy before donating",
+                )
+                break
+
+
+RULES = [
+    ("JG001", "blocking-transfer-in-loop", rule_jg001),
+    ("JG002", "unguarded-mesh-dispatch", rule_jg002),
+    ("JG003", "retrace-hazard", rule_jg003),
+    ("JG004", "tracer-leak", rule_jg004),
+    ("JG005", "donation-misuse", rule_jg005),
+]

@@ -112,6 +112,11 @@ def run_payload(n_devices: int = 1) -> None:
     # mid-probe instead of falling back cleanly
     fast_env = dict(os.environ, BENCH_BUDGET_S="120")
     steps = [
+        # lint first: jax-free and ~instant, so a dispatch-discipline
+        # regression (graftlint JG001-JG005, docs/LINTING.md) is recorded
+        # in the step summary even if the tunnel drops before any bench
+        ("lint", [sys.executable, "-m", "tools.graftlint", "scalerl_tpu"],
+         120, env),
         # --fast first: banks a BENCH_TPU.md artifact within ~60 s of
         # contact, before the long steps gamble on the tunnel staying up
         ("bench-fast", [sys.executable, "bench.py", "--fast"], 450, fast_env),
@@ -159,9 +164,10 @@ def run_payload(n_devices: int = 1) -> None:
         f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done [{summary}] "
         "(see BENCH_TPU.md)"
     )
-    if not any(status == "ok" for _, status in outcomes):
-        # nothing succeeded: there is no witnessed artifact to record — a
-        # commit here would just stamp noise over the probe log
+    if not any(status == "ok" for name, status in outcomes if name != "lint"):
+        # nothing TPU-witnessed succeeded (lint is jax-free and passes
+        # tunnel-down, so it does not count): there is no artifact to
+        # record — a commit here would just stamp noise over the probe log
         log_probe("[watcher] no payload step succeeded; skipping witness commit")
         return
     try:
