@@ -125,7 +125,10 @@ def make_a3c_learn_fn(
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard: skip (and count) non-finite updates — see impala.py
+    return maybe_guard_nonfinite(learn, args)
 
 
 def make_a3c_optimizer(args: A3CArguments) -> optax.GradientTransformation:

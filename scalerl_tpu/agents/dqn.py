@@ -325,6 +325,12 @@ class DQNAgent(BaseAgent):
                 soft_update_tau=args.soft_update_tau,
                 target_update_frequency=args.target_update_frequency,
             )
+        from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+        # all-finite guard: a non-finite update (poisoned batch, exploding
+        # grads) is skipped and counted instead of silently corrupting the
+        # params; wrapping BEFORE _learn_raw covers the mesh re-wrap too
+        learn_fn = maybe_guard_nonfinite(learn_fn, args)
         self._learn_raw = learn_fn  # un-jitted, for enable_mesh re-wrap
         self._donate_state = donate_state
         self._shard_batch = None

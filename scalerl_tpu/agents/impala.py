@@ -181,7 +181,13 @@ def make_impala_learn_fn(
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard (lax.cond-gated): a non-finite update is skipped and
+    # counted (skipped_steps/nonfinite_grads ride the batched metrics) —
+    # applies identically to the host plane and the fused/sharded drivers,
+    # which all build their learn step through this factory
+    return maybe_guard_nonfinite(learn, args)
 
 
 def make_impala_optimizer(args: ImpalaArguments) -> optax.GradientTransformation:

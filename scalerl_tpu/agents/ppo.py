@@ -221,7 +221,10 @@ def make_ppo_learn_fn(
         )
         return new_state, metrics
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard: skip (and count) non-finite updates — see impala.py
+    return maybe_guard_nonfinite(learn, args)
 
 
 def make_ppo_optimizer(args: PPOArguments) -> optax.GradientTransformation:

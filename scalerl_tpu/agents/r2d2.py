@@ -217,7 +217,10 @@ def make_r2d2_learn_fn(
             new_prio,
         )
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard: skip (and count) non-finite updates — see impala.py
+    return maybe_guard_nonfinite(learn, args)
 
 
 class _EpsGreedyActorView:

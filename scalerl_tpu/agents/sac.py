@@ -178,7 +178,10 @@ def make_sac_learn_fn(actor, critic, actor_tx, critic_tx, alpha_tx,
         }
         return new_state, metrics, td_abs
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard: skip (and count) non-finite updates — see impala.py
+    return maybe_guard_nonfinite(learn, args)
 
 
 class SACAgent(BaseAgent):

@@ -145,7 +145,10 @@ def make_td3_learn_fn(actor, critic, actor_tx, critic_tx, args: TD3Arguments,
         }
         return new_state, metrics, td_abs
 
-    return learn
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    # all-finite guard: skip (and count) non-finite updates — see impala.py
+    return maybe_guard_nonfinite(learn, args)
 
 
 class TD3Agent(BaseAgent):

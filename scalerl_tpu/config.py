@@ -105,6 +105,17 @@ class RLArguments:
     # exit (TPU preemption safety); a second signal force-quits.
     handle_preemption: bool = True
 
+    # Numerical fault tolerance (parallel/train_step.py, runtime/chaos.py)
+    # All-finite update guard: a learn step whose result contains NaN/Inf is
+    # skipped (lax.cond inside the jitted step — no extra dispatch) and
+    # counted in the batched metrics as skipped_steps/nonfinite_grads.
+    nonfinite_guard: bool = True
+    # Divergence tripwire: after this many CONSECUTIVE skipped learn steps
+    # the trainer restores agent state from the last good resume checkpoint
+    # (falling back through the .prev chain).  <= 0 disables rollback; the
+    # guard still skips individual bad steps.
+    divergence_rollback_steps: int = 0
+
     def validate(self) -> None:
         if self.batch_size <= 0:
             raise ValueError(f"batch_size must be positive, got {self.batch_size}")

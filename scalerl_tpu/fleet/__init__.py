@@ -14,7 +14,12 @@ from scalerl_tpu.fleet.cluster import (
     WorkerServer,
     worker_loop,
 )
-from scalerl_tpu.fleet.framing import pack_message, unpack_message
+from scalerl_tpu.fleet.framing import (
+    ProtocolError,
+    pack_message,
+    pack_message_v1,
+    unpack_message,
+)
 from scalerl_tpu.fleet.generation import (
     EpisodeGenerator,
     discounted_returns,
@@ -39,7 +44,9 @@ __all__ = [
     "RemoteCluster",
     "WorkerServer",
     "worker_loop",
+    "ProtocolError",
     "pack_message",
+    "pack_message_v1",
     "unpack_message",
     "EpisodeGenerator",
     "discounted_returns",

@@ -20,9 +20,17 @@ Wire protocol (dicts over ``fleet.transport.Connection``):
                     {"kind": "result", "v": {...}}        one episode result
     gather→server   {"kind": "task_batch", "n": k}        prefetch k tasks
                     {"kind": "params", "have": v}
-                    {"kind": "result_batch", "v": [...]}  batched upload
+                    {"kind": "result_batch", "v": [...], "seq": s}
+                                                          batched upload, retained
+                                                          by the gather until acked
     server→gather   {"kind": "task_batch", "v": [t...]}   t=None means stop
                     {"kind": "params", "version": v, "weights": tree}
+                    {"kind": "result_ack", "seq": s}      upload s fully received
+
+    Every result carries an at-least-once dedup key (worker_id,
+    upload_epoch, episode_seq): un-acked uploads are resent after a
+    reconnect — a cut link or a checksum-rejected frame costs a retransmit,
+    never a lost or double-counted episode.
     entry handshake {"kind": "entry", "num_workers": n, "host": h}
                     → {"kind": "entry_ack", "base_worker_id": b, "config": {...}}
 """
@@ -108,11 +116,23 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
     Runner exceptions are *reported upstream* before the worker exits —
     the reference's fleet simply forgot dead workers (SURVEY.md §5
     failure-detection notes); here the server surfaces them.
+
+    Every result carries an at-least-once dedup key: ``(worker_id,
+    upload_epoch, episode_seq)``.  A gather that loses its server link
+    resends the in-flight upload on the fresh connection (PR 2's
+    reconnect path), so the server may legitimately see a result twice;
+    the per-worker monotonic ``episode_seq`` lets it drop the duplicate
+    instead of double-counting the episode into replay.  ``upload_epoch``
+    is a random per-worker-process nonce so an elastically *respawned*
+    worker (same id, fresh seq counter) is not mistaken for a replay.
     """
+    import os as _os
     import traceback
 
     weights: Any = None
     version = -1
+    upload_epoch = int.from_bytes(_os.urandom(4), "big")
+    episode_seq = 0
     try:
         while True:
             task = send_recv(conn, {"kind": "task"})
@@ -143,6 +163,9 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
                 break
             result["worker_id"] = worker_id
             result["param_version"] = version
+            result["upload_epoch"] = upload_epoch
+            result["episode_seq"] = episode_seq
+            episode_seq += 1
             conn.send({"kind": "result", "v": result})
     except (EOFError, OSError, ConnectionError, KeyboardInterrupt):
         pass
@@ -183,6 +206,15 @@ class Gather:
         self._server_seen = time.monotonic()
         self.tasks: "queue.Queue[Any]" = queue.Queue()
         self.results: List[Dict[str, Any]] = []
+        # at-least-once uploads, completed: every result batch is RETAINED
+        # under a gather-local upload seq until the server acks it
+        # ("result_ack").  A batch the server never processed — the link
+        # was cut mid-frame, or the frame arrived corrupt and was rejected
+        # (ProtocolError -> disconnect) — is resent after the reconnect;
+        # the server's (worker_id, episode_seq) dedup makes the redelivery
+        # exactly-once from replay's point of view.
+        self._upload_seq = 0
+        self._unacked: Dict[int, List[Dict[str, Any]]] = {}
         self._params_version = -1
         self._params_msg: Any = None
         self.worker_conns, self.worker_procs = open_worker_pipes(
@@ -221,6 +253,11 @@ class Gather:
             try:
                 self.server = self.reconnect()
                 self._server_seen = time.monotonic()
+                # the cut may have eaten in-flight uploads (or the server
+                # rejected a corrupt frame and dropped the link): resend
+                # everything unacked on the fresh link; a failure here is
+                # just another failed reconnect attempt
+                self._resend_unacked()
                 return
             except (ConnectionError, OSError) as e:
                 why = e
@@ -251,6 +288,11 @@ class Gather:
                 if msg.get("kind") == "ping":
                     self.server.send(make_pong(msg))
                 continue
+            if isinstance(msg, dict) and msg.get("kind") == "result_ack":
+                # upload acks arrive unsolicited, possibly ahead of an RPC
+                # reply — filter them like heartbeats
+                self._unacked.pop(int(msg.get("seq", -1)), None)
+                continue
             return msg
 
     def _server_rpc(self, msg: Dict[str, Any], compress: bool = False) -> Any:
@@ -279,6 +321,8 @@ class Gather:
                 if is_heartbeat(msg):
                     if msg.get("kind") == "ping":
                         self.server.send(make_pong(msg))
+                elif isinstance(msg, dict) and msg.get("kind") == "result_ack":
+                    self._unacked.pop(int(msg.get("seq", -1)), None)
                 else:
                     logger.warning(
                         "gather: unsolicited server message %r",
@@ -304,24 +348,32 @@ class Gather:
     def run(self) -> None:
         try:
             while self.worker_conns:
+                # snapshot the server link: a reconnect mid-sweep (triggered
+                # by any conn in this iteration) replaces self.server, and
+                # the STALE object may still sit in ready/dead — it must
+                # never be mistaken for a dead worker pipe
+                server_conn = self.server
                 ready, dead = wait_readable(
-                    self.worker_conns + [self.server], timeout=0.02
+                    self.worker_conns + [server_conn], timeout=0.02
                 )
                 for conn in dead:
-                    if conn is self.server:
-                        self._replace_server_conn(
-                            ConnectionError("server connection invalid")
-                        )
-                    else:
+                    if conn is server_conn:
+                        if conn is self.server:  # not already replaced
+                            self._replace_server_conn(
+                                ConnectionError("server connection invalid")
+                            )
+                    elif conn in self.worker_conns:
                         self.worker_conns.remove(conn)
                 for conn in ready:
-                    if conn is self.server:
-                        self._pump_server()
+                    if conn is server_conn:
+                        if conn is self.server:
+                            self._pump_server()
                         continue
                     try:
                         msg = conn.recv()
                     except (EOFError, OSError, ConnectionError):
-                        self.worker_conns.remove(conn)
+                        if conn in self.worker_conns:
+                            self.worker_conns.remove(conn)
                         continue
                     self._handle(conn, msg)
                 self._check_server_liveness()
@@ -373,11 +425,22 @@ class Gather:
 
     def _flush_results(self) -> None:
         if self.results:
+            batch, self.results = self.results, []
+            self._upload_seq += 1
+            self._unacked[self._upload_seq] = batch
             self._server_send(
-                {"kind": "result_batch", "v": self.results},
+                {"kind": "result_batch", "v": batch, "seq": self._upload_seq},
                 compress=self.config.compress_uplink,
             )
-            self.results = []
+
+    def _resend_unacked(self) -> None:
+        """Replay every retained (un-acked) upload on the current link —
+        plain sends: the caller owns reconnect-on-failure."""
+        for seq in sorted(self._unacked):
+            self.server.send(
+                {"kind": "result_batch", "v": self._unacked[seq], "seq": seq},
+                compress=self.config.compress_uplink,
+            )
 
 
 def gather_main(
@@ -435,6 +498,11 @@ class WorkerServer:
         self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.total_results = 0
         self.dropped_results = 0
+        # at-least-once dedup: per worker, the (upload_epoch, newest
+        # episode_seq) accepted; a reconnect-resent duplicate has the same
+        # epoch and a seq we already consumed
+        self._dedup_seen: Dict[int, Tuple[int, int]] = {}
+        self.duplicate_results = 0
         self._next_worker_id = 0
         self._id_lock = threading.Lock()
         self._stop = threading.Event()
@@ -451,6 +519,28 @@ class WorkerServer:
         self.worker_errors.put(
             {"worker_id": None, "task": None, "error": f"gather link dead: {reason}"}
         )
+
+    def _is_duplicate(self, result: Dict[str, Any]) -> bool:
+        """At-least-once dedup on the (worker_id, upload_epoch, episode_seq)
+        key stamped by ``worker_loop``.  Per-worker results flow through one
+        gather in order (reconnect resends preserve order), so "seq <= newest
+        accepted within the same epoch" identifies a resend exactly.  Results
+        without the key (foreign runners) are always accepted."""
+        wid = result.get("worker_id")
+        seq = result.get("episode_seq")
+        if wid is None or seq is None:
+            return False
+        epoch = int(result.get("upload_epoch", 0))
+        seq = int(seq)
+        last = self._dedup_seen.get(wid)
+        if last is not None and last[0] == epoch and seq <= last[1]:
+            return True
+        self._dedup_seen[wid] = (
+            (epoch, seq)
+            if last is None or last[0] != epoch
+            else (epoch, max(last[1], seq))
+        )
+        return False
 
     # -- trainer API ---------------------------------------------------
     def publish(self, weights: Any) -> int:
@@ -560,7 +650,14 @@ class WorkerServer:
                     conn, {"kind": "params", "version": version, "weights": weights}
                 )
         elif kind == "result_batch":
+            if "seq" in msg:
+                # ack FIRST: at-least-once means the gather retains the
+                # batch until this lands; dedup below absorbs redelivery
+                self.hub.send(conn, {"kind": "result_ack", "seq": msg["seq"]})
             for r in msg["v"]:
+                if self._is_duplicate(r):
+                    self.duplicate_results += 1
+                    continue
                 self.total_results += 1
                 try:
                     self.results.put_nowait(r)

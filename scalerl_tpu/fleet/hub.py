@@ -25,6 +25,7 @@ import queue
 import threading
 from typing import Any, Callable, Iterator, List, Optional, Set, Tuple
 
+from scalerl_tpu.fleet.framing import ProtocolError
 from scalerl_tpu.fleet.transport import (
     Connection,
     open_worker_pipes,
@@ -67,6 +68,7 @@ class QueueHub:
         self.heartbeat_timeout = heartbeat_timeout or 2.0 * heartbeat_interval
         self.first_contact_grace = max(first_contact_grace, self.heartbeat_timeout)
         self.on_dead = on_dead
+        self.protocol_errors = 0  # corrupt frames rejected by the recv pump
         self._liveness = LivenessTracker()
         self._greeted: Set[Connection] = set()
         self._conns: Set[Connection] = set()
@@ -132,6 +134,14 @@ class QueueHub:
             for conn in ready:
                 try:
                     msg = conn.recv()
+                except ProtocolError as e:
+                    # corrupt-frame reject: the stream is desynchronized, so
+                    # drop the link — a socket gather reconnects through the
+                    # accept loop (the PR 2 backoff path) and resends
+                    self.protocol_errors += 1
+                    logger.warning("hub: corrupt frame rejected (%s)", e)
+                    self.disconnect(conn)
+                    continue
                 except (EOFError, OSError, ConnectionError, ValueError):
                     self.disconnect(conn)
                     continue

@@ -16,11 +16,14 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from scalerl_tpu.fleet.framing import (
+    _LEN,
+    ProtocolError,
     pack_message,
     recv_frame,
     send_frame,
     unpack_message,
 )
+from scalerl_tpu.runtime import chaos
 
 
 class Connection:
@@ -43,12 +46,29 @@ class Connection:
 
 
 class SocketConnection(Connection):
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, chaos_site: str = "sock") -> None:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock = sock
+        self.chaos_site = chaos_site
 
     def send(self, msg: Any, compress: bool = False) -> None:
-        send_frame(self.sock, pack_message(msg, compress=compress))
+        data = pack_message(msg, compress=compress)
+        inj = chaos.active()
+        if inj is None:
+            send_frame(self.sock, data)
+            return
+        frames, kill = inj.frame_faults(data, site=self.chaos_site)
+        for f in frames:
+            send_frame(self.sock, f)
+        if kill is not None:
+            # mid-frame peer death: the length prefix promises the full
+            # frame, the bytes stop half-way, then the link dies — the peer
+            # sees ConnectionError("peer closed mid-frame")
+            try:
+                self.sock.sendall(_LEN.pack(len(data)) + kill)
+            finally:
+                self.close()
+            raise ProtocolError("chaos: peer killed mid-frame")
 
     def recv(self, timeout: Optional[float] = None) -> Any:
         # timeout applies only to frame *arrival*: once the length prefix
@@ -78,11 +98,27 @@ class SocketConnection(Connection):
 class PipeConnection(Connection):
     """mp.Pipe end speaking the same codec (bytes over the pipe)."""
 
-    def __init__(self, conn) -> None:
+    def __init__(self, conn, chaos_site: str = "pipe") -> None:
         self.conn = conn
+        self.chaos_site = chaos_site
 
     def send(self, msg: Any, compress: bool = False) -> None:
-        self.conn.send_bytes(pack_message(msg, compress=compress))
+        data = pack_message(msg, compress=compress)
+        inj = chaos.active()
+        if inj is None:
+            self.conn.send_bytes(data)
+            return
+        frames, kill = inj.frame_faults(data, site=self.chaos_site)
+        for f in frames:
+            self.conn.send_bytes(f)
+        if kill is not None:
+            # pipes frame at message level, so "mid-frame" is a truncated
+            # message followed by a dead fd
+            try:
+                self.conn.send_bytes(kill)
+            finally:
+                self.close()
+            raise ProtocolError("chaos: peer killed mid-frame")
 
     def recv(self, timeout: Optional[float] = None) -> Any:
         if timeout is not None and not self.conn.poll(timeout):

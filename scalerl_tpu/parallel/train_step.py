@@ -19,6 +19,7 @@ from __future__ import annotations
 from typing import Any, Callable, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from scalerl_tpu.parallel.sharding import (
     batch_sharding,
@@ -26,6 +27,85 @@ from scalerl_tpu.parallel.sharding import (
     param_sharding,
     replicated,
 )
+
+
+# ---------------------------------------------------------------------------
+# numerical fault tolerance: the all-finite update guard
+
+
+def tree_all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every inexact (float/complex) leaf of ``tree`` is finite.
+
+    Integer/bool leaves (step counters, indices) are skipped — ``isfinite``
+    is undefined for them and they cannot go NaN.
+    """
+    leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(True)
+    checks = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return checks[0] if len(checks) == 1 else jnp.all(jnp.stack(checks))
+
+
+def guard_nonfinite_updates(learn_fn: Callable) -> Callable:
+    """Wrap a pure ``(state, *args) -> (state, metrics, *aux)`` update so a
+    non-finite result SKIPS the step instead of poisoning the run.
+
+    jit-compatible by construction: the candidate update always runs; a
+    ``lax.cond`` then gates which state survives — the candidate when every
+    inexact leaf is finite, the *input* state otherwise (one exploding batch
+    costs one skipped step, not the whole run).  On a skipped step the aux
+    outputs (e.g. per-sample |TD| feeding PER priorities) are sanitized to
+    finite zeros so NaN can't leak into replay through the feedback path.
+
+    Two counters ride the metrics dict — and therefore the existing ONE
+    batched device->host transfer per chunk (PR 1/PR 3 discipline), costing
+    no extra dispatch: ``nonfinite_grads`` (1.0 when the candidate update
+    contained a non-finite value) and ``skipped_steps`` (1.0 when the update
+    was dropped; the host-side divergence tripwire counts consecutive ones).
+    Inside a scanned fused driver these are per-iteration flags that the
+    chunk-mean reduces to a fraction.
+
+    Works under ``shard_map``: gradients are psum-ed before the optimizer
+    update, so every shard evaluates the same candidate state and reaches
+    the same verdict.
+    """
+
+    def guarded(state, *args):
+        out = learn_fn(state, *args)
+        new_state, metrics, aux = out[0], dict(out[1]), tuple(out[2:])
+        ok = tree_all_finite((new_state, aux))
+
+        def keep(_):
+            return new_state, aux
+
+        def skip(_):
+            safe_aux = jax.tree_util.tree_map(
+                lambda x: jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
+                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+                else x,
+                aux,
+            )
+            return state, safe_aux
+
+        safe_state, safe_aux = jax.lax.cond(ok, keep, skip, None)
+        bad = 1.0 - ok.astype(jnp.float32)
+        metrics["nonfinite_grads"] = bad
+        metrics["skipped_steps"] = bad
+        return (safe_state, metrics) + safe_aux
+
+    return guarded
+
+
+def maybe_guard_nonfinite(learn_fn: Callable, args: Any) -> Callable:
+    """Apply :func:`guard_nonfinite_updates` unless the config disabled it
+    (``RLArguments.nonfinite_guard``, default on)."""
+    if getattr(args, "nonfinite_guard", True):
+        return guard_nonfinite_updates(learn_fn)
+    return learn_fn
 
 
 def make_parallel_learn_fn(

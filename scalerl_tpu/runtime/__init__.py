@@ -18,8 +18,13 @@ if TYPE_CHECKING:  # static analyzers see the real symbols
     )
     from scalerl_tpu.runtime.param_server import ParameterServer  # noqa: F401
     from scalerl_tpu.runtime.rollout_queue import RolloutQueue  # noqa: F401
+    from scalerl_tpu.runtime.chaos import (  # noqa: F401
+        ChaosPlan,
+        FaultInjector,
+    )
     from scalerl_tpu.runtime.supervisor import (  # noqa: F401
         CheckpointCadence,
+        DivergenceTripwire,
         PreemptionGuard,
         StallError,
         StallWatchdog,
@@ -32,7 +37,10 @@ _EXPORTS = {
     "pipelined_drive": "scalerl_tpu.runtime.dispatch",
     "ParameterServer": "scalerl_tpu.runtime.param_server",
     "RolloutQueue": "scalerl_tpu.runtime.rollout_queue",
+    "ChaosPlan": "scalerl_tpu.runtime.chaos",
+    "FaultInjector": "scalerl_tpu.runtime.chaos",
     "CheckpointCadence": "scalerl_tpu.runtime.supervisor",
+    "DivergenceTripwire": "scalerl_tpu.runtime.supervisor",
     "PreemptionGuard": "scalerl_tpu.runtime.supervisor",
     "StallError": "scalerl_tpu.runtime.supervisor",
     "StallWatchdog": "scalerl_tpu.runtime.supervisor",

@@ -330,11 +330,15 @@ class DeviceActorLearnerLoop:
         windowed = float("nan")
         frames = 0
         hit = False
+        nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
 
         def consume(ready) -> None:
-            nonlocal windowed, prev_sum, prev_cnt, hit
+            nonlocal windowed, prev_sum, prev_cnt, hit, nonfinite_chunks
             for i, m in ready:
+                if m.get("skipped_steps", 0.0) > 0.0:
+                    # guarded learn skipped >= 1 non-finite update this chunk
+                    nonfinite_chunks += 1
                 s = m["episode_return_sum"]
                 c = m["episode_count_sum"]
                 if c > prev_cnt:
@@ -363,7 +367,12 @@ class DeviceActorLearnerLoop:
             if hit:
                 break
         consume(pipe.drain())
-        summary = {"windowed_return": windowed, "frames": float(frames), "hit": hit}
+        summary = {
+            "windowed_return": windowed,
+            "frames": float(frames),
+            "hit": hit,
+            "nonfinite_chunks": float(nonfinite_chunks),
+        }
         return state, carry, summary
 
     # ------------------------------------------------------------------
@@ -393,12 +402,15 @@ class DeviceActorLearnerLoop:
         instead of the requested budget.
         """
         metrics: Dict[str, float] = {}
+        nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
 
         def consume(ready) -> None:
-            nonlocal metrics
+            nonlocal metrics, nonfinite_chunks
             for i, host_m in ready:
                 m = dict(host_m)
+                if m.get("skipped_steps", 0.0) > 0.0:
+                    nonfinite_chunks += 1
                 m["episodes"] = m.pop("episode_count_sum")
                 m["return_mean"] = m.pop("episode_return_sum") / max(
                     m["episodes"], 1.0
@@ -423,4 +435,5 @@ class DeviceActorLearnerLoop:
         consume(pipe.drain())
         jax.block_until_ready(state.params)
         metrics["chunks_done"] = float(chunks_done)
+        metrics["nonfinite_chunks"] = float(nonfinite_chunks)
         return state, carry, metrics

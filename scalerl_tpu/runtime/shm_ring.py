@@ -17,17 +17,24 @@ from __future__ import annotations
 
 import ctypes
 import multiprocessing as mp
+import struct
+import time
+import zlib
 from multiprocessing import shared_memory
 from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
 from scalerl_tpu.native import load_ring_lib
+from scalerl_tpu.runtime.chaos import active as chaos_active
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 _ALIGN = 64
+# per-slot integrity words (trailing, inside the slot stride): CRC32 of the
+# payload bytes + a monotonic per-slot commit sequence number
+_INTG = struct.Struct("<II")
 
 
 def _aligned(n: int) -> int:
@@ -67,11 +74,20 @@ class ShmRolloutRing:
         spec: SlotSpec,
         num_slots: int,
         use_native: Optional[bool] = None,
+        integrity: bool = True,
     ) -> None:
+        """``integrity``: reserve per-slot sequence+checksum words.  The
+        writer stamps a CRC32 of the payload at ``commit``; readers verify
+        (``verify_slot`` / ``pop_full_verified``) so a torn write — a
+        producer SIGKILLed mid-``memcpy``, a scribbler process — is
+        *detected* instead of silently training on garbage."""
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
         self.spec = spec
         self.num_slots = num_slots
+        self.integrity = bool(integrity)
+        self._slot_stride = spec.slot_bytes + (_ALIGN if self.integrity else 0)
+        self.torn_reads = 0  # per-process detection counter (learner-side)
         lib = load_ring_lib() if use_native in (None, True) else None
         if use_native is True and lib is None:
             raise RuntimeError("native ring requested but unavailable")
@@ -80,7 +96,7 @@ class ShmRolloutRing:
             int(lib.srl_ring_bytes(num_slots)) if self.native else 0
         )
         self._ctrl_bytes = _aligned(ctrl_bytes)
-        total = self._ctrl_bytes + num_slots * spec.slot_bytes
+        total = self._ctrl_bytes + num_slots * self._slot_stride
         self.shm = shared_memory.SharedMemory(create=True, size=total)
         self._owner = True
         self._base_obj = None  # cached ctypes buffer export (see _base_ptr)
@@ -161,6 +177,8 @@ class ShmRolloutRing:
         return self._fallback_get(self._free, timeout)
 
     def commit(self, idx: int) -> None:
+        if self.integrity:
+            self._stamp_slot(idx)
         if self.native:
             rc = self._lib().srl_ring_commit(self._base_ptr(), idx)
             if rc != 0:
@@ -185,12 +203,98 @@ class ShmRolloutRing:
             self._free.put(idx)
 
     # -- payload -------------------------------------------------------
-    def slot(self, idx: int) -> Dict[str, np.ndarray]:
-        """Zero-copy field views of slot ``idx`` in shared memory."""
+    def _slot_start(self, idx: int) -> int:
         if not 0 <= idx < self.num_slots:
             raise IndexError(idx)
-        start = self._ctrl_bytes + idx * self.spec.slot_bytes
+        return self._ctrl_bytes + idx * self._slot_stride
+
+    def slot(self, idx: int) -> Dict[str, np.ndarray]:
+        """Zero-copy field views of slot ``idx`` in shared memory."""
+        start = self._slot_start(idx)
         return self.spec.views(self.shm.buf[start:start + self.spec.slot_bytes])
+
+    # -- integrity (torn-write detection) ------------------------------
+    def _payload_crc(self, idx: int) -> int:
+        start = self._slot_start(idx)
+        mv = self.shm.buf[start:start + self.spec.slot_bytes]
+        try:
+            return zlib.crc32(mv)
+        finally:
+            mv.release()  # never leave a lingering buffer export (detach)
+
+    def _stamp_slot(self, idx: int) -> None:
+        """Write the integrity words for a filled slot (commit side)."""
+        off = self._slot_start(idx) + self.spec.slot_bytes
+        _crc_old, seq = _INTG.unpack_from(self.shm.buf, off)
+        crc = self._payload_crc(idx)
+        _INTG.pack_into(self.shm.buf, off, crc, (seq + 1) & 0xFFFFFFFF)
+        inj = chaos_active()
+        if inj is not None:
+            # tear AFTER the stamp so the reader's verify must catch it
+            start = self._slot_start(idx)
+            mv = self.shm.buf[start:start + self.spec.slot_bytes]
+            try:
+                inj.tear_slot(mv, site="shm_ring")
+            finally:
+                mv.release()
+
+    def verify_slot(self, idx: int) -> bool:
+        """Recompute the payload CRC and compare against the commit stamp."""
+        if not self.integrity:
+            return True
+        off = self._slot_start(idx) + self.spec.slot_bytes
+        crc, _seq = _INTG.unpack_from(self.shm.buf, off)
+        return crc == self._payload_crc(idx)
+
+    def slot_seq(self, idx: int) -> int:
+        """Commit sequence number of slot ``idx`` (0 = never committed)."""
+        if not self.integrity:
+            return 0
+        off = self._slot_start(idx) + self.spec.slot_bytes
+        return _INTG.unpack_from(self.shm.buf, off)[1]
+
+    def pop_full_verified(
+        self,
+        timeout: Optional[float] = None,
+        repolls: int = 3,
+        repoll_delay_s: float = 0.002,
+    ) -> Optional[int]:
+        """``pop_full`` + checksum verification.
+
+        A mismatching slot is re-polled ``repolls`` times (a commit-ordering
+        race resolves in microseconds; a true torn write never does), then
+        counted in ``torn_reads``, released back to the free pool, and the
+        next full slot is tried — the learner skips the corrupt payload
+        instead of training on it.  Returns None on timeout/close, exactly
+        like ``pop_full``.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            t = (
+                None
+                if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            idx = self.pop_full(timeout=t)
+            if idx is None:
+                return None
+            ok = self.verify_slot(idx)
+            for _ in range(repolls):
+                if ok:
+                    break
+                time.sleep(repoll_delay_s)
+                ok = self.verify_slot(idx)
+            if ok:
+                return idx
+            self.torn_reads += 1
+            logger.warning(
+                "shm ring: torn/corrupt slot %d detected (seq %d); "
+                "released without consuming (%d total)",
+                idx, self.slot_seq(idx), self.torn_reads,
+            )
+            self.release(idx)
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
 
     def gather_batch(
         self, idxs: List[int], out: Optional[Dict[str, np.ndarray]] = None
@@ -210,7 +314,7 @@ class ShmRolloutRing:
                 nbytes = int(np.prod(shape)) * dtype.itemsize
                 srcs = (ctypes.c_char_p * n)(
                     *(
-                        base + idx * self.spec.slot_bytes + self.spec.offsets[name]
+                        base + idx * self._slot_stride + self.spec.offsets[name]
                         for idx in idxs
                     )
                 )
@@ -233,7 +337,12 @@ class ShmRolloutRing:
         and the closed flag are reported there — still enough to tell "ring
         closed under us" from "producers wedged".
         """
-        out = {"slots": self.num_slots, "closed": int(self.closed)}
+        out = {
+            "slots": self.num_slots,
+            "closed": int(self.closed),
+            "integrity": int(self.integrity),
+            "torn_reads": self.torn_reads,
+        }
         if not self.native:
             out["free"] = self._free.qsize()
             out["full"] = self._full.qsize()

@@ -386,6 +386,49 @@ class PreemptionGuard:
         self.restore()
 
 
+class DivergenceTripwire:
+    """Host-side divergence breaker over the guarded train step's metrics.
+
+    The jitted all-finite guard (``parallel/train_step.py``) already skips
+    individual non-finite updates; this tripwire watches the
+    ``skipped_steps`` counter it emits and, after ``k`` CONSECUTIVE bad
+    steps (a diverged run, not a single poisoned batch), invokes the
+    rollback callback — typically "restore agent state from the last good
+    checkpoint" (``OffPolicyTrainer._divergence_rollback``).  jax-free: it
+    consumes the already-materialized host metrics dict, adding zero device
+    traffic.
+    """
+
+    def __init__(self, k: int, on_trip: Optional[Callable[[], None]]) -> None:
+        self.k = int(k)
+        self.on_trip = on_trip
+        self.consecutive = 0
+        self.trips = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.k > 0 and self.on_trip is not None
+
+    def observe(self, metrics: Optional[Dict[str, Any]]) -> bool:
+        """Feed one step's host metrics; True when the rollback fired."""
+        bad = 0.0
+        if metrics:
+            try:
+                bad = float(metrics.get("skipped_steps", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                bad = 0.0
+        if bad > 0.0:
+            self.consecutive += 1
+        else:
+            self.consecutive = 0
+        if self.enabled and self.consecutive >= self.k:
+            self.consecutive = 0
+            self.trips += 1
+            self.on_trip()
+            return True
+        return False
+
+
 class CheckpointCadence:
     """When is a resume save due?  Frame interval OR wall-clock interval.
 

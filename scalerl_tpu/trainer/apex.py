@@ -298,16 +298,24 @@ class ApexTrainer(BaseTrainer):
         # state.params concurrently, and donation would free those buffers
         # mid-read (DQNAgent defaults to donating for the single-threaded
         # off-policy trainer)
+        from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
         agent._learn = jax.jit(
-            make_dqn_learn_fn(
-                agent.network,
-                agent.optimizer,
-                gamma=args.gamma,
-                n_step=args.n_steps,
-                double_dqn=args.double_dqn,
-                use_soft_update=args.use_soft_update,
-                soft_update_tau=args.soft_update_tau,
-                target_update_frequency=args.target_update_frequency,
+            # re-apply the all-finite guard: this re-jit replaces the
+            # agent's (already guarded) learn, and Ape-X must keep the same
+            # skip-non-finite-updates contract
+            maybe_guard_nonfinite(
+                make_dqn_learn_fn(
+                    agent.network,
+                    agent.optimizer,
+                    gamma=args.gamma,
+                    n_step=args.n_steps,
+                    double_dqn=args.double_dqn,
+                    use_soft_update=args.use_soft_update,
+                    soft_update_tau=args.soft_update_tau,
+                    target_update_frequency=args.target_update_frequency,
+                ),
+                args,
             )
         )
         self.per_beta = LinearDecayScheduler(

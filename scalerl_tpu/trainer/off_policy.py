@@ -13,16 +13,20 @@ acting and learning are jitted device calls through the agent.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from scalerl_tpu.agents.dqn import DQNAgent
 from scalerl_tpu.config import DQNArguments
 from scalerl_tpu.data.sampler import Sampler
+from scalerl_tpu.runtime import chaos
 from scalerl_tpu.runtime.dispatch import get_metrics
+from scalerl_tpu.runtime.supervisor import DivergenceTripwire
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 from scalerl_tpu.utils.schedulers import LinearDecayScheduler
@@ -67,6 +71,12 @@ class OffPolicyTrainer(BaseTrainer):
         self.global_step = 0
         self.learn_steps = 0
         self.metrics = EpisodeMetrics(self.num_envs)
+        # divergence tripwire: K consecutive guarded-away (non-finite) learn
+        # steps restore the agent from the last good resume checkpoint
+        self.tripwire = DivergenceTripwire(
+            getattr(args, "divergence_rollback_steps", 0),
+            self._divergence_rollback,
+        )
 
     # ------------------------------------------------------------------
     def store_experience(
@@ -92,12 +102,55 @@ class OffPolicyTrainer(BaseTrainer):
     def train_step(self) -> Dict[str, float]:
         beta = self.per_beta.value(self.global_step)
         batch = self.sampler.sample(self.args.batch_size, beta=beta)
+        inj = chaos.active()
+        if inj is not None:
+            # seeded NaN/Inf bursts land HERE (the sampled batch, not the
+            # buffer) so the guarded learn step and the tripwire below are
+            # what absorbs them
+            batch = dict(batch)
+            inj.poison_batch(batch, site="offpolicy.batch")
         info = self.agent.learn(batch)
         if self.args.use_per:
             self.sampler.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
         info.pop("td_abs", None)
         self.learn_steps += 1
+        self.tripwire.observe(info)
         return info
+
+    def _divergence_rollback(self) -> None:
+        """Restore agent state from the last good resume checkpoint after K
+        consecutive non-finite (skipped) learn steps.
+
+        Cold path by definition — it runs at most once per divergence
+        event — so it performs ONE explicit blocking readback of the
+        restored params to assert finiteness before training resumes
+        (graftlint JG001 allowlists this handler for exactly that read).
+        Env progress (``global_step``) and the replay buffer are kept: the
+        divergence corrupted the *params*, not the experience.
+        """
+        try:
+            state = self.load_resume_checkpoint(self._resume_pytree())
+        except FileNotFoundError:
+            state = None
+        if state is None:
+            self.text_logger.warning(
+                "divergence tripwire fired but no resume checkpoint exists; "
+                "continuing with the current (guard-protected) state"
+            )
+            return
+        self.agent.state = state["agent"]
+        self.learn_steps = int(state["learn_steps"])
+        leaves = jax.device_get(jax.tree_util.tree_leaves(self.agent.state))
+        finite = all(
+            bool(np.all(np.isfinite(leaf)))
+            for leaf in leaves
+            if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+        )
+        self.text_logger.warning(
+            "divergence tripwire: restored agent state from %s "
+            "(learn_steps=%d, params finite=%s, rollback #%d)",
+            self.resume_ckpt_path, self.learn_steps, finite, self.tripwire.trips,
+        )
 
     def run_evaluate_episodes(self, n_episodes: Optional[int] = None) -> Dict[str, float]:
         """Greedy rollouts on the eval env pool until ``n_episodes`` finish
@@ -169,6 +222,15 @@ class OffPolicyTrainer(BaseTrainer):
         args = self.args
         if self.resuming:
             self.try_resume()
+        if (
+            self.tripwire.enabled
+            and self.is_main_process
+            and args.save_model
+            and not args.disable_checkpoint
+            and not os.path.exists(self.resume_ckpt_path)
+        ):
+            # rollback needs a "last good" state to return to from step 0
+            self.save_resume()
         obs, _ = self.train_envs.reset(seed=args.seed)
         start = time.time()
         start_step = self.global_step

@@ -270,7 +270,8 @@ class ParallelDQNTrainer(BaseTrainer):
     def _drain(self, max_slabs: int = 8) -> int:
         drained = 0
         while drained < max_slabs:
-            idx = self.ring.pop_full(timeout=0.05 if drained else 0.5)
+            # verified pop: torn slots are detected/released, never trained on
+            idx = self.ring.pop_full_verified(timeout=0.05 if drained else 0.5)
             if idx is None:
                 break
             slab = self.ring.gather_batch([idx])

@@ -455,7 +455,9 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                 raise RuntimeError(
                     "actor process failed:\n" + "\n".join(self._actor_error)
                 )
-            idx = self.ring.pop_full(timeout=1.0)
+            # verified pop: a torn/corrupt slot (producer killed mid-write)
+            # is detected by its checksum, released, and skipped
+            idx = self.ring.pop_full_verified(timeout=1.0)
             if idx is None:
                 if self.ring.closed or self._stop.is_set():
                     for i in idxs:

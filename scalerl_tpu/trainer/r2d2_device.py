@@ -148,6 +148,10 @@ class DeviceR2D2Trainer(BaseTrainer):
             self._collect_insert = None
         self._max_priority = 1.0
         self.env_frames = 0
+        # observed skipped-update events (guarded learn; sampled at metric
+        # boundaries, so this undercounts dense bursts — a diagnostic, not
+        # an exact tally)
+        self.nonfinite_events = 0
         # PER search method pinned at construction (not at first trace of
         # the fused program), so SCALERL_PER_METHOD / backend changes
         # can't be silently ignored
@@ -479,6 +483,11 @@ class DeviceR2D2Trainer(BaseTrainer):
                 )
                 s = host.pop("_ret_sum")
                 c = host.pop("_ep_cnt")
+                if host.get("skipped_steps", 0.0) > 0.0:
+                    # the guarded learn skipped a non-finite update in the
+                    # last fused iteration (flag rides the SAME batched
+                    # transfer — no extra host sync to count it)
+                    self.nonfinite_events += 1
                 if c > prev_cnt:
                     # windowed: episodes completed since the previous log —
                     # the learning signal (the cumulative mean drags the
@@ -506,6 +515,8 @@ class DeviceR2D2Trainer(BaseTrainer):
         mark_s, mark_c = final_mark if final_mark is not None else (0.0, 0.0)
         if c > mark_c:
             windowed = (s - mark_s) / (c - mark_c)
+        if final.get("skipped_steps", 0.0) > 0.0:
+            self.nonfinite_events += 1
         sps = self.env_frames / max(time.time() - start, 1e-8)
         return {
             **final,
@@ -515,4 +526,5 @@ class DeviceR2D2Trainer(BaseTrainer):
             "return_mean": s / max(c, 1.0),
             "return_windowed": windowed,
             "episodes": c,
+            "nonfinite_events": float(self.nonfinite_events),
         }

@@ -20,16 +20,83 @@ Crash-safety contract (the supervision layer leans on this):
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# per-leaf digest manifest written INSIDE every checkpoint dir; orbax
+# ignores foreign files, and the manifest travels with the dir through the
+# .prev rotation for free
+MANIFEST_NAME = "integrity_manifest.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """Restored leaves do not match the manifest digests (silent corruption
+    orbax cannot see — a flipped bit in a data file still parses)."""
+
+
+def _leaf_digest(leaf: Any) -> str:
+    arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+    h = hashlib.sha256()
+    h.update(str((arr.dtype.str, arr.shape)).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _tree_digests(state: Any) -> List[Dict[str, str]]:
+    """Per-leaf sha256 digests, with save-time key paths for diagnostics.
+
+    Verification compares the digest MULTISET, not the paths: a restore
+    without a ``target`` materializes container types (dicts) different
+    from the saved dataclasses, which reorders/renames paths while the leaf
+    bytes — the thing integrity is about — are unchanged.
+    """
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        out.append({"path": jax.tree_util.keystr(path), "sha256": _leaf_digest(leaf)})
+    return out
+
+
+def write_manifest(path: str, state: Any) -> str:
+    manifest = {"format": 1, "leaves": _tree_digests(state)}
+    target = os.path.join(path, MANIFEST_NAME)
+    with open(target, "w") as f:
+        json.dump(manifest, f, indent=1)
+    return target
+
+
+def verify_manifest(path: str, restored: Any) -> None:
+    """Raise :class:`CheckpointIntegrityError` if ``restored`` does not
+    reproduce the digests recorded at save time.  Checkpoints predating the
+    manifest (no file) pass — upgrade compatibility."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        return
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        expected = sorted(leaf["sha256"] for leaf in manifest["leaves"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable integrity manifest at {mpath}: {e}"
+        ) from e
+    actual = sorted(d["sha256"] for d in _tree_digests(restored))
+    if expected != actual:
+        bad = len(set(expected).symmetric_difference(actual))
+        raise CheckpointIntegrityError(
+            f"checkpoint {path} failed digest verification: "
+            f"{bad} leaf digest(s) differ from the save-time manifest"
+        )
 
 
 def _prev_path(path: str, k: int) -> str:
@@ -72,6 +139,11 @@ def save_checkpoint(path: str, state: Any, keep_last: int = 1) -> str:
         shutil.rmtree(tmp)
     checkpointer.save(tmp, state)
     checkpointer.wait_until_finished()
+    # per-leaf digest manifest INSIDE the dir (before the atomic rename, so
+    # a checkpoint is never visible without its manifest): load_checkpoint
+    # verifies restored bytes against it and falls back through .prev on a
+    # mismatch — deterministic corruption detection, not "hope orbax raises"
+    write_manifest(tmp, state)
     # rotate the retention chain oldest-first so each rename target is free
     if os.path.exists(path):
         oldest = _prev_path(path, max(keep_last, 1))
@@ -87,6 +159,11 @@ def save_checkpoint(path: str, state: Any, keep_last: int = 1) -> str:
         prev = _prev_path(path, 1)
         if os.path.exists(prev):
             shutil.rmtree(prev)
+    inj = _chaos_active()
+    if inj is not None:
+        # chaos: leave the freshly-landed checkpoint partial (a preemption
+        # mid-flush) — restores must fall back through the .prev chain
+        inj.corrupt_checkpoint(path)
     return path
 
 
@@ -122,5 +199,14 @@ def _restore(path: str, target: Optional[Any]) -> Any:
     checkpointer = ocp.StandardCheckpointer()
     if target is not None:
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct, target)
-        return checkpointer.restore(path, abstract)
-    return checkpointer.restore(path)
+        restored = checkpointer.restore(path, abstract)
+    else:
+        restored = checkpointer.restore(path)
+    verify_manifest(path, restored)
+    return restored
+
+
+def _chaos_active():
+    from scalerl_tpu.runtime import chaos
+
+    return chaos.active()

@@ -87,6 +87,154 @@ def test_codec_decoded_arrays_are_writable():
 
 
 # ---------------------------------------------------------------------------
+# codec integrity: SRL2 checksum + typed malformed-input handling
+
+
+def _random_pytree(rng, depth=0):
+    """Random codec-encodable pytree: nested dicts/lists/tuples over arrays,
+    scalars, strings, and bytes."""
+    kind = rng.integers(0, 8 if depth < 3 else 5)
+    if kind == 0:
+        dtype = rng.choice([np.float32, np.int32, np.uint8, np.float64, np.bool_])
+        shape = tuple(int(s) for s in rng.integers(0, 5, size=int(rng.integers(0, 3))))
+        # np.asarray: rng.random(()) yields a numpy SCALAR, which the codec
+        # (by design) round-trips as a python scalar, not a 0-d array
+        return np.asarray(rng.random(shape) * 100).astype(dtype)
+    if kind == 1:
+        return float(rng.random())
+    if kind == 2:
+        return int(rng.integers(-1000, 1000))
+    if kind == 3:
+        return rng.bytes(int(rng.integers(0, 20)))
+    if kind == 4:
+        return "".join(chr(int(c)) for c in rng.integers(32, 1000, size=5))
+    n = int(rng.integers(0, 4))
+    children = [_random_pytree(rng, depth + 1) for _ in range(n)]
+    if kind == 5:
+        return {f"k{i}": c for i, c in enumerate(children)}
+    if kind == 6:
+        return children
+    return tuple(children)
+
+
+def _assert_trees_equal(a, b):
+    assert type(a) is type(b), (type(a), type(b))
+    if isinstance(a, np.ndarray):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    elif isinstance(a, dict):
+        assert a.keys() == b.keys()
+        for k in a:
+            _assert_trees_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_trees_equal(x, y)
+    else:
+        assert a == b
+
+
+def test_codec_property_random_pytrees_roundtrip():
+    rng = np.random.default_rng(0)
+    for case in range(40):
+        tree = {"case": case, "payload": _random_pytree(rng)}
+        for compress in (False, True):
+            _assert_trees_equal(unpack_message(pack_message(tree, compress)), tree)
+
+
+def test_codec_truncation_at_every_byte_boundary_is_typed():
+    """A frame cut ANYWHERE must raise ProtocolError — never wrong data,
+    never a bare struct/json error."""
+    from scalerl_tpu.fleet.framing import ProtocolError
+
+    frame = pack_message(
+        {"a": np.arange(48, dtype=np.float32), "s": "meta", "b": b"\x01\x02"},
+        compress=True,
+    )
+    for cut in range(len(frame)):
+        with pytest.raises(ProtocolError):
+            unpack_message(frame[:cut])
+
+
+def test_codec_single_bit_flips_always_detected():
+    """CRC32 over prefix+header+body: EVERY single-bit flip in a v2 frame is
+    rejected as ProtocolError — including flips in the flags/length fields."""
+    from scalerl_tpu.fleet.framing import ProtocolError
+
+    frame = pack_message({"a": np.arange(16, dtype=np.int32), "n": 7}, compress=True)
+    for bit in range(len(frame) * 8):
+        mutated = bytearray(frame)
+        mutated[bit // 8] ^= 1 << (bit % 8)
+        with pytest.raises(ProtocolError):
+            unpack_message(bytes(mutated))
+
+
+def test_codec_v1_frames_still_decode():
+    """Rolling upgrade: pre-checksum SRL1 senders decode for one window."""
+    from scalerl_tpu.fleet.framing import pack_message_v1
+
+    msg = {"a": np.arange(6, dtype=np.float32), "k": {1: "x"}}
+    out = unpack_message(pack_message_v1(msg, compress=True))
+    np.testing.assert_array_equal(out["a"], msg["a"])
+    assert out["k"] == {1: "x"}
+
+
+def test_codec_malformed_headers_are_typed():
+    import struct as _struct
+
+    from scalerl_tpu.fleet.framing import MAX_FRAME, ProtocolError
+
+    with pytest.raises(ProtocolError, match="magic"):
+        unpack_message(b"NOPE" + b"\x00" * 30)
+    with pytest.raises(ProtocolError):
+        unpack_message(b"")
+    with pytest.raises(ProtocolError):
+        unpack_message(b"SRL2")  # shorter than the fixed header
+    # oversize hlen/blen must reject typed, not attempt a multi-GiB read
+    huge = _struct.pack("!4sBIQ", b"SRL1", 0, 2**31, MAX_FRAME + 1)
+    with pytest.raises(ProtocolError, match="oversize|inconsistent"):
+        unpack_message(huge + b"x" * 64)
+
+
+def test_worker_results_carry_dedup_key_and_server_drops_duplicates():
+    """At-least-once uploads: results are stamped (worker_id, upload_epoch,
+    episode_seq) and a resent batch is not double-counted into results."""
+    config = FleetConfig(num_workers=1)
+    server = WorkerServer(config, lambda: None)
+    conn = object()  # _handle only forwards it to hub.send for acks
+
+    sent = []
+    server.hub.send = lambda c, m, compress=False: sent.append(m)  # type: ignore
+    batch = {
+        "kind": "result_batch",
+        "seq": 1,
+        "v": [
+            {"worker_id": 0, "upload_epoch": 99, "episode_seq": 0, "x": 1},
+            {"worker_id": 0, "upload_epoch": 99, "episode_seq": 1, "x": 2},
+        ],
+    }
+    server._handle(conn, batch)
+    server._handle(conn, batch)  # the reconnect-and-resend duplicate
+    assert server.total_results == 2
+    assert server.duplicate_results == 2
+    assert server.results.qsize() == 2
+    # both deliveries were acked (the gather releases its retained copy)
+    assert [m for m in sent if m.get("kind") == "result_ack"] == [
+        {"kind": "result_ack", "seq": 1},
+        {"kind": "result_ack", "seq": 1},
+    ]
+    # a RESPAWNED worker (same id, fresh epoch) is new data, not a duplicate
+    server._handle(conn, {
+        "kind": "result_batch", "seq": 2,
+        "v": [{"worker_id": 0, "upload_epoch": 100, "episode_seq": 0, "x": 3}],
+    })
+    assert server.total_results == 3
+    # results lacking the key (foreign runners) are always accepted
+    server._handle(conn, {"kind": "result_batch", "v": [{"x": 4}, {"x": 4}]})
+    assert server.total_results == 5
+
+
+# ---------------------------------------------------------------------------
 # transport
 
 

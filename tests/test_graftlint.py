@@ -115,6 +115,36 @@ def test_jg001_only_hot_packages():
     assert lint(BAD_JG001_FLOAT_LOOP, relpath=COLD) == []
 
 
+def test_jg001_cold_path_allowlist():
+    """The divergence-rollback handler is a sanctioned cold path: one
+    explicit blocking readback per divergence event is the point, so the
+    allowlist exempts it by enclosing-function name — and ONLY it."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        class Trainer:
+            def _divergence_rollback(self):
+                for ckpt in self.candidates:
+                    val = jnp.max(self.agent.state.params)
+                    ok = float(val)  # sanctioned: one readback per rollback
+                return ok
+
+            def _not_sanctioned(self):
+                for ckpt in self.candidates:
+                    val = jnp.max(self.agent.state.params)
+                    ok = float(val)  # identical shape: must still flag
+                return ok
+    """
+    findings = lint(src)
+    assert rules_of(findings) == ["JG001"]  # the un-sanctioned twin flags
+    # and the single finding lies in _not_sanctioned, not the handler
+    import textwrap as _tw
+    lines = _tw.dedent(src).splitlines()
+    boundary = next(i for i, ln in enumerate(lines, 1) if "_not_sanctioned" in ln)
+    assert all(f.line > boundary for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # JG002 — unguarded mesh dispatch from threaded modules
 

@@ -78,6 +78,14 @@ KNOWN_JITTED_FNS: Dict[str, Tuple[int, ...]] = {
     "per_add_with_priorities": (0,),
 }
 
+# JG001 allowlist: cold-path recovery handlers where ONE blocking readback
+# is the point.  The divergence-rollback handler restores params from the
+# last good checkpoint and reads them back once to assert finiteness before
+# training resumes — it runs at most once per divergence event, never in
+# the steady state, so the host sync is sanctioned by design (the same
+# contract as the explicit float(jax.device_get(x)) idiom).
+JG001_COLD_FUNCS = {"_divergence_rollback"}
+
 # host-state calls that must not be captured inside jitted code
 IMPURE_CALLS = {
     "time.time",
@@ -260,6 +268,9 @@ def rule_jg001(ctx: ModuleContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
+        enclosing = ctx.enclosing_function(node)
+        if enclosing is not None and enclosing.name in JG001_COLD_FUNCS:
+            continue  # sanctioned cold-path recovery handler
         in_loop = ctx.enclosing_loop(node) is not None
         where = " inside a loop body" if in_loop else ""
         # float(X) / int(X) on a jax value

@@ -117,6 +117,16 @@ def run_payload(n_devices: int = 1) -> None:
         # in the step summary even if the tunnel drops before any bench
         ("lint", [sys.executable, "-m", "tools.graftlint", "scalerl_tpu"],
          120, env),
+        # chaos soak second: seeded fault injection over the data plane
+        # (frame corruption, torn shm slots, partial checkpoints, NaN
+        # bursts — tests/test_chaos.py -m chaos).  CPU-pinned and bounded,
+        # so like lint it records integrity regressions even when the
+        # tunnel flaps — and like lint it doesn't count toward the
+        # witness-commit quorum (no TPU was exercised)
+        ("chaos-soak",
+         [sys.executable, "-m", "pytest", "tests/test_chaos.py", "-q",
+          "-m", "chaos"],
+         900, dict(env, JAX_PLATFORMS="cpu")),
         # --fast first: banks a BENCH_TPU.md artifact within ~60 s of
         # contact, before the long steps gamble on the tunnel staying up
         ("bench-fast", [sys.executable, "bench.py", "--fast"], 450, fast_env),
@@ -164,9 +174,13 @@ def run_payload(n_devices: int = 1) -> None:
         f"{time.strftime('%Y-%m-%d %H:%M:%S')} payload done [{summary}] "
         "(see BENCH_TPU.md)"
     )
-    if not any(status == "ok" for name, status in outcomes if name != "lint"):
-        # nothing TPU-witnessed succeeded (lint is jax-free and passes
-        # tunnel-down, so it does not count): there is no artifact to
+    if not any(
+        status == "ok"
+        for name, status in outcomes
+        if name not in ("lint", "chaos-soak")
+    ):
+        # nothing TPU-witnessed succeeded (lint and the chaos soak are
+        # CPU-only and pass tunnel-down, so they do not count): there is no artifact to
         # record — a commit here would just stamp noise over the probe log
         log_probe("[watcher] no payload step succeeded; skipping witness commit")
         return
