@@ -368,17 +368,27 @@ def _run_measurement(
     # still a host transfer, which under the axon tunnel is the only
     # trustworthy completion signal (block_until_ready is not)
     pipe = MetricsPipeline(depth=2)
+    # --profile-dir / BENCH_PROFILE_DIR: capture a device+host trace of the
+    # measured window with one step_marker per fused chunk so the trace
+    # viewer lines chunks up against the telemetry spans (a no-op when
+    # unset; tracing perturbs the measurement, so profile runs are for
+    # understanding the number, not reporting it)
+    from scalerl_tpu.utils.profiling import maybe_trace, step_marker
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR") or None
     t0 = time.perf_counter()
     i = 0
-    while True:
-        key, sub = jax.random.split(key)
-        state, carry, metrics = run_fn(state, carry, sub)
-        i += 1
-        frames += frames_per_call
-        pipe.push(i, metrics)
-        if time.perf_counter() - t0 >= target_s and i >= min_iters:
-            break
-    pipe.drain()
+    with maybe_trace(profile_dir):
+        while True:
+            key, sub = jax.random.split(key)
+            with step_marker(i):
+                state, carry, metrics = run_fn(state, carry, sub)
+            i += 1
+            frames += frames_per_call
+            pipe.push(i, metrics)
+            if time.perf_counter() - t0 >= target_s and i >= min_iters:
+                break
+        pipe.drain()
     elapsed = time.perf_counter() - t0
 
     fps = frames / elapsed
@@ -735,6 +745,13 @@ def _argv_mesh() -> str | None:
 
 
 if __name__ == "__main__":
+    if "--profile-dir" in sys.argv[1:]:
+        # ride through the environment so the measurement CHILD (a separate
+        # process) sees it; RLArguments.profile_dir covers trainer runs
+        _i = sys.argv.index("--profile-dir")
+        if _i + 1 >= len(sys.argv):
+            raise SystemExit("--profile-dir requires a directory argument")
+        os.environ["BENCH_PROFILE_DIR"] = sys.argv[_i + 1]
     if "--probe" in sys.argv[1:]:  # kept for manual tunnel checks
         import jax
 

@@ -105,6 +105,20 @@ class RLArguments:
     # exit (TPU preemption safety); a second signal force-quits.
     handle_preemption: bool = True
 
+    # Observability (runtime/telemetry.py, utils/profiling.py)
+    # Device+host trace directory: when set, trainers/bench wrap their
+    # measure loops in jax.profiler traces (utils.profiling.maybe_trace)
+    # with a step_marker per fused chunk so device streams line up against
+    # telemetry spans in the trace viewer.  Empty disables tracing.
+    profile_dir: str = ""
+    # Telemetry export directory: when set, a background loop writes
+    # periodic JSONL snapshots (telemetry.jsonl) and a Prometheus-style
+    # text exposition file (metrics.prom) from the process registry.
+    # Empty defaults to <run_dir>/telemetry when telemetry_interval_s > 0.
+    telemetry_dir: str = ""
+    # Export cadence in seconds; <= 0 disables the export loop entirely.
+    telemetry_interval_s: float = 30.0
+
     # Numerical fault tolerance (parallel/train_step.py, runtime/chaos.py)
     # All-finite update guard: a learn step whose result contains NaN/Inf is
     # skipped (lax.cond inside the jitted step — no extra dispatch) and

@@ -55,8 +55,10 @@ from scalerl_tpu.fleet.transport import (
     send_recv,
     wait_readable,
 )
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.supervisor import is_heartbeat, make_pong
+from scalerl_tpu.runtime.telemetry import TelemetryAggregator
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -92,6 +94,13 @@ class FleetConfig:
     reconnect_backoff_s: float = 0.5
     reconnect_backoff_cap_s: float = 10.0
     max_reconnects: int = 5
+    # Telemetry plane (runtime/telemetry.py): gathers piggyback compact
+    # registry snapshots (their own counters + per-worker payloads relayed
+    # from worker results) on heartbeat pongs and result-upload frames; the
+    # server merges them into per-worker and aggregate series.  No new
+    # message kinds or round-trips — just extra dict keys on existing v2
+    # codec frames.  False strips the piggyback (pre-telemetry wire shape).
+    telemetry_piggyback: bool = True
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @property
@@ -133,6 +142,8 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
     version = -1
     upload_epoch = int.from_bytes(_os.urandom(4), "big")
     episode_seq = 0
+    reg = telemetry.get_registry()
+    ep_meter = reg.meter("worker.episodes_per_s")
     try:
         while True:
             task = send_recv(conn, {"kind": "task"})
@@ -146,9 +157,11 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
                 if reply is not None:
                     version = int(reply["version"])
                     weights = reply["weights"]
+                    reg.counter("worker.param_fetches").inc()
             try:
                 result = runner(task, weights, worker_id)
             except Exception as exc:  # noqa: BLE001 - funneled upstream
+                reg.counter("worker.errors").inc()
                 conn.send(
                     {
                         "kind": "worker_error",
@@ -166,6 +179,12 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
             result["upload_epoch"] = upload_epoch
             result["episode_seq"] = episode_seq
             episode_seq += 1
+            reg.counter("worker.episodes").inc()
+            ep_meter.mark()
+            # compact telemetry piggyback: rides the existing result frame
+            # up through the gather to the server's aggregator — no extra
+            # messages (the gather strips it before the dedup-keyed upload)
+            result["_telem"] = reg.compact()
             conn.send({"kind": "result", "v": result})
     except (EOFError, OSError, ConnectionError, KeyboardInterrupt):
         pass
@@ -217,6 +236,21 @@ class Gather:
         self._unacked: Dict[int, List[Dict[str, Any]]] = {}
         self._params_version = -1
         self._params_msg: Any = None
+        # telemetry plane: this gather's own counters plus the newest
+        # compact snapshot relayed from each worker's result stream; both
+        # ride the uplink on pongs and result-batch frames
+        self.base_worker_id = base_worker_id
+        self._worker_telem: Dict[int, Dict[str, float]] = {}
+        self._reg = telemetry.get_registry()
+        self._reg.bind(
+            "gather",
+            lambda: {
+                "unacked_uploads": len(self._unacked),
+                "live_workers": len(self.worker_conns),
+                "reconnects": self.reconnects_used,
+                "params_version": self._params_version,
+            },
+        )
         self.worker_conns, self.worker_procs = open_worker_pipes(
             num_workers,
             worker_loop,
@@ -244,6 +278,10 @@ class Gather:
                 self.config.reconnect_backoff_cap_s,
             )
             self.reconnects_used += 1
+            self._reg.counter("gather.reconnect_attempts").inc()
+            telemetry.record_event(
+                "reconnect", attempt=self.reconnects_used, why=repr(why)
+            )
             logger.warning(
                 "gather: server link lost (%r); reconnecting in %.2fs "
                 "(attempt %d/%d)",
@@ -286,7 +324,7 @@ class Gather:
             self._server_seen = time.monotonic()
             if is_heartbeat(msg):
                 if msg.get("kind") == "ping":
-                    self.server.send(make_pong(msg))
+                    self.server.send(self._make_pong(msg))
                 continue
             if isinstance(msg, dict) and msg.get("kind") == "result_ack":
                 # upload acks arrive unsolicited, possibly ahead of an RPC
@@ -320,7 +358,7 @@ class Gather:
                 self._server_seen = time.monotonic()
                 if is_heartbeat(msg):
                     if msg.get("kind") == "ping":
-                        self.server.send(make_pong(msg))
+                        self.server.send(self._make_pong(msg))
                 elif isinstance(msg, dict) and msg.get("kind") == "result_ack":
                     self._unacked.pop(int(msg.get("seq", -1)), None)
                 else:
@@ -330,6 +368,24 @@ class Gather:
                     )
         except (ConnectionError, EOFError, OSError) as e:
             self._replace_server_conn(e)
+
+    # -- telemetry piggyback -------------------------------------------
+    def _telemetry_payload(self) -> Dict[str, Any]:
+        """Compact snapshot for the uplink: this gather's registry plus the
+        newest per-worker snapshots relayed off the result stream."""
+        return {
+            "src": f"gather:{self.base_worker_id}",
+            "v": self._reg.compact(),
+            "workers": {str(w): s for w, s in self._worker_telem.items()},
+        }
+
+    def _make_pong(self, ping_msg: Dict[str, Any]) -> Dict[str, Any]:
+        pong = make_pong(ping_msg)
+        if self.config.telemetry_piggyback:
+            # heartbeat pongs carry the compact snapshot: a silent-but-idle
+            # gather still reports series every heartbeat interval
+            pong["telem"] = self._telemetry_payload()
+        return pong
 
     def _check_server_liveness(self) -> None:
         # silent-death is a TCP pathology: pipe links (reconnect=None) skip
@@ -393,6 +449,8 @@ class Gather:
             task = None if self._exhausted else self.tasks.get()
             if task is None:
                 self._exhausted = True
+            else:
+                self._reg.counter("gather.tasks_served").inc()
             conn.send(task)
         elif kind == "params":
             have = int(msg["have"])
@@ -413,7 +471,14 @@ class Gather:
             else:
                 conn.send(None)
         elif kind == "result":
-            self.results.append(msg["v"])
+            result = msg["v"]
+            # relay point for worker telemetry: keep the newest compact
+            # snapshot per worker, strip it from the dedup-keyed upload
+            telem = result.pop("_telem", None) if isinstance(result, dict) else None
+            if telem is not None:
+                self._worker_telem[result.get("worker_id", -1)] = telem
+            self._reg.counter("gather.results").inc()
+            self.results.append(result)
             if len(self.results) >= self.config.upload_batch:
                 self._flush_results()
         elif kind == "worker_error":
@@ -428,10 +493,13 @@ class Gather:
             batch, self.results = self.results, []
             self._upload_seq += 1
             self._unacked[self._upload_seq] = batch
-            self._server_send(
-                {"kind": "result_batch", "v": batch, "seq": self._upload_seq},
-                compress=self.config.compress_uplink,
-            )
+            self._reg.counter("gather.uploads").inc()
+            msg = {"kind": "result_batch", "v": batch, "seq": self._upload_seq}
+            if self.config.telemetry_piggyback:
+                # the upload frame is the other piggyback carrier: a busy
+                # gather reports fresher than the heartbeat cadence for free
+                msg["telem"] = self._telemetry_payload()
+            self._server_send(msg, compress=self.config.compress_uplink)
 
     def _resend_unacked(self) -> None:
         """Replay every retained (un-acked) upload on the current link —
@@ -487,17 +555,36 @@ class WorkerServer:
         # silently-dead one (socket open, peer gone) here within
         # ~2 heartbeat intervals — closed sockets were already detected,
         # silent ones previously hung the fleet forever
+        # fleet telemetry merge point: gathers piggyback compact snapshots
+        # on pongs and uploads; the hub's recv pump hands every "telem"
+        # payload here, and the aggregator's tree rides the process-wide
+        # registry snapshot under fleet.*
+        self.telemetry = TelemetryAggregator()
         self.hub = QueueHub(
             heartbeat_interval=config.heartbeat_interval_s,
             heartbeat_timeout=config.heartbeat_timeout
             if config.heartbeat_interval_s > 0
             else 0.0,
             on_dead=self._on_dead_connection,
+            on_telemetry=lambda _conn, payload: self.telemetry.absorb_payload(payload),
         )
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
         self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()
         self.total_results = 0
         self.dropped_results = 0
+        reg = telemetry.get_registry()
+        reg.bind("fleet", self.telemetry.tree)
+        reg.bind(
+            "server",
+            lambda: {
+                "total_results": self.total_results,
+                "duplicate_results": self.duplicate_results,
+                "dropped_results": self.dropped_results,
+                "results_queued": self.results.qsize(),
+                "worker_errors": self.worker_errors.qsize(),
+                "param_version": self.params.version,
+            },
+        )
         # at-least-once dedup: per worker, the (upload_epoch, newest
         # episode_seq) accepted; a reconnect-resent duplicate has the same
         # epoch and a seq we already consumed
@@ -545,6 +632,12 @@ class WorkerServer:
     # -- trainer API ---------------------------------------------------
     def publish(self, weights: Any) -> int:
         return self.params.push(weights)
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """ONE merged tree: this process's registry (server/hub/codec/ring/
+        queue/supervisor instruments) plus the fleet aggregator's per-worker
+        and aggregate series under ``fleet.*``."""
+        return telemetry.snapshot()
 
     def get_result(self, timeout: Optional[float] = None) -> Optional[Dict[str, Any]]:
         try:
@@ -602,6 +695,9 @@ class WorkerServer:
                             # bounds match on both ends of every link
                             "heartbeat_interval_s": self.config.heartbeat_interval_s,
                             "heartbeat_timeout_s": self.config.heartbeat_timeout_s,
+                            # like the heartbeat policy, the telemetry
+                            # piggyback is the learner's call
+                            "telemetry_piggyback": self.config.telemetry_piggyback,
                             "extra": self.config.extra,
                         },
                     }
@@ -654,11 +750,14 @@ class WorkerServer:
                 # ack FIRST: at-least-once means the gather retains the
                 # batch until this lands; dedup below absorbs redelivery
                 self.hub.send(conn, {"kind": "result_ack", "seq": msg["seq"]})
+            reg = telemetry.get_registry()
             for r in msg["v"]:
                 if self._is_duplicate(r):
                     self.duplicate_results += 1
+                    reg.counter("server.duplicate_results").inc()
                     continue
                 self.total_results += 1
+                reg.meter("server.results_per_s").mark()
                 try:
                     self.results.put_nowait(r)
                 except queue.Full:
@@ -680,6 +779,11 @@ class WorkerServer:
                 err.get("worker_id"),
                 err.get("task"),
                 err.get("traceback", err.get("error")),
+            )
+            telemetry.record_event(
+                "worker_error",
+                worker_id=err.get("worker_id"),
+                error=err.get("error"),
             )
             self.worker_errors.put(err)
         else:
@@ -879,6 +983,11 @@ class RemoteCluster:
             heartbeat_timeout_s=float(
                 remote_cfg.get(
                     "heartbeat_timeout_s", self.config.heartbeat_timeout_s
+                )
+            ),
+            telemetry_piggyback=bool(
+                remote_cfg.get(
+                    "telemetry_piggyback", self.config.telemetry_piggyback
                 )
             ),
             extra={**self.config.extra, **remote_cfg.get("extra", {})},

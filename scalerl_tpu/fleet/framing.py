@@ -46,9 +46,32 @@ import json
 import socket
 import struct
 import zlib
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
+
+from scalerl_tpu.runtime import telemetry
+
+# cached codec instruments: one registry-identity check + one lock'd float
+# add per frame (frames are whole rollout batches — negligible).  Keyed on
+# the registry OBJECT so a telemetry.reset() (tests) re-resolves instead of
+# feeding counters into an orphaned registry.
+_COUNTERS: Optional[Tuple[Any, ...]] = None
+_COUNTERS_REG: Optional[Any] = None
+
+
+def _codec_counters():
+    global _COUNTERS, _COUNTERS_REG
+    reg = telemetry.get_registry()
+    if _COUNTERS is None or _COUNTERS_REG is not reg:
+        _COUNTERS_REG = reg
+        _COUNTERS = (
+            reg.counter("codec.frames_packed"),
+            reg.counter("codec.frames_unpacked"),
+            reg.counter("codec.v1_frames"),
+            reg.counter("codec.bytes_packed"),
+        )
+    return _COUNTERS
 
 
 class ProtocolError(ConnectionError):
@@ -169,7 +192,11 @@ def pack_message(obj: Any, compress: bool = False) -> bytes:
     flags, header, body = _encode(obj, compress)
     prefix = _BASE.pack(MAGIC, flags, len(header), len(body))
     crc = zlib.crc32(body, zlib.crc32(header, zlib.crc32(prefix)))
-    return prefix + _CRC.pack(crc) + header + body
+    frame = prefix + _CRC.pack(crc) + header + body
+    packed, _unpacked, _v1, nbytes = _codec_counters()
+    packed.inc()
+    nbytes.inc(len(frame))
+    return frame
 
 
 def pack_message_v1(obj: Any, compress: bool = False) -> bytes:
@@ -224,6 +251,7 @@ def unpack_message(frame: bytes) -> Any:
                 f"frame checksum mismatch (stored {crc:#010x}, "
                 f"computed {actual:#010x})"
             )
+        _codec_counters()[1].inc()
         return _decode_frame(flags, hlen, blen, frame, _HEADER.size)
     if magic == MAGIC_V1:
         # rolling upgrade: decode pre-checksum senders for one window.  No
@@ -233,6 +261,9 @@ def unpack_message(frame: bytes) -> Any:
                 f"frame of {len(frame)} bytes shorter than the v1 header"
             )
         _magic, flags, hlen, blen = _HEADER_V1.unpack_from(frame, 0)
+        _counters = _codec_counters()
+        _counters[1].inc()
+        _counters[2].inc()  # legacy senders still on the wire, worth seeing
         return _decode_frame(flags, hlen, blen, frame, _HEADER_V1.size)
     raise ProtocolError(f"bad frame magic {magic!r}")
 

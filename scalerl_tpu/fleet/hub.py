@@ -31,6 +31,7 @@ from scalerl_tpu.fleet.transport import (
     open_worker_pipes,
     wait_readable,
 )
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.supervisor import (
     LivenessTracker,
     is_heartbeat,
@@ -61,6 +62,7 @@ class QueueHub:
         heartbeat_timeout: float = 0.0,
         first_contact_grace: float = 120.0,
         on_dead: Optional[Callable[[Connection, str], None]] = None,
+        on_telemetry: Optional[Callable[[Connection, Any], None]] = None,
     ) -> None:
         self.input_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
         self.output_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
@@ -68,7 +70,23 @@ class QueueHub:
         self.heartbeat_timeout = heartbeat_timeout or 2.0 * heartbeat_interval
         self.first_contact_grace = max(first_contact_grace, self.heartbeat_timeout)
         self.on_dead = on_dead
+        # piggybacked telemetry: any inbound dict carrying a "telem" key —
+        # heartbeat pongs and result-upload frames — has the payload handed
+        # to this callback in the recv pump (one merge point, no new
+        # message kinds or round-trips)
+        self.on_telemetry = on_telemetry
         self.protocol_errors = 0  # corrupt frames rejected by the recv pump
+        self.peers_dropped = 0  # liveness verdicts (silent peers dropped)
+        telemetry.get_registry().bind(
+            "hub",
+            lambda: {
+                "protocol_errors": self.protocol_errors,
+                "peers_dropped": self.peers_dropped,
+                "connections": self.connection_count(),
+                "input_depth": self.input_queue.qsize(),
+                "output_depth": self.output_queue.qsize(),
+            },
+        )
         self._liveness = LivenessTracker()
         self._greeted: Set[Connection] = set()
         self._conns: Set[Connection] = set()
@@ -139,6 +157,8 @@ class QueueHub:
                     # drop the link — a socket gather reconnects through the
                     # accept loop (the PR 2 backoff path) and resends
                     self.protocol_errors += 1
+                    telemetry.get_registry().counter("hub.protocol_errors").inc()
+                    telemetry.record_event("protocol_error", error=str(e))
                     logger.warning("hub: corrupt frame rejected (%s)", e)
                     self.disconnect(conn)
                     continue
@@ -148,6 +168,16 @@ class QueueHub:
                 self._liveness.beat(conn)
                 with self._lock:
                     self._greeted.add(conn)
+                if (
+                    self.on_telemetry is not None
+                    and isinstance(msg, dict)
+                    and "telem" in msg
+                ):
+                    # piggybacked fleet telemetry (pong or result upload)
+                    try:
+                        self.on_telemetry(conn, msg.get("telem"))
+                    except Exception:  # noqa: BLE001 — telemetry must not kill the pump
+                        logger.exception("hub: on_telemetry callback failed")
                 if is_heartbeat(msg):
                     # swallowed here: pings answered in-pump, pongs are pure
                     # liveness — consumers never see a heartbeat kind
@@ -188,6 +218,8 @@ class QueueHub:
                         f"{self.first_contact_grace:.1f}s of connecting"
                     )
                     logger.warning("hub: dropping silent connection (%s)", reason)
+                    self.peers_dropped += 1
+                    telemetry.record_event("peer_dead", reason=reason)
                     self.disconnect(conn)
                     if self.on_dead is not None:
                         try:

@@ -213,6 +213,7 @@ def connect_socket(
     fixed — the reconnect-after-server-loss schedule, where hammering a
     recovering learner at a fixed high rate helps nobody.
     """
+    from scalerl_tpu.runtime import telemetry
     from scalerl_tpu.runtime.supervisor import exp_backoff
 
     last: Optional[Exception] = None
@@ -220,6 +221,15 @@ def connect_socket(
         try:
             sock = socket.create_connection((host, port), timeout=10.0)
             sock.settimeout(None)
+            if attempt:
+                # bring-up visibility: how many dials a connection cost is
+                # the earliest signal of a flapping learner/NAT
+                telemetry.get_registry().counter("transport.connect_retries").inc(
+                    attempt
+                )
+                telemetry.record_event(
+                    "connect_retried", host=host, port=port, attempts=attempt + 1
+                )
             return SocketConnection(sock)
         except OSError as e:  # server not up yet
             last = e
@@ -228,6 +238,9 @@ def connect_socket(
                 if backoff_cap is not None
                 else delay
             )
+    telemetry.record_event(
+        "connect_failed", host=host, port=port, attempts=retries
+    )
     raise ConnectionError(f"could not connect to {host}:{port}") from last
 
 

@@ -29,6 +29,12 @@ if TYPE_CHECKING:  # static analyzers see the real symbols
         StallError,
         StallWatchdog,
     )
+    from scalerl_tpu.runtime.telemetry import (  # noqa: F401
+        FlightRecorder,
+        MetricsRegistry,
+        TelemetryAggregator,
+        TelemetryExportLoop,
+    )
 
 _EXPORTS = {
     "DeviceActorLearnerLoop": "scalerl_tpu.runtime.device_loop",
@@ -44,6 +50,10 @@ _EXPORTS = {
     "PreemptionGuard": "scalerl_tpu.runtime.supervisor",
     "StallError": "scalerl_tpu.runtime.supervisor",
     "StallWatchdog": "scalerl_tpu.runtime.supervisor",
+    "FlightRecorder": "scalerl_tpu.runtime.telemetry",
+    "MetricsRegistry": "scalerl_tpu.runtime.telemetry",
+    "TelemetryAggregator": "scalerl_tpu.runtime.telemetry",
+    "TelemetryExportLoop": "scalerl_tpu.runtime.telemetry",
 }
 
 __all__ = list(_EXPORTS)

@@ -198,7 +198,14 @@ class FaultInjector:
                 return False
             if hit:
                 self.fired[kind] += 1
-            return hit
+        if hit:
+            # telemetry outside the injector lock: counter + flight event so
+            # a post-mortem can line injected faults up against detections
+            from scalerl_tpu.runtime import telemetry
+
+            telemetry.get_registry().counter(f"chaos.{kind}").inc()
+            telemetry.record_event("chaos_injection", fault=kind, site=site)
+        return hit
 
     def _draw_int(self, kind: str, site: str, n: int) -> int:
         with self._lock:

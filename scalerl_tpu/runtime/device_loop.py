@@ -29,8 +29,9 @@ import jax.numpy as jnp
 from scalerl_tpu.agents.impala import ImpalaTrainState
 from scalerl_tpu.data.trajectory import Trajectory
 from scalerl_tpu.envs.jax_envs.base import JaxVecEnv
-from scalerl_tpu.runtime import dispatch
+from scalerl_tpu.runtime import dispatch, telemetry
 from scalerl_tpu.runtime.dispatch import MetricsPipeline, get_metrics
+from scalerl_tpu.utils.profiling import step_marker
 
 
 class ActorCarry(NamedTuple):
@@ -332,10 +333,18 @@ class DeviceActorLearnerLoop:
         hit = False
         nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
+        reg = telemetry.get_registry()
+        _chunk_meter = reg.meter("rates.chunks_per_s")
+        _fps_meter = reg.meter("rates.fps")
 
         def consume(ready) -> None:
             nonlocal windowed, prev_sum, prev_cnt, hit, nonfinite_chunks
             for i, m in ready:
+                # host-side registry feed (m is already host floats via the
+                # pipeline's one batched transfer — no extra device traffic)
+                telemetry.observe_train_metrics(m)
+                _chunk_meter.mark()
+                _fps_meter.mark(frames_per_call)
                 if m.get("skipped_steps", 0.0) > 0.0:
                     # guarded learn skipped >= 1 non-finite update this chunk
                     nonfinite_chunks += 1
@@ -357,8 +366,11 @@ class DeviceActorLearnerLoop:
             # batched device_get; a stray implicit sync raises at its line.
             # Chunk 0 is exempt — tracing/compilation may place constants.
             with dispatch.steady_state_guard() if i > 0 else nullcontext():
-                key, sub = jax.random.split(key)
-                state, carry, m = self.train_chunk(state, carry, sub)
+                # step_marker: per-chunk device-trace alignment (a cheap
+                # profiler annotation — a no-op unless a trace is active)
+                with step_marker(i):
+                    key, sub = jax.random.split(key)
+                    state, carry, m = self.train_chunk(state, carry, sub)
                 frames += frames_per_call
                 if progress is not None:
                     progress.bump()
@@ -404,11 +416,18 @@ class DeviceActorLearnerLoop:
         metrics: Dict[str, float] = {}
         nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
+        frames_per_call = self.unroll_length * self.venv.num_envs * self.iters_per_call
+        reg = telemetry.get_registry()
+        _chunk_meter = reg.meter("rates.chunks_per_s")
+        _fps_meter = reg.meter("rates.fps")
 
         def consume(ready) -> None:
             nonlocal metrics, nonfinite_chunks
             for i, host_m in ready:
                 m = dict(host_m)
+                telemetry.observe_train_metrics(m)
+                _chunk_meter.mark()
+                _fps_meter.mark(frames_per_call)
                 if m.get("skipped_steps", 0.0) > 0.0:
                     nonfinite_chunks += 1
                 m["episodes"] = m.pop("episode_count_sum")
@@ -426,8 +445,10 @@ class DeviceActorLearnerLoop:
             # steady-state transfer guard (see run_until): implicit host
             # syncs raise; get_metrics' one explicit batched get passes
             with dispatch.steady_state_guard() if i > 0 else nullcontext():
-                key, sub = jax.random.split(key)
-                state, carry, dev_metrics = self.train_chunk(state, carry, sub)
+                # per-chunk trace step (no-op without an active trace)
+                with step_marker(i):
+                    key, sub = jax.random.split(key)
+                    state, carry, dev_metrics = self.train_chunk(state, carry, sub)
                 chunks_done += 1
                 if progress is not None:
                     progress.bump()

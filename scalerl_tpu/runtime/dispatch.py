@@ -135,6 +135,11 @@ class MetricsPipeline:
     def _materialize(self, item: Tuple[Any, Any]) -> Tuple[Any, Any]:
         tag, payload = item
         self.transfers += 1
+        # registry mirror: host-side int bump only (the transfer itself is
+        # the one sanctioned batched get inside get_metrics)
+        from scalerl_tpu.runtime import telemetry
+
+        telemetry.get_registry().counter("dispatch.batched_transfers").inc()
         return tag, get_metrics(payload)
 
     def push(self, tag: Any, payload: Any) -> List[Tuple[Any, Any]]:

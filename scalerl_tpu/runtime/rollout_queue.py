@@ -19,11 +19,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from scalerl_tpu.data.trajectory import TrajectorySpec
+from scalerl_tpu.runtime import telemetry
 
 
 class RolloutQueue:
@@ -42,6 +44,12 @@ class RolloutQueue:
         self._error: Optional[BaseException] = None
         self._error_lock = threading.Lock()
         self._closed = threading.Event()
+        # telemetry plane: queue occupancy in the merged snapshot (weakref
+        # snapshot-time binding — nothing on the acquire/commit hot path)
+        q_ref = weakref.ref(self)
+        telemetry.get_registry().bind(
+            "queue", lambda: (lambda q: q.stats() if q is not None else {"gone": 1})(q_ref())
+        )
 
     # -- actor side ----------------------------------------------------
     def acquire(self, timeout: Optional[float] = None) -> Optional[int]:
@@ -58,6 +66,8 @@ class RolloutQueue:
         self.full.put(idx)
 
     def report_error(self, exc: BaseException) -> None:
+        telemetry.get_registry().counter("queue.actor_errors").inc()
+        telemetry.record_event("actor_error", error=repr(exc))
         with self._error_lock:
             if self._error is None:
                 self._error = exc

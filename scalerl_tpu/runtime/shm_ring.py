@@ -26,6 +26,7 @@ from typing import Dict, List, Mapping, Optional, Tuple
 import numpy as np
 
 from scalerl_tpu.native import load_ring_lib
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.chaos import active as chaos_active
 from scalerl_tpu.utils.logging import get_logger
 
@@ -99,6 +100,19 @@ class ShmRolloutRing:
         total = self._ctrl_bytes + num_slots * self._slot_stride
         self.shm = shared_memory.SharedMemory(create=True, size=total)
         self._owner = True
+        # telemetry plane: occupancy + torn_reads ride the merged snapshot
+        # (snapshot-time binding — zero hot-path cost; a later ring simply
+        # shadows an earlier one in the same process; weakref so the
+        # registry never pins a torn-down ring's shm mapping alive)
+        import weakref
+
+        ring_ref = weakref.ref(self)
+
+        def _ring_stats() -> Dict[str, int]:
+            ring = ring_ref()
+            return ring.stats() if ring is not None else {"gone": 1}
+
+        telemetry.get_registry().bind("ring", _ring_stats)
         self._base_obj = None  # cached ctypes buffer export (see _base_ptr)
         self._base_addr: Optional[int] = None
         if self.native:
@@ -287,6 +301,11 @@ class ShmRolloutRing:
             if ok:
                 return idx
             self.torn_reads += 1
+            telemetry.get_registry().counter("ring.torn_reads").inc()
+            telemetry.record_event(
+                "torn_read", slot=idx, seq=self.slot_seq(idx),
+                total=self.torn_reads,
+            )
             logger.warning(
                 "shm ring: torn/corrupt slot %d detected (seq %d); "
                 "released without consuming (%d total)",

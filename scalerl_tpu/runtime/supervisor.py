@@ -38,6 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
 
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -170,6 +171,7 @@ class StallWatchdog:
         self.name = name
         self.stalled: Optional[StallError] = None
         self.fire_count = 0
+        self.flight_dump_path: Optional[str] = None  # set on first fire
         self._counters: List[ProgressCounter] = []
         self._watches: List[Tuple[str, Callable[[], Any]]] = []
         self._probes: List[Tuple[str, Callable[[], Any]]] = []
@@ -199,6 +201,16 @@ class StallWatchdog:
     def start(self) -> "StallWatchdog":
         if self._thread is not None:
             return self
+        # telemetry plane: the watchdog's verdict state is part of the
+        # merged snapshot (supervisor.<name>.fire_count/stalled)
+        telemetry.get_registry().bind(
+            f"supervisor.{self.name}",
+            lambda: {
+                "fire_count": self.fire_count,
+                "stalled": int(self.stalled is not None),
+                "deadline_s": self.deadline_s,
+            },
+        )
         self._thread = threading.Thread(
             target=self._monitor, name=f"stall-{self.name}", daemon=True
         )
@@ -253,7 +265,14 @@ class StallWatchdog:
 
     def _fire(self, snap: Dict[str, Any], stalled_for: float) -> None:
         self.fire_count += 1
+        telemetry.record_event(
+            "watchdog_stall", watchdog=self.name, stalled_for_s=round(stalled_for, 1)
+        )
         report = self._build_report(snap, stalled_for)
+        # the flight recorder tail also lands as JSON next to the stack dump
+        self.flight_dump_path = telemetry.get_recorder().dump_json(
+            telemetry.flight_dump_path(f"stall_{self.name}")
+        )
         logger.error("%s", report)
         err = StallError(report)
         self.stalled = err
@@ -277,9 +296,15 @@ class StallWatchdog:
         ]
         for name, fn in probes:
             try:
-                lines.append(f"probe {name}: {fn()}")
+                value = fn()
+                lines.append(f"probe {name}: {value}")
+                telemetry.record_event(
+                    "watchdog_probe", watchdog=self.name, probe=name, value=str(value)
+                )
             except Exception as e:  # noqa: BLE001 — report what we can
                 lines.append(f"probe {name}: <error: {e!r}>")
+        lines.append("--- flight recorder (recent events) ---")
+        lines.append(telemetry.get_recorder().dump_text())
         lines.append("--- all-thread stacks (faulthandler) ---")
         lines.append(self._dump_stacks())
         return "\n".join(lines)
@@ -327,6 +352,7 @@ class PreemptionGuard:
         self._prev: Dict[int, Any] = {}
         self._installed = False
         self.received: Optional[int] = None
+        self.flight_dump_path: Optional[str] = None  # set on first signal
 
     @property
     def triggered(self) -> bool:
@@ -349,10 +375,18 @@ class PreemptionGuard:
             name = signal.Signals(signum).name
         except ValueError:
             name = str(signum)
+        # flight recorder: the preemption is itself an event, and the tail
+        # of everything that led up to it lands as JSON immediately — the
+        # "save at next safe point" may never run if the loop is wedged
+        telemetry.record_event("preemption_signal", signal=name)
+        self.flight_dump_path = telemetry.get_recorder().dump_json(
+            telemetry.flight_dump_path(f"signal_{name.lower()}")
+        )
         # signal-safe enough: one write, no allocation-heavy formatting
         sys.stderr.write(
             f"[scalerl] caught {name}: checkpointing at next safe point "
-            "(repeat to force-quit)\n"
+            "(repeat to force-quit; flight recorder -> "
+            f"{self.flight_dump_path})\n"
         )
 
     def install(self) -> "PreemptionGuard":
@@ -424,6 +458,13 @@ class DivergenceTripwire:
         if self.enabled and self.consecutive >= self.k:
             self.consecutive = 0
             self.trips += 1
+            telemetry.get_registry().counter("supervisor.divergence_trips").inc()
+            telemetry.record_event("divergence_trip", trips=self.trips, k=self.k)
+            # flight tail alongside the rollback (the events leading into a
+            # divergence are exactly what a post-mortem wants)
+            telemetry.get_recorder().dump_json(
+                telemetry.flight_dump_path("divergence")
+            )
             self.on_trip()
             return True
         return False

@@ -35,6 +35,7 @@ import numpy as np
 from scalerl_tpu.agents.impala import ImpalaAgent
 from scalerl_tpu.config import ImpalaArguments
 from scalerl_tpu.data.trajectory import TrajectorySpec, batch_to_trajectory
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
@@ -45,6 +46,7 @@ from scalerl_tpu.runtime.supervisor import (
 )
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
+from scalerl_tpu.utils.profiling import maybe_trace
 from scalerl_tpu.utils.timers import Timings
 
 
@@ -507,8 +509,19 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                     # one batched device->host transfer for the whole dict
                     # (per-key float() would pay a round trip per metric)
                     host_metrics = get_metrics(metrics)
-                    info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
-                    self.logger.log_train_data(info, self.env_frames)
+                    telemetry.observe_train_metrics(host_metrics)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(
+                        {**host_metrics, "sps": sps, "return_mean": ret_mean},
+                        prefix="train.",
+                    )
+                    # registry-backed write: queue occupancy and guard
+                    # counters ride alongside the learner metrics
+                    self.logger.log_registry(
+                        self.env_frames,
+                        step_type="train",
+                        include_prefixes=("train.", "queue."),
+                    )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "
@@ -624,7 +637,14 @@ class DeviceActorLearnerTrainer(BaseTrainer):
             # timeline continues instead of rewinding over the old events
             frames = done_frames + (i + 1) * frames_per_call
             sps = (frames - done_frames) / max(time.time() - start, 1e-8)
-            self.logger.log_train_data({**m, "sps": sps}, frames)
+            # registry-backed write path: m is already host floats (the
+            # driver's one batched transfer per chunk); the driver also
+            # feeds train.fps/train.chunks_per_s meters
+            reg = telemetry.get_registry()
+            reg.set_gauges({**m, "sps": sps}, prefix="train.")
+            self.logger.log_registry(
+                frames, step_type="train", include_prefixes=("train.",)
+            )
             if self.is_main_process and (i % 10 == 0 or i == num_calls - 1):
                 self.text_logger.info(
                     f"frames {frames} | sps {sps:.0f} | return {m.get('return_mean', float('nan')):.2f}"
@@ -643,12 +663,15 @@ class DeviceActorLearnerTrainer(BaseTrainer):
             progress = watchdog.counter("fused_chunks")
             watchdog.start()
         try:
-            state, carry, metrics = self.loop.run(
-                self.agent.state, carry, key, num_calls, on_metrics=on_metrics,
-                chunks_in_flight=self.chunks_in_flight,
-                progress=progress,
-                should_stop=(lambda: guard.triggered) if guard is not None else None,
-            )
+            # --profile-dir: device+host trace around the fused run; the
+            # driver's per-chunk step_marker aligns chunks in the viewer
+            with maybe_trace(getattr(args, "profile_dir", "") or None):
+                state, carry, metrics = self.loop.run(
+                    self.agent.state, carry, key, num_calls, on_metrics=on_metrics,
+                    chunks_in_flight=self.chunks_in_flight,
+                    progress=progress,
+                    should_stop=(lambda: guard.triggered) if guard is not None else None,
+                )
         finally:
             if watchdog is not None:
                 watchdog.stop()

@@ -40,6 +40,8 @@ import numpy as np
 from scalerl_tpu.agents.dqn import DQNAgent, make_dqn_learn_fn, make_dqn_priority_fn
 from scalerl_tpu.config import ApexArguments
 from scalerl_tpu.data.prioritized import PrioritizedReplayBuffer
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.supervisor import (
     CheckpointCadence,
@@ -489,15 +491,27 @@ class ApexTrainer(BaseTrainer):
                     last_log = self.global_step
                     fps = int(self.global_step / max(time.time() - start, 1e-8))
                     summary = self.metrics.summary()
-                    info = {
-                        **train_info,
-                        "rpm_size": len(self.buffer),
-                        "fps": fps,
-                        "learn_steps": self.learn_steps,
-                        "weight_version": self.param_server.version,
-                        **summary,
-                    }
-                    self.logger.log_train_data(info, self.global_step)
+                    # registry-backed write: one batched transfer for any
+                    # device scalars, then instruments are the source
+                    train_info = get_metrics(train_info)
+                    telemetry.observe_train_metrics(train_info)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(train_info, prefix="train.")
+                    reg.set_gauges(summary, prefix="train.")
+                    reg.set_gauges(
+                        {
+                            "rpm_size": float(len(self.buffer)),
+                            "fps": float(fps),
+                            "learn_steps": float(self.learn_steps),
+                            "weight_version": float(self.param_server.version),
+                        },
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.global_step,
+                        step_type="train",
+                        include_prefixes=("train.",),
+                    )
                     if self.is_main_process:
                         ret = summary.get("return_mean", float("nan"))
                         self.text_logger.info(

@@ -61,6 +61,27 @@ class BaseTrainer:
         else:
             self.logger = make_logger("none", self.tb_log_dir)
 
+        # telemetry plane: periodic JSONL + Prometheus exposition off the
+        # process registry (runtime/telemetry.py); the same registry the
+        # interval-gated logger backends read via log_registry
+        self.telemetry_export = None
+        interval_s = float(getattr(args, "telemetry_interval_s", 0.0) or 0.0)
+        if self.is_main_process and interval_s > 0:
+            from scalerl_tpu.runtime.telemetry import (
+                TelemetryExportLoop,
+                get_registry,
+            )
+
+            out_dir = getattr(args, "telemetry_dir", "") or os.path.join(
+                root, "telemetry"
+            )
+            self.telemetry_export = TelemetryExportLoop(
+                out_dir, interval_s=interval_s
+            ).start()
+            get_registry().set_gauges(
+                {"seed": float(args.seed)}, prefix="run."
+            )
+
     # -- resume checkpointing ------------------------------------------
     @property
     def resume_ckpt_path(self) -> str:
@@ -111,4 +132,7 @@ class BaseTrainer:
         return state
 
     def close(self) -> None:
+        if self.telemetry_export is not None:
+            self.telemetry_export.stop()  # final flush: files hold end state
+            self.telemetry_export = None
         self.logger.close()

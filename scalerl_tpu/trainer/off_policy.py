@@ -24,7 +24,7 @@ import numpy as np
 from scalerl_tpu.agents.dqn import DQNAgent
 from scalerl_tpu.config import DQNArguments
 from scalerl_tpu.data.sampler import Sampler
-from scalerl_tpu.runtime import chaos
+from scalerl_tpu.runtime import chaos, telemetry
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.supervisor import DivergenceTripwire
 from scalerl_tpu.trainer.base import BaseTrainer
@@ -71,6 +71,13 @@ class OffPolicyTrainer(BaseTrainer):
         self.global_step = 0
         self.learn_steps = 0
         self.metrics = EpisodeMetrics(self.num_envs)
+        # telemetry plane: rate meters + snapshot-time replay binding; the
+        # logger's registry-backed write path reads these instead of a
+        # hand-assembled metric dict
+        reg = telemetry.get_registry()
+        self._fps_meter = reg.meter("rates.fps")
+        self._learn_meter = reg.meter("rates.learn_steps_per_s")
+        reg.bind("replay.size", lambda: len(self.sampler))
         # divergence tripwire: K consecutive guarded-away (non-finite) learn
         # steps restore the agent from the last good resume checkpoint
         self.tripwire = DivergenceTripwire(
@@ -114,6 +121,7 @@ class OffPolicyTrainer(BaseTrainer):
             self.sampler.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
         info.pop("td_abs", None)
         self.learn_steps += 1
+        self._learn_meter.mark()
         self.tripwire.observe(info)
         return info
 
@@ -258,6 +266,7 @@ class OffPolicyTrainer(BaseTrainer):
                 train_info = self.train_step()
 
             if self.global_step - last_log >= args.logger_frequency:
+                frames_delta = self.global_step - last_log
                 last_log = self.global_step
                 fps = int(
                     (self.global_step - start_step) / max(time.time() - start, 1e-8)
@@ -265,20 +274,35 @@ class OffPolicyTrainer(BaseTrainer):
                 summary = self.metrics.summary()
                 # one batched device->host transfer for the metric dict —
                 # any device scalars still un-materialized ride together
-                info = {
-                    **get_metrics(train_info),
-                    "rpm_size": len(self.sampler),
-                    "fps": fps,
-                    "learn_steps": self.learn_steps,
-                    **summary,
-                }
-                self.logger.log_train_data(info, self.global_step)
+                host_info = get_metrics(train_info)
+                train_info = host_info
+                telemetry.observe_train_metrics(host_info)
+                # registry-backed write path: instruments are the single
+                # source the logger backends read from (no hand-assembled
+                # metric dict; queue/ring/guard counters ride for free)
+                reg = telemetry.get_registry()
+                reg.set_gauges(host_info, prefix="train.")
+                reg.set_gauges(summary, prefix="train.")
+                reg.set_gauges(
+                    {
+                        "rpm_size": float(len(self.sampler)),
+                        "fps": float(fps),
+                        "learn_steps": float(self.learn_steps),
+                    },
+                    prefix="train.",
+                )
+                self._fps_meter.mark(frames_delta)
+                self.logger.log_registry(
+                    self.global_step,
+                    step_type="train",
+                    include_prefixes=("train.",),
+                )
                 if self.is_main_process:
                     ret = summary.get("return_mean", float("nan"))
                     self.text_logger.info(
                         f"step {self.global_step} | fps {fps} | return {ret:.1f} "
                         f"| eps {getattr(self.agent, 'eps', float('nan')):.3f} "
-                        f"| loss {train_info.get('loss', float('nan')):.4f}"
+                        f"| loss {host_info.get('loss', float('nan')):.4f}"
                     )
 
             if self.eval_envs is not None and self.global_step - last_eval >= args.eval_frequency:

@@ -23,6 +23,8 @@ import numpy as np
 from scalerl_tpu.agents.a3c import A3CAgent
 from scalerl_tpu.config import A3CArguments
 from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.trainer.base import BaseTrainer
 from scalerl_tpu.utils.metrics import EpisodeMetrics
 
@@ -181,8 +183,19 @@ class OnPolicyTrainer(BaseTrainer):
                     (self.global_step - start_step) / max(time.time() - start, 1e-8)
                 )
                 summary = self.metrics.summary()
-                info = {**train_info, "fps": fps, "learn_steps": self.learn_steps, **summary}
-                self.logger.log_train_data(info, self.global_step)
+                # one batched transfer, then the registry-backed write path
+                train_info = get_metrics(train_info)
+                telemetry.observe_train_metrics(train_info)
+                reg = telemetry.get_registry()
+                reg.set_gauges(train_info, prefix="train.")
+                reg.set_gauges(summary, prefix="train.")
+                reg.set_gauges(
+                    {"fps": float(fps), "learn_steps": float(self.learn_steps)},
+                    prefix="train.",
+                )
+                self.logger.log_registry(
+                    self.global_step, step_type="train", include_prefixes=("train.",)
+                )
                 if self.is_main_process:
                     ret = summary.get("return_mean", float("nan"))
                     self.text_logger.info(

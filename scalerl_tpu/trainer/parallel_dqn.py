@@ -38,6 +38,7 @@ from scalerl_tpu.fleet.transport import (
     wait_readable,
 )
 from scalerl_tpu.models.np_forward import mlp_qnet_forward
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
 from scalerl_tpu.trainer.base import BaseTrainer
@@ -330,8 +331,23 @@ class ParallelDQNTrainer(BaseTrainer):
                     last_log = self.env_steps
                     sps = self.env_steps / max(time.time() - start, 1e-8)
                     ret = float(np.mean(self.returns[-20:])) if self.returns else float("nan")
-                    self.logger.log_train_data(
-                        {**info, "sps": sps, "return_mean": ret}, self.env_steps
+                    # registry-backed write: ring occupancy and torn_reads
+                    # (bound by ShmRolloutRing) ride alongside.  get_metrics
+                    # imports lazily: this module must stay jax-free for the
+                    # spawned np_forward actor children
+                    from scalerl_tpu.runtime.dispatch import get_metrics
+
+                    host_info = get_metrics(info)
+                    telemetry.observe_train_metrics(host_info)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(
+                        {**host_info, "sps": sps, "return_mean": ret},
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.env_steps,
+                        step_type="train",
+                        include_prefixes=("train.", "ring."),
                     )
                     if self.is_main_process:
                         self.text_logger.info(

@@ -37,6 +37,7 @@ import numpy as np
 
 from scalerl_tpu.config import ImpalaArguments
 from scalerl_tpu.fleet.transport import PipeConnection, send_recv, wait_readable
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
 from scalerl_tpu.runtime.supervisor import (
@@ -554,9 +555,24 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                         if self.returns
                         else float("nan")
                     )
-                    info = {**metrics, "sps": sps, "return_mean": ret,
-                            "weights_lag": self._lag}
-                    self.logger.log_train_data(info, self.env_frames)
+                    # registry-backed write: ring + guard counters ride
+                    # along.  Lazy import: actor children must pin their
+                    # platform BEFORE anything imports jax (dispatch does)
+                    from scalerl_tpu.runtime.dispatch import get_metrics
+
+                    host_info = get_metrics(metrics)
+                    telemetry.observe_train_metrics(host_info)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(
+                        {**host_info, "sps": sps, "return_mean": ret,
+                         "weights_lag": self._lag},
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.env_frames,
+                        step_type="train",
+                        include_prefixes=("train.", "ring."),
+                    )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "

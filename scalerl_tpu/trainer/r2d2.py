@@ -36,6 +36,7 @@ from scalerl_tpu.data.sequence_replay import (
     seq_update_priorities,
 )
 from scalerl_tpu.data.trajectory import TrajectorySpec
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.rollout_queue import RolloutQueue
@@ -285,8 +286,17 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
                     ret_mean = float(np.mean(rets)) if rets else float("nan")
                     # one batched device->host transfer for the whole dict
                     host_metrics = get_metrics(metrics)
-                    info = {**host_metrics, "sps": sps, "return_mean": ret_mean}
-                    self.logger.log_train_data(info, self.env_frames)
+                    telemetry.observe_train_metrics(host_metrics)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(
+                        {**host_metrics, "sps": sps, "return_mean": ret_mean},
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.env_frames,
+                        step_type="train",
+                        include_prefixes=("train.", "queue."),
+                    )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "

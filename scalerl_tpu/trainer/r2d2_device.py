@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from scalerl_tpu.agents.r2d2 import R2D2Agent
 from scalerl_tpu.config import R2D2Arguments
-from scalerl_tpu.runtime import dispatch
+from scalerl_tpu.runtime import dispatch, telemetry
 from scalerl_tpu.runtime.dispatch import get_metrics
 from scalerl_tpu.data.sequence_replay import (
     seq_add,
@@ -494,9 +494,16 @@ class DeviceR2D2Trainer(BaseTrainer):
                     # random-policy prefix along forever)
                     windowed = (s - prev_sum) / (c - prev_cnt)
                     prev_sum, prev_cnt = s, c
-                self.logger.log_train_data(
+                # registry-backed write path off the same host dict (the
+                # guard counters fold into train.skipped_steps etc.)
+                telemetry.observe_train_metrics(host)
+                reg = telemetry.get_registry()
+                reg.set_gauges(
                     {**host, "return_windowed": windowed, "eps": eps},
-                    self.env_frames,
+                    prefix="train.",
+                )
+                self.logger.log_registry(
+                    self.env_frames, step_type="train", include_prefixes=("train.",)
                 )
                 if self.is_main_process:
                     self.text_logger.info(

@@ -164,6 +164,8 @@ def save_checkpoint(path: str, state: Any, keep_last: int = 1) -> str:
         # chaos: leave the freshly-landed checkpoint partial (a preemption
         # mid-flush) — restores must fall back through the .prev chain
         inj.corrupt_checkpoint(path)
+    _telemetry().record_event("checkpoint_save", path=path)
+    _telemetry().get_registry().counter("checkpoint.saves").inc()
     return path
 
 
@@ -182,11 +184,20 @@ def load_checkpoint(
     first_err: Optional[Exception] = None
     for cand in candidates:
         try:
-            return _restore(cand, target)
+            restored = _restore(cand, target)
+            _telemetry().record_event(
+                "checkpoint_restore", path=cand, fallback=cand != path
+            )
+            _telemetry().get_registry().counter("checkpoint.restores").inc()
+            return restored
         except Exception as e:  # noqa: BLE001 — try the retained predecessor
             if first_err is None:
                 first_err = e
             if fallback and cand != candidates[-1]:
+                _telemetry().record_event(
+                    "checkpoint_fallback", path=cand, error=repr(e)
+                )
+                _telemetry().get_registry().counter("checkpoint.fallbacks").inc()
                 logger.warning(
                     "checkpoint %s failed to restore (%r); falling back to %s",
                     cand, e, candidates[candidates.index(cand) + 1],
@@ -210,3 +221,11 @@ def _chaos_active():
     from scalerl_tpu.runtime import chaos
 
     return chaos.active()
+
+
+def _telemetry():
+    # lazy: keep jax-free importers of runtime.telemetry from paying for
+    # orbax, and this module from importing telemetry at module load
+    from scalerl_tpu.runtime import telemetry
+
+    return telemetry

@@ -8,12 +8,20 @@ Parity targets: ``BaseLogger``/``LazyLogger`` (``scalerl/utils/logger/base.py:
 
 from __future__ import annotations
 
+import itertools
 import os
 from abc import ABC, abstractmethod
 from numbers import Number
 from typing import Callable, Dict, Optional, Tuple
 
 WRITE_TYPE = Tuple[str, int, Dict[str, float]]
+
+# tensorboardX names event files events.out.tfevents.<second>.<hostname>:
+# two writers on one dir within the same second SILENTLY OVERWRITE each
+# other — exactly the resume path (restore_data constructs a fresh writer
+# over the old run dir).  A per-process sequence + pid suffix makes every
+# writer's file unique.
+_WRITER_SEQ = itertools.count()
 
 
 class BaseLogger(ABC):
@@ -50,6 +58,55 @@ class BaseLogger(ABC):
         if step - self.last_log_update_step >= self.update_interval:
             self.write("update/gradient_step", step, {f"update/{k}": v for k, v in data.items()})
             self.last_log_update_step = step
+
+    def log_registry(
+        self,
+        step: int,
+        step_type: str = "train",
+        registry=None,
+        include_prefixes: Optional[Tuple[str, ...]] = None,
+        extra: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """Registry-backed write path: flatten the telemetry registry's
+        scalars and route them through the existing interval gating.
+
+        Trainers populate the process registry (gauges/meters/counters) and
+        call this instead of hand-assembling a metric dict; every backend
+        (TensorBoard/W&B/none) then reads from the same plane.  Dots become
+        slashes so instruments group in TensorBoard (``train.fps`` →
+        ``train/fps``).  ``include_prefixes`` narrows the write to matching
+        instrument names; ``extra`` rides along (already-host floats only).
+        """
+        from scalerl_tpu.runtime.telemetry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        scalars = reg.scalars()
+        if include_prefixes is not None:
+            scalars = {
+                k: v
+                for k, v in scalars.items()
+                if k.startswith(include_prefixes)
+            }
+        # the gating methods prefix with their namespace; drop a redundant
+        # leading instrument namespace (train.fps → train/fps, not
+        # train/train/fps)
+        ns = step_type + "."
+        data = {
+            (k[len(ns):] if k.startswith(ns) else k).replace(".", "/"): v
+            for k, v in scalars.items()
+        }
+        if extra:
+            data.update(extra)
+        if step_type == "train":
+            self.log_train_data(data, step)
+        elif step_type == "test":
+            self.log_test_data(data, step)
+        elif step_type == "update":
+            self.log_update_data(data, step)
+        else:
+            raise ValueError(
+                f"unknown step_type {step_type!r}; expected train|test|update"
+            )
 
     def save_data(
         self,
@@ -96,7 +153,9 @@ class TensorboardLogger(BaseLogger):
 
         os.makedirs(log_dir, exist_ok=True)
         self.log_dir = log_dir
-        self.writer = SummaryWriter(log_dir)
+        self.writer = SummaryWriter(
+            log_dir, filename_suffix=f".{os.getpid()}.{next(_WRITER_SEQ)}"
+        )
 
     def write(self, step_type: str, step: int, data: Dict[str, float]) -> None:
         for k, v in data.items():

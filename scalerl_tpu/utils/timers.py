@@ -5,6 +5,12 @@ Parity targets: ``Timings`` online mean/variance event profiler
 ``Timer`` (``scalerl/utils/timer.py:12-118``).  For device-side tracing use
 ``jax.profiler.trace`` — these timers cover the host runtime (env stepping,
 queue waits, infeed) where ``jax.profiler`` has no visibility.
+
+All clocks are ``time.monotonic()``: these are interval timers, and a
+wall-clock jump (NTP step, suspend/resume, a container migration) under
+``time.time()`` would feed a negative or multi-hour "elapsed" sample
+straight into the Welford accumulators, permanently corrupting the
+mean/variance stats the stall reports and telemetry lean on.
 """
 
 from __future__ import annotations
@@ -27,34 +33,44 @@ class Timings:
     """
 
     def __init__(self) -> None:
-        self._means: Dict[str, float] = collections.defaultdict(float)
-        self._vars: Dict[str, float] = collections.defaultdict(float)
-        self._counts: Dict[str, int] = collections.defaultdict(int)
+        # plain dicts: reads must never insert keys (the old defaultdicts
+        # grew phantom zero-entries on every speculative lookup)
+        self._means: Dict[str, float] = {}
+        self._vars: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
         self.reset()
 
     def reset(self) -> None:
-        self.last_time = time.time()
+        self.last_time = time.monotonic()
 
     def time(self, name: str) -> None:
         """Record the elapsed time since the last ``time``/``reset`` call."""
-        now = time.time()
+        now = time.monotonic()
         x = now - self.last_time
         self.last_time = now
-        n = self._counts[name]
-        n += 1
-        delta = x - self._means[name]
-        self._means[name] += delta / n
-        delta2 = x - self._means[name]
-        self._vars[name] += delta * delta2
+        n = self._counts.get(name, 0) + 1
+        mean = self._means.get(name, 0.0)
+        delta = x - mean
+        mean += delta / n
+        delta2 = x - mean
+        self._means[name] = mean
+        self._vars[name] = self._vars.get(name, 0.0) + delta * delta2
         self._counts[name] = n
 
     def means(self) -> Dict[str, float]:
         return dict(self._means)
 
     def stds(self) -> Dict[str, float]:
-        return {
-            k: (self._vars[k] / max(self._counts[k], 1)) ** 0.5 for k in self._vars
-        }
+        """Per-event std-devs; lookups of never-recorded keys return 0.0
+        (a defaultdict view) instead of raising — summary consumers probe
+        speculative keys like ``dequeue`` that only some topologies emit."""
+        return collections.defaultdict(
+            float,
+            {
+                k: (self._vars.get(k, 0.0) / max(self._counts.get(k, 1), 1)) ** 0.5
+                for k in self._counts
+            },
+        )
 
     def summary(self, prefix: str = "") -> str:
         means = self.means()
@@ -72,7 +88,7 @@ class Timer:
     """Context-manager stopwatch with a running check interval."""
 
     def __init__(self) -> None:
-        self._start = time.time()
+        self._start = time.monotonic()
         self._last_check = self._start
         self._running = True
 
@@ -84,22 +100,22 @@ class Timer:
         self._running = False
 
     def start(self) -> None:
-        self._start = time.time()
+        self._start = time.monotonic()
         self._last_check = self._start
         self._running = True
 
     def since_start(self) -> float:
-        return time.time() - self._start
+        return time.monotonic() - self._start
 
     def since_last_check(self) -> float:
-        now = time.time()
+        now = time.monotonic()
         dur = now - self._last_check
         self._last_check = now
         return dur
 
     def check_time(self, interval: float) -> bool:
         """True (and reset the check clock) if ``interval`` seconds elapsed."""
-        now = time.time()
+        now = time.monotonic()
         if now - self._last_check >= interval:
             self._last_check = now
             return True

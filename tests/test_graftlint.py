@@ -482,3 +482,53 @@ def test_baseline_key_survives_line_drift():
     b = lint_source(shifted, HOT)[0]
     assert a.line != b.line
     assert a.key == b.key  # file::rule::snippet, not line numbers
+
+
+# ---------------------------------------------------------------------------
+# JG001 x telemetry — the registry write path must never add device reads
+
+
+GOOD_TELEMETRY_WRITE_PATH = """
+    from scalerl_tpu.runtime import telemetry
+    from scalerl_tpu.runtime.dispatch import get_metrics
+
+    def drive(chunks, logger):
+        reg = telemetry.get_registry()
+        meter = reg.meter("train.fps")
+        for i, device_metrics in enumerate(chunks):
+            host = get_metrics(device_metrics)  # ONE batched transfer
+            telemetry.observe_train_metrics(host)  # host floats only
+            reg.set_gauges(host, prefix="train.")
+            reg.counter("train.chunks").inc()
+            meter.mark(1)
+            telemetry.record_event("chunk_done", i=i)
+            logger.log_registry(i, step_type="train")
+"""
+
+BAD_TELEMETRY_DEVICE_READ_LOOP = """
+    import jax.numpy as jnp
+
+    from scalerl_tpu.runtime import telemetry
+
+    def drive(chunks):
+        reg = telemetry.get_registry()
+        for m in chunks:
+            loss = jnp.mean(m["loss"])
+            reg.gauge("train.loss").set(float(loss))  # per-chunk host sync
+"""
+
+
+def test_jg001_telemetry_write_path_is_clean():
+    """The sanctioned telemetry idiom — get_metrics once per chunk, then
+    host-side instrument writes — introduces no blocking device reads in
+    hot loops, so the linter finds nothing to flag."""
+    assert lint(GOOD_TELEMETRY_WRITE_PATH) == []
+
+
+def test_jg001_flags_device_value_fed_to_gauge_in_loop():
+    """Feeding a *device* scalar to a registry gauge inside a loop is the
+    exact bug class the plane is designed to avoid: JG001 flags the
+    float() at its line."""
+    findings = lint(BAD_TELEMETRY_DEVICE_READ_LOOP)
+    assert rules_of(findings) == ["JG001"]
+    assert "float()" in findings[0].message

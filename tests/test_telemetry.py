@@ -1,0 +1,507 @@
+"""Unified telemetry plane tests: registry, flight recorder, fleet merge.
+
+Acceptance surface of the telemetry PR:
+
+- one ``telemetry.snapshot()`` on the server process returns a merged tree
+  covering the pre-existing counters (hub, ring, queue, train-step guard,
+  supervisor) plus per-worker fleet series piggybacked over sockets;
+- a forced watchdog stall and a SIGTERM both produce a flight-recorder
+  dump containing the last N events.
+"""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.telemetry import (
+    FlightRecorder,
+    JsonlExporter,
+    MetricsRegistry,
+    PrometheusExporter,
+    TelemetryAggregator,
+    TelemetryExportLoop,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test gets a fresh default registry + recorder."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry instruments
+
+
+def test_counter_gauge_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("hub.protocol_errors").inc()
+    reg.counter("hub.protocol_errors").inc(2)
+    reg.gauge("train.fps").set(123.0)
+    snap = reg.snapshot()
+    assert snap["hub"]["protocol_errors"] == 3.0
+    assert snap["train"]["fps"] == 123.0
+    # same name -> same instrument object
+    assert reg.counter("hub.protocol_errors") is reg.counter("hub.protocol_errors")
+
+
+def test_instrument_kind_mismatch_raises_but_bulk_write_skips():
+    reg = MetricsRegistry()
+    reg.meter("train.fps")
+    with pytest.raises(TypeError):
+        reg.gauge("train.fps")
+    # the bulk gauge path skips names owned by another instrument kind
+    reg.set_gauges({"fps": 10.0, "loss": 0.5}, prefix="train.")
+    scalars = reg.scalars()
+    assert scalars["train.loss"] == 0.5
+    assert "train.fps.total" in scalars  # still the meter
+
+
+def test_set_gauges_skips_nonfinite_and_non_numeric():
+    reg = MetricsRegistry()
+    reg.set_gauges({"a": 1.0, "b": float("nan"), "c": "str", "d": True})
+    assert set(reg.scalars()) == {"a"}
+
+
+def test_histogram_summary_and_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("latency")
+    for v in range(1, 101):
+        h.observe(float(v))
+    snap = h.read()
+    assert snap["count"] == 100.0
+    assert snap["min"] == 1.0 and snap["max"] == 100.0
+    assert snap["mean"] == pytest.approx(50.5)
+    assert 40.0 <= snap["p50"] <= 60.0
+    assert snap["p99"] >= snap["p50"]
+
+
+def test_histogram_reservoir_is_bounded():
+    h = MetricsRegistry().histogram("x", reservoir_size=32)
+    for v in range(10_000):
+        h.observe(float(v))
+    assert len(h._reservoir) <= 32
+    assert h.count == 10_000
+
+
+def test_rate_meter_total_and_rate():
+    m = MetricsRegistry().meter("fps", window_s=30.0)
+    m.mark(100)
+    m.mark(50)
+    assert m.total == 150.0
+    # fresh burst: span floored at 1 s, so rate <= total
+    assert 0.0 < m.rate() <= 150.0
+
+
+def test_snapshot_nests_on_dots_and_bindings_merge():
+    reg = MetricsRegistry()
+    reg.counter("a.b.c").inc(7)
+    reg.bind("a.b.extra", lambda: 1.5)
+    reg.bind("queue", lambda: {"free": 3, "full": 1})
+    snap = reg.snapshot()
+    assert snap["a"]["b"]["c"] == 7.0
+    assert snap["a"]["b"]["extra"] == 1.5
+    assert snap["queue"] == {"free": 3, "full": 1}
+    flat = reg.scalars()
+    assert flat["queue.free"] == 3.0
+
+
+def test_broken_binding_reports_error_string_not_raise():
+    reg = MetricsRegistry()
+    reg.bind("dead", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert "error" in str(snap["dead"])
+
+
+def test_observe_train_metrics_accumulates_guard_counters():
+    telemetry.observe_train_metrics({"skipped_steps": 2.0, "nonfinite_grads": 5.0})
+    telemetry.observe_train_metrics({"skipped_steps": 0.0})
+    telemetry.observe_train_metrics(None)
+    snap = telemetry.snapshot()
+    assert snap["train"]["skipped_steps"] == 2.0
+    assert snap["train"]["nonfinite_grads"] == 5.0
+    kinds = [e["kind"] for e in telemetry.get_recorder().events()]
+    assert kinds.count("nonfinite_skip") == 1
+
+
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+
+    def bump():
+        for _ in range(1000):
+            reg.counter("c").inc()
+            reg.meter("m").mark()
+
+    threads = [threading.Thread(target=bump) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("c").value == 4000.0
+    assert reg.meter("m").total == 4000.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def test_flight_recorder_bounded_and_ordered():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("evt", i=i)
+    evts = fr.events()
+    assert [e["i"] for e in evts] == [6, 7, 8, 9]
+    assert fr.total_recorded == 10
+    assert "last 4 events" in fr.dump_text()
+
+
+def test_flight_recorder_dump_json(tmp_path):
+    fr = FlightRecorder(capacity=8)
+    fr.record("reconnect", attempt=1)
+    fr.record("torn_read", slot=3)
+    path = fr.dump_json(str(tmp_path / "flight.json"))
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["total_recorded"] == 2
+    assert [e["kind"] for e in payload["events"]] == ["reconnect", "torn_read"]
+
+
+# ---------------------------------------------------------------------------
+# aggregator
+
+
+def test_aggregator_per_source_latest_and_aggregate():
+    agg = TelemetryAggregator()
+    agg.absorb("gather:0", {"gather.results": 5})
+    agg.absorb("gather:0", {"gather.results": 9})  # cumulative: latest wins
+    agg.absorb("gather:16", {"gather.results": 4})
+    tree = agg.tree()
+    assert tree["sources"] == 2
+    assert tree["aggregate"]["gather.results"] == 13.0
+    assert tree["per_worker"]["gather:0"]["gather.results"] == 9.0
+
+
+def test_aggregator_payload_shape_and_garbage_tolerance():
+    agg = TelemetryAggregator()
+    agg.absorb_payload(
+        {"src": "gather:0", "v": {"a": 1, "junk": "str"},
+         "workers": {"3": {"worker.episodes": 2}}}
+    )
+    agg.absorb_payload("not a dict")
+    agg.absorb_payload(None)
+    tree = agg.tree()
+    assert tree["per_worker"]["gather:0"] == pytest.approx(
+        {"a": 1.0, "age_s": tree["per_worker"]["gather:0"]["age_s"]}
+    )
+    assert tree["per_worker"]["worker:3"]["worker.episodes"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# exporters
+
+
+def test_jsonl_and_prometheus_exporters(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("hub.protocol_errors").inc(2)
+    reg.gauge("train.fps").set(1000.0)
+    jp = tmp_path / "telemetry.jsonl"
+    JsonlExporter(str(jp)).write(reg.snapshot())
+    JsonlExporter(str(jp)).write(reg.snapshot())
+    lines = jp.read_text().strip().splitlines()
+    assert len(lines) == 2
+    row = json.loads(lines[-1])
+    assert row["snapshot"]["hub"]["protocol_errors"] == 2.0
+
+    pp = tmp_path / "metrics.prom"
+    PrometheusExporter(str(pp)).write(reg.scalars())
+    text = pp.read_text()
+    assert "scalerl_hub_protocol_errors 2.0" in text
+    assert "scalerl_train_fps 1000.0" in text
+
+
+def test_export_loop_flush_and_stop(tmp_path):
+    reg = telemetry.get_registry()
+    reg.counter("c").inc(4)
+    loop = TelemetryExportLoop(str(tmp_path), interval_s=3600.0).start()
+    loop.stop()  # stop() always flushes the final state
+    assert loop.writes >= 1
+    assert (tmp_path / "telemetry.jsonl").exists()
+    assert "scalerl_c 4.0" in (tmp_path / "metrics.prom").read_text()
+
+
+def test_write_final_snapshot(tmp_path):
+    telemetry.get_registry().counter("train.skipped_steps").inc()
+    telemetry.record_event("chaos_injection", fault="frame_bitflip")
+    path = telemetry.write_final_snapshot(str(tmp_path))
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["snapshot"]["train"]["skipped_steps"] == 1.0
+    assert payload["flight_recorder"][-1]["kind"] == "chaos_injection"
+
+
+# ---------------------------------------------------------------------------
+# failure-path dumps: watchdog stall + SIGTERM
+
+
+def test_watchdog_stall_report_carries_flight_recorder(monkeypatch, tmp_path):
+    monkeypatch.setenv(telemetry.ENV_DIR, str(tmp_path))
+    from scalerl_tpu.runtime.supervisor import StallWatchdog
+
+    telemetry.record_event("reconnect", attempt=1)
+    telemetry.record_event("torn_read", slot=2)
+    reports = []
+    wd = StallWatchdog(
+        deadline_s=0.2,
+        poll_s=0.05,
+        on_stall=lambda e: reports.append(str(e)),
+        name="test-stall",
+    )
+    wd.watch("frozen", lambda: 0)  # never advances -> guaranteed stall
+    with wd:
+        deadline = time.monotonic() + 10.0
+        while not reports and time.monotonic() < deadline:
+            time.sleep(0.05)
+    assert reports, "watchdog never fired"
+    report = reports[0]
+    # the stall report embeds the flight-recorder tail next to the stacks
+    assert "flight recorder" in report
+    assert "reconnect" in report and "torn_read" in report
+    assert "faulthandler" in report
+    # ... and the tail also landed as JSON (under SCALERL_TELEMETRY_DIR)
+    assert wd.flight_dump_path and os.path.exists(wd.flight_dump_path)
+    with open(wd.flight_dump_path) as f:
+        events = [e["kind"] for e in json.load(f)["events"]]
+    assert "reconnect" in events and "torn_read" in events
+    # the watchdog's own verdict is in the merged snapshot
+    snap = telemetry.snapshot()
+    assert snap["supervisor"]["test-stall"]["fire_count"] == 1
+
+
+def test_sigterm_produces_flight_dump(monkeypatch, tmp_path):
+    monkeypatch.setenv(telemetry.ENV_DIR, str(tmp_path))
+    from scalerl_tpu.runtime.supervisor import PreemptionGuard
+
+    for i in range(5):
+        telemetry.record_event("checkpoint_save", step=i)
+    guard = PreemptionGuard(signals=(signal.SIGTERM,))
+    with guard:
+        assert guard._installed  # pytest's main thread
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not guard.triggered and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert guard.triggered and guard.received == signal.SIGTERM
+    assert guard.flight_dump_path and os.path.exists(guard.flight_dump_path)
+    with open(guard.flight_dump_path) as f:
+        payload = json.load(f)
+    kinds = [e["kind"] for e in payload["events"]]
+    # the last N events, ending with the preemption itself
+    assert kinds.count("checkpoint_save") == 5
+    assert kinds[-1] == "preemption_signal"
+
+
+def test_divergence_tripwire_records_event_and_counter():
+    from scalerl_tpu.runtime.supervisor import DivergenceTripwire
+
+    fired = []
+    tw = DivergenceTripwire(2, lambda: fired.append(1))
+    tw.observe({"skipped_steps": 1.0})
+    assert not fired
+    tw.observe({"skipped_steps": 1.0})
+    assert fired
+    snap = telemetry.snapshot()
+    assert snap["supervisor"]["divergence_trips"] == 1.0
+    assert any(
+        e["kind"] == "divergence_trip" for e in telemetry.get_recorder().events()
+    )
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide merge over sockets (the acceptance test)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _bandit_runner(task, weights, worker_id):
+    w = weights["w"] if weights is not None else np.zeros(2, np.float32)
+    return {
+        "seed": int(task.get("seed", 0)),
+        "reward": float(w.sum()),
+        "frames": np.zeros((4, 2), np.float32),
+    }
+
+
+def _make_task_source(n, param_server=lambda: 0):
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if counter["i"] >= n:
+                return None
+            counter["i"] += 1
+            return {"seed": counter["i"], "param_version": param_server()}
+
+    return source
+
+
+def _drain(server, n, timeout=180.0):
+    results = []
+    deadline = time.monotonic() + timeout
+    while len(results) < n and time.monotonic() < deadline:
+        r = server.get_result(timeout=0.2)
+        if r is not None:
+            results.append(r)
+    return results
+
+
+def test_socket_fleet_merged_snapshot_covers_preexisting_counters():
+    """ONE ``telemetry.snapshot()`` on the server process merges >= 10
+    pre-existing counters (hub, ring, queue, train-step guard, supervisor)
+    plus per-worker fleet series piggybacked on pong/upload frames."""
+    from scalerl_tpu.data.trajectory import TrajectorySpec
+    from scalerl_tpu.fleet.cluster import FleetConfig, RemoteCluster, WorkerServer
+    from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+    from scalerl_tpu.runtime.shm_ring import ShmRolloutRing, SlotSpec
+    from scalerl_tpu.runtime.supervisor import StallWatchdog
+
+    # local (learner-process) planes so their bindings join the snapshot
+    queue = RolloutQueue(
+        TrajectorySpec(unroll_length=2, batch_size=1, obs_shape=(2,), num_actions=2),
+        num_slots=2,
+    )
+    ring = ShmRolloutRing(
+        SlotSpec({"obs": ((2,), np.float32)}), num_slots=2, use_native=False
+    )
+    telemetry.observe_train_metrics({"skipped_steps": 1.0, "nonfinite_grads": 2.0})
+    watchdog = StallWatchdog(deadline_s=3600.0, name="learner").start()
+
+    entry_port, worker_port = _free_port(), _free_port()
+    config = FleetConfig(
+        num_workers=2,
+        workers_per_gather=2,
+        upload_batch=1,
+        entry_port=entry_port,
+        worker_port=worker_port,
+        heartbeat_interval_s=0.2,
+    )
+    server = WorkerServer(config, _make_task_source(6, lambda: server.params.version))
+    server.publish({"w": np.array([0.5, 0.5], np.float32)})
+    server.start(listen=True)
+    remote = RemoteCluster(config, _bandit_runner)
+    try:
+        remote.start()
+        results = _drain(server, 6)
+        assert len(results) == 6
+        # results are clean: the piggyback was stripped at the gather
+        assert all("_telem" not in r for r in results)
+        # wait for at least one piggybacked snapshot to land (first upload
+        # or first heartbeat pong, whichever wins)
+        deadline = time.monotonic() + 30.0
+        while not server.telemetry.sources() and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        snap = server.telemetry_snapshot()
+        flat = telemetry.get_registry().scalars()
+        # >= 10 pre-existing counters, one merged tree
+        preexisting = [
+            "hub.protocol_errors",        # PR 4
+            "hub.peers_dropped",          # PR 2 liveness verdicts
+            "hub.connections",
+            "server.total_results",       # fleet results accounting
+            "server.duplicate_results",   # PR 4 at-least-once dedup
+            "server.dropped_results",
+            "server.worker_errors",
+            "queue.free",                 # RolloutQueue.stats
+            "queue.full",
+            "queue.in_flight",
+            "ring.torn_reads",            # ShmRolloutRing integrity
+            "ring.slots",
+            "train.skipped_steps",        # train-step guard
+            "train.nonfinite_grads",
+            "supervisor.learner.fire_count",  # watchdog
+            "codec.frames_packed",        # v2 codec
+        ]
+        missing = [k for k in preexisting if k not in flat]
+        assert not missing, f"missing from merged snapshot: {missing}"
+        assert len(preexisting) >= 10
+        assert snap["server"]["total_results"] == 6
+        assert snap["train"]["skipped_steps"] == 1.0
+
+        # fleet series: at least the gather source, with counters that
+        # match what actually happened
+        fleet = snap["fleet"]
+        assert fleet["sources"] >= 1
+        gather_keys = [s for s in fleet["per_worker"] if s.startswith("gather:")]
+        assert gather_keys, f"no gather series in {sorted(fleet['per_worker'])}"
+        gsnap = fleet["per_worker"][gather_keys[0]]
+        assert gsnap.get("gather.results", 0.0) >= 1.0
+        assert fleet["aggregate"].get("gather.results", 0.0) >= 1.0
+    finally:
+        remote.join()
+        server.stop()
+        watchdog.stop()
+        queue.close()
+        ring.unlink()
+
+
+def test_local_cluster_pipe_piggyback_reaches_server():
+    """Pipe-transport fleets (LocalCluster) ride the same piggyback: the
+    hub's recv pump absorbs "telem" payloads regardless of transport."""
+    from scalerl_tpu.fleet.cluster import FleetConfig, LocalCluster, WorkerServer
+
+    config = FleetConfig(num_workers=2, workers_per_gather=2, upload_batch=1)
+    server = WorkerServer(config, _make_task_source(4, lambda: server.params.version))
+    server.publish({"w": np.array([1.0, 1.0], np.float32)})
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, _bandit_runner)
+    try:
+        cluster.start()
+        results = _drain(server, 4)
+        assert len(results) == 4
+        deadline = time.monotonic() + 30.0
+        while not server.telemetry.sources() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.telemetry.sources(), "no piggybacked telemetry absorbed"
+        agg = server.telemetry.aggregate()
+        assert agg.get("gather.results", 0.0) >= 1.0
+    finally:
+        cluster.join()
+        server.stop()
+
+
+def test_piggyback_disabled_keeps_wire_clean():
+    from scalerl_tpu.fleet.cluster import FleetConfig, LocalCluster, WorkerServer
+
+    config = FleetConfig(
+        num_workers=1, upload_batch=1, telemetry_piggyback=False
+    )
+    server = WorkerServer(config, _make_task_source(2, lambda: server.params.version))
+    server.publish({"w": np.array([1.0, 1.0], np.float32)})
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, _bandit_runner)
+    try:
+        cluster.start()
+        results = _drain(server, 2)
+        assert len(results) == 2
+        # no telem frames -> nothing absorbed (worker results still strip
+        # their _telem at the gather, so the wire stays clean either way)
+        assert server.telemetry.sources() == []
+    finally:
+        cluster.join()
+        server.stop()

@@ -20,8 +20,10 @@ then commits the artifacts immediately.
 Run: ``nohup python tools/tpu_watch.py >/tmp/tpu_watch_r5.out 2>&1 &``
 """
 
+import json
 import os
 import re
+import shutil
 import subprocess
 import sys
 import time
@@ -29,6 +31,19 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBELOG = os.path.join(REPO, "TPU_PROBELOG.md")
 PAYLOG = "/tmp/tpu_autobench_r5.log"
+TELEM_ROOT = "/tmp/tpu_watch_telemetry"
+
+# registry counters whose nonzero final value flags a step as suspect even
+# when its exit code was 0: the integrity layer detected (and absorbed)
+# corruption, or the numerical guard skipped updates — worth a human look
+INTEGRITY_FLAT_KEYS = (
+    "hub.protocol_errors",
+    "ring.torn_reads",
+    "server.duplicate_results",
+    "train.skipped_steps",
+    "train.nonfinite_grads",
+    "queue.actor_errors",
+)
 
 PROBE = (
     "import jax; print('backend:', jax.default_backend());"
@@ -74,6 +89,55 @@ def _watchdog_dump_marker(bl, start_offset: int) -> str:
     except Exception:  # noqa: BLE001 - diagnosis must not fail the watcher
         pass
     return ""
+
+
+def _flatten_snapshot(tree, prefix="") -> dict:
+    flat = {}
+    for k, v in (tree or {}).items():
+        name = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten_snapshot(v, name))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            flat[name] = float(v)
+    return flat
+
+
+def _telemetry_marker(telem_dir: str, bl) -> str:
+    """Attach the step's final telemetry snapshot to the step summary.
+
+    Every payload step runs with SCALERL_TELEMETRY_DIR pointed at its own
+    dir; the runtime's atexit hook (runtime/telemetry.py) writes
+    ``final_snapshot.json`` there.  The flat counter view is appended to
+    the payload log, and the returned marker is ``+telem`` — plus
+    ``!integrity(<keys>)`` when any protocol_errors/torn_reads/nonfinite
+    counter ended nonzero (the step *absorbed* corruption; the summary
+    must say so even on rc=0).
+    """
+    path = os.path.join(telem_dir, "final_snapshot.json")
+    try:
+        if not os.path.exists(path):
+            return ""
+        with open(path) as f:
+            payload = json.load(f)
+        flat = _flatten_snapshot(payload.get("snapshot") or {})
+        bl.write(
+            "[watcher] final telemetry snapshot "
+            f"({len(flat)} series): "
+            + json.dumps({k: flat[k] for k in sorted(flat)[:80]})
+            + "\n"
+        )
+        bad = [
+            k.rsplit(".", 1)[0]
+            for k in flat
+            for key in INTEGRITY_FLAT_KEYS
+            if (k == key or k.startswith(key + ".")) and flat[k] > 0
+        ]
+        if bad:
+            return "+telem!integrity(" + ",".join(sorted(set(bad))[:4]) + ")"
+        return "+telem"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] telemetry attach failed: {e}\n")
+        return ""
 
 
 def _run_step(cmd, env, bl, timeout_s: float) -> str:
@@ -164,8 +228,15 @@ def run_payload(n_devices: int = 1) -> None:
     with open(PAYLOG, "a", buffering=1) as bl:
         for name, cmd, tmo, step_env in steps:
             bl.write(f"=== {name} {time.strftime('%H:%M:%S')} ===\n")
+            # per-step telemetry dir: the runtime's exit hook drops a final
+            # registry snapshot there, attached to this step's summary
+            telem_dir = os.path.join(TELEM_ROOT, name)
+            shutil.rmtree(telem_dir, ignore_errors=True)
+            os.makedirs(telem_dir, exist_ok=True)
+            step_env = dict(step_env, SCALERL_TELEMETRY_DIR=telem_dir)
             try:
-                outcomes.append((name, _run_step(cmd, step_env, bl, tmo)))
+                status = _run_step(cmd, step_env, bl, tmo)
+                outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
                 outcomes.append((name, "error"))
@@ -175,7 +246,7 @@ def run_payload(n_devices: int = 1) -> None:
         "(see BENCH_TPU.md)"
     )
     if not any(
-        status == "ok"
+        status.startswith("ok")
         for name, status in outcomes
         if name not in ("lint", "chaos-soak")
     ):
