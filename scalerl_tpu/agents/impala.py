@@ -53,6 +53,7 @@ def impala_loss(
     reward_clipping: str = "abs_one",
     rho_clip: float = 1.0,
     c_clip: float = 1.0,
+    vtrace_impl: str = "scan",
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """The IMPALA objective over one [T+1, B] trajectory chunk.
 
@@ -86,6 +87,7 @@ def impala_loss(
         clip_rho_threshold=rho_clip,
         clip_pg_rho_threshold=rho_clip,
         clip_c_threshold=c_clip,
+        impl=vtrace_impl,
     )
 
     pg = policy_gradient_loss(target_logits[:-1], actions_taken, vt.pg_advantages)
@@ -139,6 +141,11 @@ def make_impala_learn_fn(
             args.entropy_cost, end_cost, n_updates
         )
 
+    # RLArguments.use_pallas routes the V-trace targets through the fused
+    # Pallas kernel (ops/pallas_vtrace.py; interpreter mode off-TPU) —
+    # gradient-safe because V-trace outputs are stop_gradient-ed constants
+    vtrace_impl = "pallas" if getattr(args, "use_pallas", False) else "scan"
+
     def learn(state: ImpalaTrainState, traj: Trajectory):
         ent_cost = (
             ent_schedule(state.step) if ent_schedule is not None
@@ -154,6 +161,7 @@ def make_impala_learn_fn(
             reward_clipping=args.reward_clipping,
             rho_clip=args.vtrace_rho_clip,
             c_clip=args.vtrace_c_clip,
+            vtrace_impl=vtrace_impl,
         )
         n_shards = 1
         if grad_axis is not None:

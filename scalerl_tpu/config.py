@@ -124,11 +124,25 @@ class RLArguments:
     # skipped (lax.cond inside the jitted step — no extra dispatch) and
     # counted in the batched metrics as skipped_steps/nonfinite_grads.
     nonfinite_guard: bool = True
+    # Guard amortization: run the (single fused-reduction) all-finite check
+    # only on learn steps where state.step % K == 0.  K=1 (default)
+    # preserves check-every-step semantics; K>1 makes the guard's cost
+    # ~1/K per step — a divergence is still caught within K-1 steps, which
+    # the tripwire's consecutive-skip window tolerates.  The env fast-off
+    # SCALERL_NONFINITE_GUARD=0 compiles the guard out entirely instead.
+    nonfinite_check_every: int = 1
     # Divergence tripwire: after this many CONSECUTIVE skipped learn steps
     # the trainer restores agent state from the last good resume checkpoint
     # (falling back through the .prev chain).  <= 0 disables rollback; the
     # guard still skips individual bad steps.
     divergence_rollback_steps: int = 0
+
+    # Pallas kernels (ops/pallas_vtrace.py, ops/pallas_per.py): route the
+    # V-trace target computation and the PER priority/sum-tree update
+    # through the fused TPU kernels (interpret-mode on CPU for parity
+    # tests).  Off by default: the XLA reference ops are the baseline the
+    # kernels are bit-tolerance-tested against.
+    use_pallas: bool = False
 
     def validate(self) -> None:
         if self.batch_size <= 0:
@@ -139,6 +153,11 @@ class RLArguments:
             raise ValueError(
                 f"buffer_size ({self.buffer_size}) must be >= batch_size "
                 f"({self.batch_size})"
+            )
+        if self.nonfinite_check_every < 1:
+            raise ValueError(
+                "nonfinite_check_every must be >= 1, got "
+                f"{self.nonfinite_check_every}"
             )
 
 

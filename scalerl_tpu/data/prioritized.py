@@ -140,18 +140,35 @@ def per_update_priorities(
     state: PrioritizedState,
     flat_physical: jnp.ndarray,  # [B] as returned in batch["indices"]
     priorities: jnp.ndarray,  # [B] new raw priorities (e.g. |td| + eps)
+    method: str = "xla",
 ) -> PrioritizedState:
     """Scatter new priorities at the sampled PHYSICAL slots.
 
     ``batch["indices"]`` is physical (see ``per_sample``), so this stays
     correct even when inserts landed between sample and update — the
     failure mode a logical-index contract would have had.
+
+    ``method="pallas"`` routes the scatter through the fused in-place
+    kernel (``ops/pallas_per.update_priorities_blocks``): one block DMA
+    per updated slot instead of a full-plane XLA scatter pass; selected by
+    ``RLArguments.use_pallas`` at buffer construction.
     """
-    num_envs = state.priorities.shape[1]
-    rows = flat_physical // num_envs
-    envs = flat_physical % num_envs
+    capacity, num_envs = state.priorities.shape
     priorities = jnp.maximum(priorities, 1e-6)
-    new_prio = state.priorities.at[rows, envs].set(priorities)
+    if method == "pallas":
+        from scalerl_tpu.ops.pallas_per import update_priorities_blocks
+
+        # the priority plane is C-order [capacity, num_envs], so the flat
+        # physical index addresses its ravel directly
+        new_flat, _ = update_priorities_blocks(
+            state.priorities.reshape(-1), flat_physical, priorities,
+            method="pallas",
+        )
+        new_prio = new_flat.reshape(capacity, num_envs)
+    else:
+        rows = flat_physical // num_envs
+        envs = flat_physical % num_envs
+        new_prio = state.priorities.at[rows, envs].set(priorities)
     new_max = jnp.maximum(state.max_priority, jnp.max(priorities))
     return state.replace(priorities=new_prio, max_priority=new_max)
 
@@ -172,6 +189,7 @@ class PrioritizedReplayBuffer:
         gamma: float = 0.99,
         extra_fields: Optional[Dict[str, Tuple[Tuple[int, ...], jnp.dtype]]] = None,
         sample_method: str = "auto",
+        update_method: str = "auto",
         action_shape: Tuple[int, ...] = (),
         action_dtype: jnp.dtype = jnp.int32,
     ) -> None:
@@ -189,9 +207,13 @@ class PrioritizedReplayBuffer:
         # resolve "auto" NOW (env var / backend at construction), not at
         # first trace — a SCALERL_PER_METHOD change after tracing would
         # otherwise be silently ignored by the cached program
-        from scalerl_tpu.ops.pallas_per import resolve_sample_method
+        from scalerl_tpu.ops.pallas_per import (
+            resolve_sample_method,
+            resolve_update_method,
+        )
 
         self.sample_method = resolve_sample_method(sample_method)
+        self.update_method = resolve_update_method(update_method)
         self.state = per_init(self.spec, capacity, num_envs)
         self._add = jax.jit(per_add, donate_argnums=0)
         self._add_prio = jax.jit(per_add_with_priorities, donate_argnums=0)
@@ -200,7 +222,9 @@ class PrioritizedReplayBuffer:
         self._sample = jax.jit(
             per_sample, static_argnames=("batch_size", "n_step", "gamma", "method")
         )
-        self._update = jax.jit(per_update_priorities, donate_argnums=0)
+        self._update = jax.jit(
+            per_update_priorities, donate_argnums=0, static_argnames=("method",)
+        )
 
     def __len__(self) -> int:
         return int(self.state.replay.size) * self.num_envs
@@ -246,5 +270,6 @@ class PrioritizedReplayBuffer:
 
     def update_priorities(self, indices, priorities) -> None:
         self.state = self._update(
-            self.state, jnp.asarray(indices), jnp.asarray(priorities, jnp.float32)
+            self.state, jnp.asarray(indices), jnp.asarray(priorities, jnp.float32),
+            method=self.update_method,
         )

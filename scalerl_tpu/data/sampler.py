@@ -33,10 +33,14 @@ class Sampler:
         gamma: float = 0.99,
         action_shape=(),
         action_dtype: jnp.dtype = jnp.int32,
+        use_pallas: bool = False,
     ) -> None:
         self.use_per = use_per
         self.n_step = n_step
         if use_per:
+            # use_pallas (RLArguments.use_pallas): pin both PER halves to
+            # the Pallas kernels (interpreter mode off-TPU) instead of the
+            # backend-resolved "auto"
             self.buffer = PrioritizedReplayBuffer(
                 obs_shape,
                 capacity,
@@ -47,6 +51,8 @@ class Sampler:
                 gamma=gamma,
                 action_shape=tuple(action_shape),
                 action_dtype=action_dtype,
+                sample_method="pallas" if use_pallas else "auto",
+                update_method="pallas" if use_pallas else "auto",
             )
         else:
             self.buffer = ReplayBuffer(

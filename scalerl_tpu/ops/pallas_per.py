@@ -80,12 +80,20 @@ def pallas_sample(
     flat_p: jnp.ndarray,
     targets: jnp.ndarray,
     block_size: int = 1024,
-    interpret: bool = False,
+    interpret: bool = None,
 ) -> jnp.ndarray:
     """Pallas within-block search; distribution-identical to
-    :func:`hierarchical_sample`."""
+    :func:`hierarchical_sample`.
+
+    ``interpret=None`` auto-resolves: compiled Mosaic on TPU, the Pallas
+    interpreter elsewhere — so an explicitly pinned ``method="pallas"``
+    (e.g. ``RLArguments.use_pallas`` on a CPU test run) works on every
+    backend instead of failing to compile off-TPU."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
 
     blocks, b_idx, within_t = _split_targets(flat_p, targets, block_size)
     S = targets.shape[0]
@@ -173,3 +181,189 @@ def proportional_sample(
 @functools.partial(jax.jit, static_argnames=("method", "block_size"))
 def _jitted_proportional_sample(flat_p, targets, method, block_size):
     return proportional_sample(flat_p, targets, method, block_size)
+
+
+# ---------------------------------------------------------------------------
+# fused priority / sum-tree update (the write half of the PER feedback loop)
+
+_UPDATE_METHODS = ("xla", "pallas")
+
+
+def resolve_update_method(method: str = "auto") -> str:
+    """Resolve the priority-update implementation for this backend.
+
+    Mirrors :func:`resolve_sample_method`: ``auto`` -> ``pallas`` on TPU
+    (the aliased in-place scatter kernel), ``xla`` elsewhere (interpreter
+    mode is correct but slow for a per-learn-step op).  The env var
+    ``SCALERL_PER_UPDATE`` overrides what ``auto`` resolves to; an
+    explicitly pinned method always wins.
+    """
+    import os
+
+    if method != "auto":
+        if method not in _UPDATE_METHODS:
+            raise ValueError(
+                f"unknown update method {method!r}; use one of "
+                f"{('auto',) + _UPDATE_METHODS}"
+            )
+        return method
+    forced = os.environ.get("SCALERL_PER_UPDATE")
+    if forced:
+        if forced not in _UPDATE_METHODS:
+            raise ValueError(
+                f"SCALERL_PER_UPDATE={forced!r} is not one of {_UPDATE_METHODS}"
+            )
+        return forced
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _pad_to_blocks(flat_p: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    pad = (-flat_p.shape[0]) % block_size
+    return jnp.pad(flat_p, (0, pad)) if pad else flat_p
+
+
+def _update_kernel_factory(M: int, with_sums: bool):
+    """Grid step i owns block ``b_idx[i]`` and applies EVERY update whose
+    block matches — idempotent per block, so a block revisited by a later
+    grid step (whose input DMA races the earlier step's writeback under the
+    double-buffered pipeline) recomputes the identical final content
+    instead of losing the earlier write.  Updates apply in ascending order,
+    so duplicate (block, lane) pairs are deterministic last-wins."""
+    import jax.experimental.pallas as pl
+
+    def kernel(b_idx_ref, w_idx_ref, blocks_ref, *rest):
+        if with_sums:
+            _sums_ref, newp_ref, out_blocks_ref, out_sums_ref = rest
+        else:
+            newp_ref, out_blocks_ref = rest
+        i = pl.program_id(0)
+        my_b = b_idx_ref[i]
+        blk = blocks_ref[:]
+        lane = jax.lax.broadcasted_iota(jnp.int32, blk.shape, 1)
+
+        def body(j, blk):
+            sel = (b_idx_ref[j] == my_b) & (lane == w_idx_ref[j])
+            return jnp.where(sel, newp_ref[j, 0], blk)
+
+        blk = jax.lax.fori_loop(0, M, body, blk)
+        out_blocks_ref[:] = blk
+        if with_sums:
+            out_sums_ref[0, 0] = jnp.sum(blk)
+
+    return kernel
+
+
+def _pallas_update(
+    blocks: jnp.ndarray,  # [nb, bs]
+    block_sums,  # [nb] or None
+    b_idx: jnp.ndarray,  # [M]
+    w_idx: jnp.ndarray,  # [M]
+    new_p: jnp.ndarray,  # [M]
+    interpret: bool,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, bs = blocks.shape
+    M = b_idx.shape[0]
+    with_sums = block_sums is not None
+    in_specs = [
+        pl.BlockSpec((1, bs), lambda i, b, w: (b[i], 0)),
+    ]
+    out_specs = [pl.BlockSpec((1, bs), lambda i, b, w: (b[i], 0))]
+    out_shape = [jax.ShapeDtypeStruct((nb, bs), jnp.float32)]
+    operands = [blocks.astype(jnp.float32)]
+    # the outputs alias their inputs (indices count the scalar-prefetch
+    # operands): untouched blocks/sums keep their values with zero copies
+    aliases = {2: 0}
+    if with_sums:
+        in_specs.append(pl.BlockSpec((1, 1), lambda i, b, w: (b[i], 0)))
+        out_specs.append(pl.BlockSpec((1, 1), lambda i, b, w: (b[i], 0)))
+        out_shape.append(jax.ShapeDtypeStruct((nb, 1), jnp.float32))
+        operands.append(block_sums.astype(jnp.float32).reshape(nb, 1))
+        aliases[3] = 1
+    in_specs.append(
+        pl.BlockSpec((M, 1), lambda i, b, w: (0, 0))  # all updates, VMEM
+    )
+    operands.append(new_p.astype(jnp.float32)[:, None])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(M,),
+        in_specs=in_specs,
+        # out_specs/out_shape pytrees must match exactly: a bare leaf for
+        # the plane-only variant, a 2-tuple when sums ride along
+        out_specs=tuple(out_specs) if with_sums else out_specs[0],
+    )
+    out = pl.pallas_call(
+        _update_kernel_factory(M, with_sums),
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape) if with_sums else out_shape[0],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(b_idx.astype(jnp.int32), w_idx.astype(jnp.int32), *operands)
+    if with_sums:
+        return out[0], out[1][:, 0]
+    return out, None
+
+
+def update_priorities_blocks(
+    flat_p: jnp.ndarray,
+    idx: jnp.ndarray,
+    new_p: jnp.ndarray,
+    block_sums=None,
+    block_size: int = 1024,
+    method: str = "auto",
+    interpret=None,
+):
+    """Fused PER priority + two-level sum-tree update.
+
+    Scatters ``new_p`` into the flat priority plane at ``idx`` and, when
+    ``block_sums`` (the maintained per-block partial sums — the two-level
+    "sum tree" :func:`hierarchical_sample`'s phase 1 consumes) is given,
+    refreshes exactly the affected blocks' sums in the same pass.  Returns
+    ``(new_flat_p, new_block_sums)`` (``new_block_sums`` is None when no
+    sums were passed).
+
+    Semantics: updates apply in ascending order, so duplicate indices are
+    deterministic last-wins in BOTH implementations.  ``method="pallas"``
+    runs the aliased in-place kernel — one block DMA per update, no full-
+    plane traffic; ``"xla"`` is the reference (an ordered scatter loop +
+    affected-block re-sum) the kernel is bit-tolerance-tested against;
+    ``"auto"`` resolves per backend (:func:`resolve_update_method`).
+    ``interpret=None`` auto-resolves like :func:`pallas_sample`.
+    """
+    method = resolve_update_method(method)
+    n = flat_p.shape[0]
+    idx = jnp.clip(idx.astype(jnp.int32), 0, n - 1)
+    new_p = new_p.astype(jnp.float32)
+    padded = _pad_to_blocks(flat_p.astype(jnp.float32), block_size)
+    nb = padded.shape[0] // block_size
+    if block_sums is not None and block_sums.shape[0] != nb:
+        raise ValueError(
+            f"block_sums has {block_sums.shape[0]} entries but the padded "
+            f"plane has {nb} blocks of {block_size}"
+        )
+    b_idx = idx // block_size
+    w_idx = idx % block_size
+
+    if method == "xla":
+        def body(j, p):
+            return p.at[idx[j]].set(new_p[j])
+
+        padded = jax.lax.fori_loop(0, idx.shape[0], body, padded)
+        new_sums = None
+        if block_sums is not None:
+            rows = padded.reshape(nb, block_size)
+            new_sums = block_sums.astype(jnp.float32).at[b_idx].set(
+                jnp.sum(rows[b_idx], axis=1)
+            )
+        return padded[:n], new_sums
+
+    blocks = padded.reshape(nb, block_size)
+    new_blocks, new_sums = _pallas_update(
+        blocks, block_sums, b_idx, w_idx, new_p,
+        interpret=(
+            jax.default_backend() != "tpu" if interpret is None else interpret
+        ),
+    )
+    return new_blocks.reshape(-1)[:n], new_sums

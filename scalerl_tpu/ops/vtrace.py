@@ -47,6 +47,7 @@ def vtrace_from_importance_weights(
     clip_rho_threshold: Optional[float] = 1.0,
     clip_pg_rho_threshold: Optional[float] = 1.0,
     clip_c_threshold: float = 1.0,
+    impl: str = "scan",
 ) -> VTraceOutput:
     """Compute V-trace targets from log importance weights.
 
@@ -59,7 +60,23 @@ def vtrace_from_importance_weights(
       clip_rho_threshold: rho-hat clip (None = no clipping).
       clip_pg_rho_threshold: clip for the pg-advantage rhos (None = none).
       clip_c_threshold: c-hat clip.
+      impl: ``"scan"`` (this reference op, reverse ``lax.scan``) or
+        ``"pallas"`` (the fused kernel, ``ops/pallas_vtrace.py`` —
+        interpreter-mode off-TPU; selected by ``RLArguments.use_pallas``).
     """
+    if impl == "pallas":
+        from scalerl_tpu.ops.pallas_vtrace import (
+            vtrace_from_importance_weights_pallas,
+        )
+
+        return vtrace_from_importance_weights_pallas(
+            log_rhos, discounts, rewards, values, bootstrap_value,
+            clip_rho_threshold=clip_rho_threshold,
+            clip_pg_rho_threshold=clip_pg_rho_threshold,
+            clip_c_threshold=clip_c_threshold,
+        )
+    if impl != "scan":
+        raise ValueError(f"impl must be 'scan' or 'pallas', got {impl!r}")
     rhos = jnp.exp(log_rhos)
     clipped_rhos = jnp.minimum(clip_rho_threshold, rhos) if clip_rho_threshold is not None else rhos
     cs = jnp.minimum(clip_c_threshold, rhos)
@@ -106,6 +123,7 @@ def vtrace_from_logits(
     clip_rho_threshold: Optional[float] = 1.0,
     clip_pg_rho_threshold: Optional[float] = 1.0,
     clip_c_threshold: float = 1.0,
+    impl: str = "scan",
 ) -> VTraceOutput:
     """V-trace from behavior/target policy logits ([T, B, A]) and actions ([T, B])."""
     log_rhos = action_log_probs(target_logits, actions) - action_log_probs(
@@ -120,4 +138,5 @@ def vtrace_from_logits(
         clip_rho_threshold=clip_rho_threshold,
         clip_pg_rho_threshold=clip_pg_rho_threshold,
         clip_c_threshold=clip_c_threshold,
+        impl=impl,
     )

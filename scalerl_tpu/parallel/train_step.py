@@ -33,11 +33,15 @@ from scalerl_tpu.parallel.sharding import (
 # numerical fault tolerance: the all-finite update guard
 
 
-def tree_all_finite(tree: Any) -> jnp.ndarray:
-    """Scalar bool: every inexact (float/complex) leaf of ``tree`` is finite.
+def nonfinite_score(tree: Any) -> jnp.ndarray:
+    """Scalar f32 that is ``0.0`` when every inexact leaf of ``tree`` is
+    finite and NaN otherwise — ONE fused multiply+reduce per leaf.
 
-    Integer/bool leaves (step counters, indices) are skipped — ``isfinite``
-    is undefined for them and they cannot go NaN.
+    ``x * 0`` maps finite values to ``0`` and NaN/Inf to NaN, so the sum of
+    the zeroed leaves is exactly the verdict: no boolean plane is ever
+    materialized and the whole check fuses into a single reduction tree
+    whose scalar can ride the batched per-chunk metric read.  Integer/bool
+    leaves (step counters, indices) are skipped — they cannot go NaN.
     """
     leaves = [
         x
@@ -45,12 +49,22 @@ def tree_all_finite(tree: Any) -> jnp.ndarray:
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
     ]
     if not leaves:
-        return jnp.asarray(True)
-    checks = [jnp.all(jnp.isfinite(x)) for x in leaves]
-    return checks[0] if len(checks) == 1 else jnp.all(jnp.stack(checks))
+        return jnp.float32(0.0)
+    total = jnp.float32(0.0)
+    for x in leaves:
+        total = total + jnp.sum(x.astype(jnp.float32) * 0.0)
+    return total
 
 
-def guard_nonfinite_updates(learn_fn: Callable) -> Callable:
+def tree_all_finite(tree: Any) -> jnp.ndarray:
+    """Scalar bool: every inexact (float/complex) leaf of ``tree`` is
+    finite (computed via the fused :func:`nonfinite_score` reduction)."""
+    return jnp.isfinite(nonfinite_score(tree))
+
+
+def guard_nonfinite_updates(
+    learn_fn: Callable, check_every: int = 1
+) -> Callable:
     """Wrap a pure ``(state, *args) -> (state, metrics, *aux)`` update so a
     non-finite result SKIPS the step instead of poisoning the run.
 
@@ -61,13 +75,22 @@ def guard_nonfinite_updates(learn_fn: Callable) -> Callable:
     outputs (e.g. per-sample |TD| feeding PER priorities) are sanitized to
     finite zeros so NaN can't leak into replay through the feedback path.
 
-    Two counters ride the metrics dict — and therefore the existing ONE
-    batched device->host transfer per chunk (PR 1/PR 3 discipline), costing
-    no extra dispatch: ``nonfinite_grads`` (1.0 when the candidate update
-    contained a non-finite value) and ``skipped_steps`` (1.0 when the update
-    was dropped; the host-side divergence tripwire counts consecutive ones).
-    Inside a scanned fused driver these are per-iteration flags that the
-    chunk-mean reduces to a fraction.
+    The finiteness verdict is the single fused :func:`nonfinite_score`
+    reduction — no per-leaf boolean planes — and its counters ride the
+    metrics dict and therefore the existing ONE batched device->host
+    transfer per chunk (PR 1/PR 3 discipline): ``nonfinite_grads`` /
+    ``skipped_steps`` (the host-side divergence tripwire counts consecutive
+    ones).  Inside a scanned fused driver these are per-iteration flags
+    that the chunk-mean reduces to a fraction.
+
+    ``check_every`` (``RLArguments.nonfinite_check_every``) amortizes the
+    guard: the reduction + state select run only on steps where
+    ``state.step % check_every == 0`` (a ``lax.cond`` on the step counter —
+    the *skipped* branch is a pure pass-through, so K-1 of every K steps
+    pay nothing).  K=1 preserves the original check-every-step semantics; a
+    divergence under K>1 is caught within K-1 steps of surfacing, which the
+    tripwire's consecutive-skip window already tolerates.  States without a
+    ``step`` field fall back to checking every step.
 
     Works under ``shard_map``: gradients are psum-ed before the optimizer
     update, so every shard evaluates the same candidate state and reaches
@@ -77,22 +100,39 @@ def guard_nonfinite_updates(learn_fn: Callable) -> Callable:
     def guarded(state, *args):
         out = learn_fn(state, *args)
         new_state, metrics, aux = out[0], dict(out[1]), tuple(out[2:])
-        ok = tree_all_finite((new_state, aux))
 
-        def keep(_):
-            return new_state, aux
+        def run_check(_):
+            ok = tree_all_finite((new_state, aux))
 
-        def skip(_):
-            safe_aux = jax.tree_util.tree_map(
-                lambda x: jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
-                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
-                else x,
-                aux,
+            def keep(_):
+                return new_state, aux
+
+            def skip(_):
+                safe_aux = jax.tree_util.tree_map(
+                    lambda x: jnp.nan_to_num(
+                        x, nan=0.0, posinf=0.0, neginf=0.0
+                    )
+                    if hasattr(x, "dtype")
+                    and jnp.issubdtype(x.dtype, jnp.inexact)
+                    else x,
+                    aux,
+                )
+                return state, safe_aux
+
+            safe_state, safe_aux = jax.lax.cond(ok, keep, skip, None)
+            return safe_state, safe_aux, 1.0 - ok.astype(jnp.float32)
+
+        def pass_through(_):
+            return new_state, aux, jnp.float32(0.0)
+
+        step = getattr(state, "step", None)
+        if check_every > 1 and step is not None:
+            do_check = (step % check_every) == 0
+            safe_state, safe_aux, bad = jax.lax.cond(
+                do_check, run_check, pass_through, None
             )
-            return state, safe_aux
-
-        safe_state, safe_aux = jax.lax.cond(ok, keep, skip, None)
-        bad = 1.0 - ok.astype(jnp.float32)
+        else:
+            safe_state, safe_aux, bad = run_check(None)
         metrics["nonfinite_grads"] = bad
         metrics["skipped_steps"] = bad
         return (safe_state, metrics) + safe_aux
@@ -101,10 +141,25 @@ def guard_nonfinite_updates(learn_fn: Callable) -> Callable:
 
 
 def maybe_guard_nonfinite(learn_fn: Callable, args: Any) -> Callable:
-    """Apply :func:`guard_nonfinite_updates` unless the config disabled it
-    (``RLArguments.nonfinite_guard``, default on)."""
+    """Apply :func:`guard_nonfinite_updates` unless the config disabled it.
+
+    Two off switches, different costs: ``RLArguments.nonfinite_guard=False``
+    and the environment fast-off ``SCALERL_NONFINITE_GUARD=0`` both return
+    ``learn_fn`` untouched — the guard is *compiled out entirely* (no cond,
+    no reduction, no counters in the metrics dict), not skipped at runtime.
+    The env var exists so a bench/bisect run can toggle the guard without
+    plumbing a config change through every trainer (the r05 regression
+    bisect protocol, docs/PERFORMANCE.md).  ``nonfinite_check_every``
+    amortizes the enabled guard instead of removing it.
+    """
+    import os
+
+    if os.environ.get("SCALERL_NONFINITE_GUARD") == "0":
+        return learn_fn
     if getattr(args, "nonfinite_guard", True):
-        return guard_nonfinite_updates(learn_fn)
+        return guard_nonfinite_updates(
+            learn_fn, check_every=getattr(args, "nonfinite_check_every", 1)
+        )
     return learn_fn
 
 

@@ -53,6 +53,43 @@ class ActorCarry(NamedTuple):
     episode_count: jnp.ndarray  # [B] per-env completed-episode count
 
 
+def resolve_iter_mode(iter_mode: str = "auto") -> str:
+    """Resolve the fused loop's iteration-fusion strategy.
+
+    ``"scan"`` wraps the per-iteration (rollout + learn) body in
+    ``lax.scan`` — compile time stays flat in ``iters_per_call`` and the
+    program is small; this is the right choice on TPU/GPU.  ``"unroll"``
+    expands the iterations as a Python loop inside the one jitted program —
+    identical math, but no ``while`` wrapper in the HLO.
+
+    Why the knob exists (the r05 bench regression verdict,
+    docs/PERFORMANCE.md): XLA:CPU lowers convolution *gradient* ops inside
+    a while-loop body through a non-Eigen path that is catastrophically
+    slow — the fused IMPALA chunk measured **23.2 s wrapped in a length-1
+    ``lax.scan`` vs 0.42 s with the same body unrolled** (~55x) on this
+    repo's bench shape.  ``"auto"`` therefore picks ``"unroll"`` on the CPU
+    backend and ``"scan"`` everywhere else.  ``SCALERL_ITER_MODE`` overrides
+    what ``auto`` resolves to (escape hatch, same pattern as
+    ``SCALERL_PER_METHOD``)."""
+    import os
+
+    modes = ("scan", "unroll")
+    if iter_mode != "auto":
+        if iter_mode not in modes:
+            raise ValueError(
+                f"iter_mode must be one of {('auto',) + modes}, got {iter_mode!r}"
+            )
+        return iter_mode
+    forced = os.environ.get("SCALERL_ITER_MODE")
+    if forced:
+        if forced not in modes:
+            raise ValueError(
+                f"SCALERL_ITER_MODE={forced!r} is not one of {modes}"
+            )
+        return forced
+    return "unroll" if jax.default_backend() == "cpu" else "scan"
+
+
 class DeviceActorLearnerLoop:
     def __init__(
         self,
@@ -63,12 +100,18 @@ class DeviceActorLearnerLoop:
         iters_per_call: int = 10,
         mesh=None,
         axis_name: str = "dp",
+        iter_mode: str = "auto",
     ) -> None:
         """``mesh``: shard the fused loop data-parallel over a mesh — env
         lanes and actor carry split along ``axis_name``, params replicated,
         gradients ``psum``-ed inside the learn step (pass a ``learn_fn``
         built with ``grad_axis=axis_name``).  This is the Podracer "Anakin"
-        architecture; ``venv.num_envs`` must divide by the axis size."""
+        architecture; ``venv.num_envs`` must divide by the axis size.
+
+        ``iter_mode``: how iterations fuse into the chunk program —
+        ``"scan"`` (lax.scan body, TPU/GPU), ``"unroll"`` (Python-unrolled
+        body; recovers XLA:CPU's ~55x conv-grad-in-while-loop slowdown), or
+        ``"auto"`` (backend-resolved, see :func:`resolve_iter_mode`)."""
         self.model = model
         self.venv = venv
         self.learn_fn = learn_fn
@@ -76,6 +119,11 @@ class DeviceActorLearnerLoop:
         self.iters_per_call = iters_per_call
         self.mesh = mesh
         self.axis_name = axis_name
+        self.iter_mode = resolve_iter_mode(iter_mode)
+        # superchunk executables keyed by num_chunks (the Anakin whole-run
+        # fusion: one dispatch covers N chunks of rollout+learn)
+        self._superchunks: Dict[int, Callable] = {}
+        self._superchunk_warm: set = set()
         if mesh is None:
             self._train_many = jax.jit(
                 partial(self._train_many_impl), donate_argnums=(0, 1)
@@ -267,15 +315,152 @@ class DeviceActorLearnerLoop:
             state, metrics = self.learn_fn(state, traj)
             return (state, carry), metrics
 
-        (state, carry), metrics = jax.lax.scan(
-            one_iter, (state, carry), jax.random.split(key, self.iters_per_call)
-        )
+        keys = jax.random.split(key, self.iters_per_call)
+        if self.iter_mode == "scan":
+            (state, carry), metrics = jax.lax.scan(one_iter, (state, carry), keys)
+        else:
+            # "unroll": same iteration body, Python-expanded — no while
+            # wrapper in the HLO, so XLA:CPU's slow conv-grad-in-loop
+            # lowering is never hit (the r05 bench regression; the stacked
+            # metrics keep the scan path's exact reduction order)
+            per_iter = []
+            sc = (state, carry)
+            for i in range(self.iters_per_call):
+                sc, m = one_iter(sc, keys[i])
+                per_iter.append(m)
+            state, carry = sc
+            metrics = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_iter
+            )
         mean_metrics = {k: jnp.mean(v) for k, v in metrics.items()}
         # monitoring sums ride the fused program (shard-local here; the mesh
         # wrapper overwrites them with the psum-ed globals)
         mean_metrics["episode_return_sum"] = jnp.sum(carry.return_sum)
         mean_metrics["episode_count_sum"] = jnp.sum(carry.episode_count)
         return state, carry, mean_metrics
+
+    # ------------------------------------------------------------------
+    def _superchunk_impl(self, state, carry, key, num_chunks: int):
+        """The Anakin whole-run fusion: ``num_chunks`` chunks of
+        (rollout + V-trace learn) in ONE program.
+
+        The per-chunk key schedule replicates ``run``'s host loop exactly
+        (``key, sub = split(key)`` each chunk), so the final state and the
+        per-chunk metric stream are bitwise-comparable with the chunked
+        driver — the parity contract ``tests/test_dispatch.py`` asserts.
+        Per-chunk metric dicts come back stacked ``[num_chunks]`` and are
+        materialized by the caller with ONE batched transfer for the whole
+        super-chunk.
+        """
+
+        def one_chunk(sc, _):
+            state, carry, key = sc
+            key, sub = jax.random.split(key)
+            state, carry, m = self._train_many_impl(state, carry, sub)
+            return (state, carry, key), m
+
+        if self.iter_mode == "scan":
+            (state, carry, key), stacked = jax.lax.scan(
+                one_chunk, (state, carry, key), None, length=num_chunks
+            )
+        else:
+            per_chunk = []
+            sc = (state, carry, key)
+            for _ in range(num_chunks):
+                sc, m = one_chunk(sc, None)
+                per_chunk.append(m)
+            state, carry, key = sc
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_chunk
+            )
+        return state, carry, stacked
+
+    def train_superchunk(
+        self, state, carry, key, num_chunks: int
+    ) -> Tuple[ImpalaTrainState, ActorCarry, Dict]:
+        """One host dispatch covering ``num_chunks`` fused chunks (Anakin).
+
+        Metrics are returned as DEVICE arrays stacked ``[num_chunks]`` per
+        key — read them back with one ``dispatch.get_metrics`` call.
+        Inputs are donated, like :meth:`train_chunk`.
+        """
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "train_superchunk composes with the single-device fused "
+                "loop; the mesh path already fuses per-chunk via shard_map "
+                "(drive it through run())"
+            )
+        fn = self._superchunks.get(num_chunks)
+        if fn is None:
+            fn = jax.jit(
+                partial(self._superchunk_impl, num_chunks=num_chunks),
+                donate_argnums=(0, 1),
+            )
+            self._superchunks[num_chunks] = fn
+        return fn(state, carry, key)
+
+    def run_anakin(
+        self,
+        state: ImpalaTrainState,
+        carry: ActorCarry,
+        key: jax.Array,
+        num_calls: int,
+        on_metrics: Optional[Callable[[int, Dict[str, float]], None]] = None,
+        progress=None,
+        instrument: bool = True,
+    ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
+        """Drive ``num_calls`` chunks as ONE fused dispatch (Anakin mode).
+
+        Where :meth:`run` dispatches once per chunk and pipelines the metric
+        reads, this path dispatches once per *run*: a single jitted
+        ``lax.scan`` (or unrolled body, per ``iter_mode``) over (env step ->
+        policy -> V-trace learn) covers every chunk, and ONE batched
+        device->host transfer materializes the whole stacked metric history
+        afterwards.  Steady state (every ``run_anakin`` call after the first
+        for a given ``num_calls``) runs under the armed transfer guard.
+        ``on_metrics(i, metrics)`` fires per chunk, in order, after the
+        read — the metric stream matches :meth:`run`'s exactly.
+        """
+        guard_ctx = (
+            dispatch.steady_state_guard()
+            if num_calls in self._superchunk_warm
+            else nullcontext()
+        )
+        with guard_ctx:
+            with step_marker(0):
+                state, carry, stacked = self.train_superchunk(
+                    state, carry, key, num_calls
+                )
+            if progress is not None:
+                progress.bump()
+            host = get_metrics(stacked)  # ONE batched transfer, all chunks
+        self._superchunk_warm.add(num_calls)
+        frames_per_call = (
+            self.unroll_length * self.venv.num_envs * self.iters_per_call
+        )
+        reg = telemetry.get_registry() if instrument else None
+        metrics: Dict[str, float] = {}
+        nonfinite_chunks = 0
+        for i in range(num_calls):
+            m = {k: float(v[i]) for k, v in host.items()}
+            if reg is not None:
+                telemetry.observe_train_metrics(m)
+            if m.get("skipped_steps", 0.0) > 0.0:
+                nonfinite_chunks += 1
+            m["episodes"] = m.pop("episode_count_sum")
+            m["return_mean"] = m.pop("episode_return_sum") / max(
+                m["episodes"], 1.0
+            )
+            metrics = m
+            if on_metrics is not None:
+                on_metrics(i, m)
+        if reg is not None:
+            # per-superchunk instrument write (chunk-amortized by design)
+            reg.meter("rates.chunks_per_s").mark(num_calls)
+            reg.meter("rates.fps").mark(frames_per_call * num_calls)
+        metrics["chunks_done"] = float(num_calls)
+        metrics["nonfinite_chunks"] = float(nonfinite_chunks)
+        return state, carry, metrics
 
     # ------------------------------------------------------------------
     def train_chunk(
@@ -300,6 +485,7 @@ class DeviceActorLearnerLoop:
         chunks_in_flight: int = 2,
         progress=None,
         should_stop: Optional[Callable[[], bool]] = None,
+        instrument: bool = True,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
         """Drive fused chunks until the *windowed* mean episode return (over
         episodes completed since the previous chunk) reaches ``threshold``,
@@ -333,18 +519,22 @@ class DeviceActorLearnerLoop:
         hit = False
         nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
-        reg = telemetry.get_registry()
-        _chunk_meter = reg.meter("rates.chunks_per_s")
-        _fps_meter = reg.meter("rates.fps")
+        # instrument=False (args.telemetry_interval_s <= 0) compiles the
+        # per-chunk registry feed out of the driver entirely — no meter
+        # objects, no observe calls, not even a skipped branch per chunk
+        reg = telemetry.get_registry() if instrument else None
+        _chunk_meter = reg.meter("rates.chunks_per_s") if instrument else None
+        _fps_meter = reg.meter("rates.fps") if instrument else None
 
         def consume(ready) -> None:
             nonlocal windowed, prev_sum, prev_cnt, hit, nonfinite_chunks
             for i, m in ready:
                 # host-side registry feed (m is already host floats via the
                 # pipeline's one batched transfer — no extra device traffic)
-                telemetry.observe_train_metrics(m)
-                _chunk_meter.mark()
-                _fps_meter.mark(frames_per_call)
+                if instrument:
+                    telemetry.observe_train_metrics(m)
+                    _chunk_meter.mark()
+                    _fps_meter.mark(frames_per_call)
                 if m.get("skipped_steps", 0.0) > 0.0:
                     # guarded learn skipped >= 1 non-finite update this chunk
                     nonfinite_chunks += 1
@@ -398,6 +588,7 @@ class DeviceActorLearnerLoop:
         chunks_in_flight: int = 2,
         progress=None,
         should_stop: Optional[Callable[[], bool]] = None,
+        instrument: bool = True,
     ) -> Tuple[ImpalaTrainState, ActorCarry, Dict[str, float]]:
         """Drive ``num_calls`` fused mega-steps; one host dispatch each.
 
@@ -417,17 +608,19 @@ class DeviceActorLearnerLoop:
         nonfinite_chunks = 0
         pipe = MetricsPipeline(depth=chunks_in_flight)
         frames_per_call = self.unroll_length * self.venv.num_envs * self.iters_per_call
-        reg = telemetry.get_registry()
-        _chunk_meter = reg.meter("rates.chunks_per_s")
-        _fps_meter = reg.meter("rates.fps")
+        # see run_until: instrument=False compiles the registry feed out
+        reg = telemetry.get_registry() if instrument else None
+        _chunk_meter = reg.meter("rates.chunks_per_s") if instrument else None
+        _fps_meter = reg.meter("rates.fps") if instrument else None
 
         def consume(ready) -> None:
             nonlocal metrics, nonfinite_chunks
             for i, host_m in ready:
                 m = dict(host_m)
-                telemetry.observe_train_metrics(m)
-                _chunk_meter.mark()
-                _fps_meter.mark(frames_per_call)
+                if instrument:
+                    telemetry.observe_train_metrics(m)
+                    _chunk_meter.mark()
+                    _fps_meter.mark(frames_per_call)
                 if m.get("skipped_steps", 0.0) > 0.0:
                     nonfinite_chunks += 1
                 m["episodes"] = m.pop("episode_count_sum")

@@ -509,19 +509,20 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                     # one batched device->host transfer for the whole dict
                     # (per-key float() would pay a round trip per metric)
                     host_metrics = get_metrics(metrics)
-                    telemetry.observe_train_metrics(host_metrics)
-                    reg = telemetry.get_registry()
-                    reg.set_gauges(
-                        {**host_metrics, "sps": sps, "return_mean": ret_mean},
-                        prefix="train.",
-                    )
-                    # registry-backed write: queue occupancy and guard
-                    # counters ride alongside the learner metrics
-                    self.logger.log_registry(
-                        self.env_frames,
-                        step_type="train",
-                        include_prefixes=("train.", "queue."),
-                    )
+                    if self._instrument:
+                        telemetry.observe_train_metrics(host_metrics)
+                        reg = telemetry.get_registry()
+                        reg.set_gauges(
+                            {**host_metrics, "sps": sps, "return_mean": ret_mean},
+                            prefix="train.",
+                        )
+                        # registry-backed write: queue occupancy and guard
+                        # counters ride alongside the learner metrics
+                        self.logger.log_registry(
+                            self.env_frames,
+                            step_type="train",
+                            include_prefixes=("train.", "queue."),
+                        )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "
@@ -639,12 +640,14 @@ class DeviceActorLearnerTrainer(BaseTrainer):
             sps = (frames - done_frames) / max(time.time() - start, 1e-8)
             # registry-backed write path: m is already host floats (the
             # driver's one batched transfer per chunk); the driver also
-            # feeds train.fps/train.chunks_per_s meters
-            reg = telemetry.get_registry()
-            reg.set_gauges({**m, "sps": sps}, prefix="train.")
-            self.logger.log_registry(
-                frames, step_type="train", include_prefixes=("train.",)
-            )
+            # feeds train.fps/train.chunks_per_s meters.  Per-chunk cadence;
+            # self._instrument compiles the writes out entirely.
+            if self._instrument:
+                reg = telemetry.get_registry()
+                reg.set_gauges({**m, "sps": sps}, prefix="train.")
+                self.logger.log_registry(
+                    frames, step_type="train", include_prefixes=("train.",)
+                )
             if self.is_main_process and (i % 10 == 0 or i == num_calls - 1):
                 self.text_logger.info(
                     f"frames {frames} | sps {sps:.0f} | return {m.get('return_mean', float('nan')):.2f}"
@@ -671,6 +674,7 @@ class DeviceActorLearnerTrainer(BaseTrainer):
                     chunks_in_flight=self.chunks_in_flight,
                     progress=progress,
                     should_stop=(lambda: guard.triggered) if guard is not None else None,
+                    instrument=self._instrument,
                 )
         finally:
             if watchdog is not None:

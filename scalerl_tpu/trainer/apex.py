@@ -494,24 +494,25 @@ class ApexTrainer(BaseTrainer):
                     # registry-backed write: one batched transfer for any
                     # device scalars, then instruments are the source
                     train_info = get_metrics(train_info)
-                    telemetry.observe_train_metrics(train_info)
-                    reg = telemetry.get_registry()
-                    reg.set_gauges(train_info, prefix="train.")
-                    reg.set_gauges(summary, prefix="train.")
-                    reg.set_gauges(
-                        {
-                            "rpm_size": float(len(self.buffer)),
-                            "fps": float(fps),
-                            "learn_steps": float(self.learn_steps),
-                            "weight_version": float(self.param_server.version),
-                        },
-                        prefix="train.",
-                    )
-                    self.logger.log_registry(
-                        self.global_step,
-                        step_type="train",
-                        include_prefixes=("train.",),
-                    )
+                    if self._instrument:
+                        telemetry.observe_train_metrics(train_info)
+                        reg = telemetry.get_registry()
+                        reg.set_gauges(train_info, prefix="train.")
+                        reg.set_gauges(summary, prefix="train.")
+                        reg.set_gauges(
+                            {
+                                "rpm_size": float(len(self.buffer)),
+                                "fps": float(fps),
+                                "learn_steps": float(self.learn_steps),
+                                "weight_version": float(self.param_server.version),
+                            },
+                            prefix="train.",
+                        )
+                        self.logger.log_registry(
+                            self.global_step,
+                            step_type="train",
+                            include_prefixes=("train.",),
+                        )
                     if self.is_main_process:
                         ret = summary.get("return_mean", float("nan"))
                         self.text_logger.info(

@@ -63,9 +63,14 @@ class BaseTrainer:
 
         # telemetry plane: periodic JSONL + Prometheus exposition off the
         # process registry (runtime/telemetry.py); the same registry the
-        # interval-gated logger backends read via log_registry
+        # interval-gated logger backends read via log_registry.
+        # telemetry_interval_s <= 0 is the FAST-OFF toggle: trainers gate
+        # every registry write on self._instrument, so the instrument path
+        # is compiled out of the hot loops, not skipped at runtime
+        # (docs/PERFORMANCE.md "Guard & telemetry amortization").
         self.telemetry_export = None
         interval_s = float(getattr(args, "telemetry_interval_s", 0.0) or 0.0)
+        self._instrument = interval_s > 0
         if self.is_main_process and interval_s > 0:
             from scalerl_tpu.runtime.telemetry import (
                 TelemetryExportLoop,

@@ -61,6 +61,7 @@ class OffPolicyTrainer(BaseTrainer):
             per_alpha=args.per_alpha,
             n_step=args.n_steps,
             gamma=args.gamma,
+            use_pallas=getattr(args, "use_pallas", False),
             action_shape=action_shape,
             action_dtype=action_dtype,
         )
@@ -71,13 +72,27 @@ class OffPolicyTrainer(BaseTrainer):
         self.global_step = 0
         self.learn_steps = 0
         self.metrics = EpisodeMetrics(self.num_envs)
+        # replay sampling gets its own seeded key stream: sampling without a
+        # key falls back to global np.random (replay.py), which makes a run's
+        # batch sequence depend on whatever np.random state previous tests /
+        # callers left behind — the order-dependent flake
+        # test_td3_solves_pendulum exposed (passes in-suite, fails
+        # standalone).  Deriving from args.seed pins RNG isolation: the same
+        # seed now samples the same batches standalone and in-suite.
+        self._sample_key = jax.random.PRNGKey(args.seed + 0x53A1)
         # telemetry plane: rate meters + snapshot-time replay binding; the
         # logger's registry-backed write path reads these instead of a
-        # hand-assembled metric dict
-        reg = telemetry.get_registry()
-        self._fps_meter = reg.meter("rates.fps")
-        self._learn_meter = reg.meter("rates.learn_steps_per_s")
-        reg.bind("replay.size", lambda: len(self.sampler))
+        # hand-assembled metric dict.  telemetry_interval_s <= 0 compiles
+        # the instrument writes out entirely (no meter objects, no marks —
+        # the fast-off toggle documented in docs/PERFORMANCE.md); meters
+        # are fed once per LOG INTERVAL (chunk-amortized), never per step
+        # (self._instrument comes from BaseTrainer).
+        self._learn_marked = 0
+        if self._instrument:
+            reg = telemetry.get_registry()
+            self._fps_meter = reg.meter("rates.fps")
+            self._learn_meter = reg.meter("rates.learn_steps_per_s")
+            reg.bind("replay.size", lambda: len(self.sampler))
         # divergence tripwire: K consecutive guarded-away (non-finite) learn
         # steps restore the agent from the last good resume checkpoint
         self.tripwire = DivergenceTripwire(
@@ -108,7 +123,8 @@ class OffPolicyTrainer(BaseTrainer):
 
     def train_step(self) -> Dict[str, float]:
         beta = self.per_beta.value(self.global_step)
-        batch = self.sampler.sample(self.args.batch_size, beta=beta)
+        self._sample_key, sk = jax.random.split(self._sample_key)
+        batch = self.sampler.sample(self.args.batch_size, beta=beta, key=sk)
         inj = chaos.active()
         if inj is not None:
             # seeded NaN/Inf bursts land HERE (the sampled batch, not the
@@ -121,7 +137,6 @@ class OffPolicyTrainer(BaseTrainer):
             self.sampler.update_priorities(batch["indices"], info["td_abs"] + 1e-6)
         info.pop("td_abs", None)
         self.learn_steps += 1
-        self._learn_meter.mark()
         self.tripwire.observe(info)
         return info
 
@@ -276,27 +291,34 @@ class OffPolicyTrainer(BaseTrainer):
                 # any device scalars still un-materialized ride together
                 host_info = get_metrics(train_info)
                 train_info = host_info
-                telemetry.observe_train_metrics(host_info)
-                # registry-backed write path: instruments are the single
-                # source the logger backends read from (no hand-assembled
-                # metric dict; queue/ring/guard counters ride for free)
-                reg = telemetry.get_registry()
-                reg.set_gauges(host_info, prefix="train.")
-                reg.set_gauges(summary, prefix="train.")
-                reg.set_gauges(
-                    {
-                        "rpm_size": float(len(self.sampler)),
-                        "fps": float(fps),
-                        "learn_steps": float(self.learn_steps),
-                    },
-                    prefix="train.",
-                )
-                self._fps_meter.mark(frames_delta)
-                self.logger.log_registry(
-                    self.global_step,
-                    step_type="train",
-                    include_prefixes=("train.",),
-                )
+                if self._instrument:
+                    telemetry.observe_train_metrics(host_info)
+                    # registry-backed write path: instruments are the single
+                    # source the logger backends read from (no hand-assembled
+                    # metric dict; queue/ring/guard counters ride for free).
+                    # All marks are interval-deltas — per-chunk cadence, the
+                    # per-step write path no longer exists.
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(host_info, prefix="train.")
+                    reg.set_gauges(summary, prefix="train.")
+                    reg.set_gauges(
+                        {
+                            "rpm_size": float(len(self.sampler)),
+                            "fps": float(fps),
+                            "learn_steps": float(self.learn_steps),
+                        },
+                        prefix="train.",
+                    )
+                    self._fps_meter.mark(frames_delta)
+                    self._learn_meter.mark(
+                        self.learn_steps - self._learn_marked
+                    )
+                    self._learn_marked = self.learn_steps
+                    self.logger.log_registry(
+                        self.global_step,
+                        step_type="train",
+                        include_prefixes=("train.",),
+                    )
                 if self.is_main_process:
                     ret = summary.get("return_mean", float("nan"))
                     self.text_logger.info(

@@ -184,18 +184,21 @@ class OnPolicyTrainer(BaseTrainer):
                 )
                 summary = self.metrics.summary()
                 # one batched transfer, then the registry-backed write path
+                # (per log interval — chunk cadence; self._instrument is the
+                # telemetry_interval_s<=0 fast-off)
                 train_info = get_metrics(train_info)
-                telemetry.observe_train_metrics(train_info)
-                reg = telemetry.get_registry()
-                reg.set_gauges(train_info, prefix="train.")
-                reg.set_gauges(summary, prefix="train.")
-                reg.set_gauges(
-                    {"fps": float(fps), "learn_steps": float(self.learn_steps)},
-                    prefix="train.",
-                )
-                self.logger.log_registry(
-                    self.global_step, step_type="train", include_prefixes=("train.",)
-                )
+                if self._instrument:
+                    telemetry.observe_train_metrics(train_info)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(train_info, prefix="train.")
+                    reg.set_gauges(summary, prefix="train.")
+                    reg.set_gauges(
+                        {"fps": float(fps), "learn_steps": float(self.learn_steps)},
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.global_step, step_type="train", include_prefixes=("train.",)
+                    )
                 if self.is_main_process:
                     ret = summary.get("return_mean", float("nan"))
                     self.text_logger.info(

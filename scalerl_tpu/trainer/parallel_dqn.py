@@ -338,17 +338,18 @@ class ParallelDQNTrainer(BaseTrainer):
                     from scalerl_tpu.runtime.dispatch import get_metrics
 
                     host_info = get_metrics(info)
-                    telemetry.observe_train_metrics(host_info)
-                    reg = telemetry.get_registry()
-                    reg.set_gauges(
-                        {**host_info, "sps": sps, "return_mean": ret},
-                        prefix="train.",
-                    )
-                    self.logger.log_registry(
-                        self.env_steps,
-                        step_type="train",
-                        include_prefixes=("train.", "ring."),
-                    )
+                    if self._instrument:
+                        telemetry.observe_train_metrics(host_info)
+                        reg = telemetry.get_registry()
+                        reg.set_gauges(
+                            {**host_info, "sps": sps, "return_mean": ret},
+                            prefix="train.",
+                        )
+                        self.logger.log_registry(
+                            self.env_steps,
+                            step_type="train",
+                            include_prefixes=("train.", "ring."),
+                        )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"steps {self.env_steps} | sps {sps:.0f} | "

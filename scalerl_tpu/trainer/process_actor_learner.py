@@ -561,18 +561,19 @@ class ProcessActorLearnerTrainer(BaseTrainer):
                     from scalerl_tpu.runtime.dispatch import get_metrics
 
                     host_info = get_metrics(metrics)
-                    telemetry.observe_train_metrics(host_info)
-                    reg = telemetry.get_registry()
-                    reg.set_gauges(
-                        {**host_info, "sps": sps, "return_mean": ret,
-                         "weights_lag": self._lag},
-                        prefix="train.",
-                    )
-                    self.logger.log_registry(
-                        self.env_frames,
-                        step_type="train",
-                        include_prefixes=("train.", "ring."),
-                    )
+                    if self._instrument:
+                        telemetry.observe_train_metrics(host_info)
+                        reg = telemetry.get_registry()
+                        reg.set_gauges(
+                            {**host_info, "sps": sps, "return_mean": ret,
+                             "weights_lag": self._lag},
+                            prefix="train.",
+                        )
+                        self.logger.log_registry(
+                            self.env_frames,
+                            step_type="train",
+                            include_prefixes=("train.", "ring."),
+                        )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "

@@ -286,17 +286,18 @@ class R2D2Trainer(HostPlaneMixin, BaseTrainer):
                     ret_mean = float(np.mean(rets)) if rets else float("nan")
                     # one batched device->host transfer for the whole dict
                     host_metrics = get_metrics(metrics)
-                    telemetry.observe_train_metrics(host_metrics)
-                    reg = telemetry.get_registry()
-                    reg.set_gauges(
-                        {**host_metrics, "sps": sps, "return_mean": ret_mean},
-                        prefix="train.",
-                    )
-                    self.logger.log_registry(
-                        self.env_frames,
-                        step_type="train",
-                        include_prefixes=("train.", "queue."),
-                    )
+                    if self._instrument:
+                        telemetry.observe_train_metrics(host_metrics)
+                        reg = telemetry.get_registry()
+                        reg.set_gauges(
+                            {**host_metrics, "sps": sps, "return_mean": ret_mean},
+                            prefix="train.",
+                        )
+                        self.logger.log_registry(
+                            self.env_frames,
+                            step_type="train",
+                            include_prefixes=("train.", "queue."),
+                        )
                     if self.is_main_process:
                         self.text_logger.info(
                             f"frames {self.env_frames} | sps {sps:.0f} | "

@@ -495,16 +495,18 @@ class DeviceR2D2Trainer(BaseTrainer):
                     windowed = (s - prev_sum) / (c - prev_cnt)
                     prev_sum, prev_cnt = s, c
                 # registry-backed write path off the same host dict (the
-                # guard counters fold into train.skipped_steps etc.)
-                telemetry.observe_train_metrics(host)
-                reg = telemetry.get_registry()
-                reg.set_gauges(
-                    {**host, "return_windowed": windowed, "eps": eps},
-                    prefix="train.",
-                )
-                self.logger.log_registry(
-                    self.env_frames, step_type="train", include_prefixes=("train.",)
-                )
+                # guard counters fold into train.skipped_steps etc.);
+                # per-chunk cadence, compiled out when telemetry is off
+                if self._instrument:
+                    telemetry.observe_train_metrics(host)
+                    reg = telemetry.get_registry()
+                    reg.set_gauges(
+                        {**host, "return_windowed": windowed, "eps": eps},
+                        prefix="train.",
+                    )
+                    self.logger.log_registry(
+                        self.env_frames, step_type="train", include_prefixes=("train.",)
+                    )
                 if self.is_main_process:
                     self.text_logger.info(
                         f"frames {self.env_frames} | eps {eps:.2f} | "

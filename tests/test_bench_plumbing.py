@@ -40,9 +40,10 @@ class _FakeChild:
     """Scripted stand-in for the measurement subprocess: backend ack,
     then micro line, then headline line, arriving over time."""
 
-    def __init__(self, cpu, mesh_spec=None, fast=None, learn=False):
+    def __init__(self, cpu, mesh_spec=None, fast=None, learn=False, mode=None):
         self.cpu = cpu
         self.fast = fast
+        self.mode = mode
         self.lines = []
         self.proc = type(
             "P", (),
@@ -117,8 +118,8 @@ def test_cpu_backend_falls_through_to_pinned_cpu_child():
     bench = _load_bench()
 
     class CpuAckChild(_FakeChild):
-        def __init__(self, cpu, mesh_spec=None, fast=None, learn=False):
-            super().__init__(True, mesh_spec, fast, learn)
+        def __init__(self, cpu, mesh_spec=None, fast=None, learn=False, mode=None):
+            super().__init__(True, mesh_spec, fast, learn, mode)
             if not cpu:
                 self.lines = ["backend: cpu"]
             else:

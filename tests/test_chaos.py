@@ -632,3 +632,74 @@ def test_chaos_matrix_nan_burst_run_finishes_finite(tmp_path, monkeypatch):
     assert trainer.tripwire.trips >= 1
     trainer.close()
     envs.close()
+
+
+def test_nonfinite_score_is_single_fused_reduction():
+    """The guard's verdict primitive: 0.0 for all-finite trees, NaN when
+    any inexact leaf holds NaN/Inf; int leaves are ignored."""
+    from scalerl_tpu.parallel.train_step import nonfinite_score, tree_all_finite
+
+    good = {"a": jnp.ones((4, 4)), "b": jnp.zeros(3), "n": jnp.arange(5)}
+    assert float(nonfinite_score(good)) == 0.0
+    assert bool(tree_all_finite(good))
+    for poison in (np.nan, np.inf, -np.inf):
+        bad = {**good, "b": jnp.array([1.0, poison, 2.0])}
+        assert not np.isfinite(float(nonfinite_score(bad)))
+        assert not bool(tree_all_finite(bad))
+    # int-only trees are trivially finite
+    assert bool(tree_all_finite({"n": jnp.arange(3)}))
+
+
+def test_guard_check_every_amortizes_on_step_counter():
+    """check_every=K: the reduction + select run only when state.step % K
+    == 0.  On checked steps a bad update is skipped (state preserved); on
+    unchecked steps it passes through uninspected — the documented trade:
+    the divergence is then *detected* at the next checked step (skip fires
+    on the propagated non-finite state) and the tripwire handles recovery."""
+    from flax import struct
+
+    from scalerl_tpu.parallel.train_step import guard_nonfinite_updates
+
+    @struct.dataclass
+    class S:
+        p: jnp.ndarray
+        step: jnp.ndarray
+
+    def learn(state, batch):
+        new = S(p=state.p + batch, step=state.step + 1)
+        return new, {"loss": jnp.sum(batch)}
+
+    guarded = jax.jit(guard_nonfinite_updates(learn, check_every=2))
+    st = S(p=jnp.ones(3), step=jnp.int32(0))
+    # step 0 (checked): bad update skipped, state kept
+    st, m = guarded(st, jnp.array([np.nan, 0.0, 0.0]))
+    assert float(m["skipped_steps"]) == 1.0
+    np.testing.assert_allclose(np.asarray(st.p), 1.0)
+    assert int(st.step) == 0  # the whole candidate (incl. counter) dropped
+    # force an odd step so the next call is unchecked
+    st = S(p=st.p, step=jnp.int32(1))
+    st, m = guarded(st, jnp.array([np.nan, 0.0, 0.0]))
+    assert float(m["skipped_steps"]) == 0.0  # uninspected pass-through
+    assert not np.all(np.isfinite(np.asarray(st.p)))  # poison went through
+    # next step is checked: the propagated NaN is detected and skip fires
+    st, m = guarded(st, jnp.zeros(3))
+    assert float(m["skipped_steps"]) == 1.0
+
+
+def test_guard_env_fast_off_compiles_out(monkeypatch):
+    """SCALERL_NONFINITE_GUARD=0 returns the raw learn fn — the guard is
+    compiled out entirely, even with nonfinite_guard=True in the config."""
+    from dataclasses import dataclass
+
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    @dataclass
+    class A:
+        nonfinite_guard: bool = True
+        nonfinite_check_every: int = 1
+
+    fn = lambda s, b: (s, {})  # noqa: E731
+    monkeypatch.setenv("SCALERL_NONFINITE_GUARD", "0")
+    assert maybe_guard_nonfinite(fn, A()) is fn
+    monkeypatch.delenv("SCALERL_NONFINITE_GUARD")
+    assert maybe_guard_nonfinite(fn, A()) is not fn

@@ -304,3 +304,164 @@ def test_pipelined_drive_helper():
     # was already in flight and still drained
     assert n == 4
     assert seen == [(0, 0.0), (1, 1.0), (2, 2.0), (3, 3.0)]
+
+
+# ---------------------------------------------------------------------------
+# iter_mode (the r05 regression fix) + the Anakin single-dispatch driver
+
+
+def test_resolve_iter_mode(monkeypatch):
+    from scalerl_tpu.runtime.device_loop import resolve_iter_mode
+
+    # explicit pins always win
+    assert resolve_iter_mode("scan") == "scan"
+    assert resolve_iter_mode("unroll") == "unroll"
+    with pytest.raises(ValueError):
+        resolve_iter_mode("bogus")
+    # auto resolves per backend: CPU unrolls (XLA:CPU's conv-grad-in-while
+    # slow path), accelerators scan
+    expect = "unroll" if jax.default_backend() == "cpu" else "scan"
+    assert resolve_iter_mode("auto") == expect
+    # env escape hatch overrides auto but not explicit pins
+    monkeypatch.setenv("SCALERL_ITER_MODE", "scan")
+    assert resolve_iter_mode("auto") == "scan"
+    assert resolve_iter_mode("unroll") == "unroll"
+    monkeypatch.setenv("SCALERL_ITER_MODE", "bogus")
+    with pytest.raises(ValueError):
+        resolve_iter_mode("auto")
+
+
+def _make_loop_mode(iter_mode, iters_per_call=2, T=4, B=4):
+    args = ImpalaArguments(
+        env_id="CartPole-v1", rollout_length=T, batch_size=B,
+        use_lstm=False, hidden_size=32, logger_backend="none",
+    )
+    venv = make_jax_vec_env("CartPole-v1", num_envs=B)
+    agent = ImpalaAgent(args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32)
+    learn = make_impala_learn_fn(agent.model, agent.optimizer, args)
+    loop = DeviceActorLearnerLoop(
+        agent.model, venv, learn, T, iters_per_call=iters_per_call,
+        iter_mode=iter_mode,
+    )
+    return loop, agent
+
+
+def test_iter_mode_scan_unroll_parity():
+    """The unrolled chunk body is the same math as the scanned one: same
+    final params and same per-chunk metric stream."""
+    results = {}
+    for mode in ("scan", "unroll"):
+        loop, agent = _make_loop_mode(mode)
+        stream = []
+        state, carry, metrics = loop.run(
+            _fresh_state(agent),
+            loop.init_carry(jax.random.PRNGKey(1)),
+            jax.random.PRNGKey(2),
+            num_calls=3,
+            on_metrics=lambda i, m: stream.append((i, dict(m))),
+            chunks_in_flight=1,
+        )
+        results[mode] = (state, stream)
+    s_scan, stream_scan = results["scan"]
+    s_unroll, stream_unroll = results["unroll"]
+    assert [i for i, _ in stream_scan] == [i for i, _ in stream_unroll]
+    for (_, a), (_, b) in zip(stream_scan, stream_unroll):
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6, err_msg=k)
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(s_scan.params),
+        jax.tree_util.tree_leaves(s_unroll.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(pa), np.asarray(pb), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_anakin_parity_with_chunked_driver():
+    """run_anakin(N) — ONE dispatch covering N chunks — produces the same
+    final params and the same per-chunk metric stream as the existing
+    chunked driver run(num_calls=N)."""
+    loop, agent = _make_loop()
+    num_calls = 4
+    s_run, m_run, stream_run = _run_stream(loop, agent, num_calls, 1)
+    stream_anakin = []
+    s_ana, carry, m_ana = loop.run_anakin(
+        _fresh_state(agent),
+        loop.init_carry(jax.random.PRNGKey(1)),
+        jax.random.PRNGKey(2),
+        num_calls=num_calls,
+        on_metrics=lambda i, m: stream_anakin.append((i, dict(m))),
+    )
+    assert [i for i, _ in stream_run] == [i for i, _ in stream_anakin]
+    for (_, a), (_, b) in zip(stream_run, stream_anakin):
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6, err_msg=k)
+    assert int(s_run.step) == int(s_ana.step) == num_calls * loop.iters_per_call
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(s_run.params),
+        jax.tree_util.tree_leaves(s_ana.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(pa), np.asarray(pb), rtol=1e-5, atol=1e-6
+        )
+    assert m_ana["chunks_done"] == float(num_calls)
+
+
+def test_anakin_one_dispatch_one_transfer_under_guard(monkeypatch):
+    """The Anakin invariant, all three halves: N chunks cost ONE batched
+    device->host transfer, the warm path runs under the armed
+    steady_state_guard, and the guard admits that one explicit transfer."""
+    loop, agent = _make_loop()
+    num_calls = 3
+
+    def drive():
+        return loop.run_anakin(
+            _fresh_state(agent),
+            loop.init_carry(jax.random.PRNGKey(1)),
+            jax.random.PRNGKey(2),
+            num_calls=num_calls,
+        )
+
+    drive()  # warm: compile exemption, like run()'s chunk 0
+
+    calls = []
+    real_get = dispatch._device_get
+    monkeypatch.setattr(
+        dispatch, "_device_get", lambda t: (calls.append(t), real_get(t))[1]
+    )
+    entered = []
+    real_guard = dispatch.steady_state_guard
+
+    def counting_guard():
+        entered.append(True)
+        return real_guard()
+
+    import scalerl_tpu.runtime.device_loop as dl_mod
+
+    monkeypatch.setattr(dl_mod.dispatch, "steady_state_guard", counting_guard)
+    drive()
+    assert len(entered) == 1  # whole warm superchunk under the armed guard
+    assert len(calls) == 1  # ONE batched get covers all N chunks
+
+
+def test_run_instrument_off_skips_registry_feed():
+    """instrument=False (telemetry_interval_s <= 0) compiles the per-chunk
+    registry feed out of the driver: no meters are created, nothing is
+    observed."""
+    from scalerl_tpu.runtime import telemetry
+
+    telemetry.reset()
+    loop, agent = _make_loop()
+    _, _, metrics = loop.run(
+        _fresh_state(agent),
+        loop.init_carry(jax.random.PRNGKey(1)),
+        jax.random.PRNGKey(2),
+        num_calls=2,
+        instrument=False,
+    )
+    snap = telemetry.get_registry().snapshot()
+    assert "rates" not in snap  # no fps/chunk meters were ever registered
+    assert metrics["chunks_done"] == 2.0
+    telemetry.reset()

@@ -327,3 +327,84 @@ def test_c51_loss_and_q_values(rng):
     q = categorical_q_values(logits, support)
     probs = np_softmax(np.asarray(logits))
     np.testing.assert_allclose(np.asarray(q), (probs * np.asarray(support)).sum(-1), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas V-trace kernel (ops/pallas_vtrace.py) vs the reference op
+
+
+def _vtrace_inputs(rng, T=20, B=8):
+    return dict(
+        log_rhos=jnp.asarray(rng.normal(size=(T, B)) * 0.4, jnp.float32),
+        discounts=jnp.asarray(
+            0.99 * (rng.uniform(size=(T, B)) > 0.1), jnp.float32
+        ),
+        rewards=jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+        values=jnp.asarray(rng.normal(size=(T, B)), jnp.float32),
+        bootstrap_value=jnp.asarray(rng.normal(size=(B,)), jnp.float32),
+    )
+
+
+def test_vtrace_pallas_matches_reference(rng):
+    """The acceptance tolerance: fused kernel within 1e-5 of the scan
+    reference in interpret mode, across clip configurations."""
+    from scalerl_tpu.ops.pallas_vtrace import (
+        vtrace_from_importance_weights_pallas,
+    )
+    from scalerl_tpu.ops.vtrace import vtrace_from_importance_weights
+
+    inp = _vtrace_inputs(rng)
+    for clips in (
+        {},
+        {"clip_rho_threshold": 2.0, "clip_c_threshold": 1.5},
+        {"clip_rho_threshold": None, "clip_pg_rho_threshold": None},
+    ):
+        ref = vtrace_from_importance_weights(**inp, **clips)
+        pal = vtrace_from_importance_weights_pallas(**inp, **clips)
+        np.testing.assert_allclose(
+            np.asarray(ref.vs), np.asarray(pal.vs), atol=1e-5, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref.pg_advantages), np.asarray(pal.pg_advantages),
+            atol=1e-5, rtol=1e-5,
+        )
+
+
+def test_vtrace_impl_dispatch(rng):
+    """impl='pallas' routes through the kernel from the public entry points
+    (the RLArguments.use_pallas selection path) and stays jit/grad-safe."""
+    from scalerl_tpu.ops.vtrace import (
+        vtrace_from_importance_weights,
+        vtrace_from_logits,
+    )
+
+    inp = _vtrace_inputs(rng, T=6, B=4)
+    ref = vtrace_from_importance_weights(**inp)
+    pal = vtrace_from_importance_weights(**inp, impl="pallas")
+    np.testing.assert_allclose(
+        np.asarray(ref.vs), np.asarray(pal.vs), atol=1e-5
+    )
+    with pytest.raises(ValueError):
+        vtrace_from_importance_weights(**inp, impl="bogus")
+
+    T, B, A = 6, 4, 3
+    logits_b = jnp.asarray(rng.normal(size=(T, B, A)), jnp.float32)
+    logits_t = jnp.asarray(rng.normal(size=(T, B, A)), jnp.float32)
+    actions = jnp.asarray(rng.integers(0, A, size=(T, B)), jnp.int32)
+    common = dict(
+        behavior_logits=logits_b, target_logits=logits_t, actions=actions,
+        discounts=inp["discounts"], rewards=inp["rewards"],
+        values=inp["values"], bootstrap_value=inp["bootstrap_value"],
+    )
+    ref = vtrace_from_logits(**common)
+    pal = jax.jit(lambda: vtrace_from_logits(**common, impl="pallas"))()
+    np.testing.assert_allclose(np.asarray(ref.vs), np.asarray(pal.vs), atol=1e-5)
+
+    # grad-safety: V-trace outputs are stop_gradient-ed constants, so a loss
+    # through the pallas impl differentiates cleanly w.r.t. the logits
+    def loss(lt):
+        out = vtrace_from_logits(**{**common, "target_logits": lt}, impl="pallas")
+        return jnp.sum(out.pg_advantages * jax.nn.log_softmax(lt).sum(-1))
+
+    g = jax.grad(loss)(logits_t)
+    assert np.all(np.isfinite(np.asarray(g)))

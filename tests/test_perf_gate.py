@@ -1,0 +1,93 @@
+"""Perf-regression gate + bench history plumbing (ISSUE 6 satellites).
+
+Covers:
+- ``bench.load_bench_history`` parses the committed ``BENCH_r0N.json``
+  driver artifacts (concatenated JSON objects, rounds without a parsed
+  measurement skipped);
+- ``tools.tpu_watch.perf_gate_verdict`` fails a >20% fps/chip drop against
+  the history median the way a lint finding fails the payload step;
+- ``bench._measured_drift`` attaches the measured-window drift warning
+  (the r05 "75 s vs 38 s at identical batch/unroll" symptom) without
+  touching the fps number.
+
+jax-free: these run in tier-1 for pennies.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from bench import _measured_drift, load_bench_history  # noqa: E402
+from tools.tpu_watch import perf_gate_verdict  # noqa: E402
+
+
+def test_load_bench_history_parses_committed_artifacts():
+    hist = load_bench_history(REPO)
+    # the committed history has the r02-r04 plateau and the r05 drop
+    values = [
+        h["value"]
+        for h in hist
+        if h["metric"] == "impala_atari_env_frames_per_sec_per_chip"
+    ]
+    assert len(values) >= 4
+    assert 6.4 in values  # the r05 regression datapoint
+    assert any(v >= 12.0 for v in values)  # the plateau
+
+
+def test_load_bench_history_concatenated_objects(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": {"metric": "m", "value": 10.0}})
+        + json.dumps({"n": 2, "parsed": None})
+        + json.dumps({"n": 3, "parsed": {"metric": "m", "value": 12.0}})
+    )
+    hist = load_bench_history(tmp_path)
+    assert [h["value"] for h in hist] == [10.0, 12.0]
+
+
+def test_perf_gate_verdict_fails_large_drop():
+    history = [12.7, 12.4, 12.5]
+    ok, median = perf_gate_verdict(6.4, history)
+    assert median == 12.5
+    assert not ok  # the r05 regression would have failed the step
+    ok, _ = perf_gate_verdict(11.0, history)
+    assert ok  # within 20% of the median passes
+    ok, _ = perf_gate_verdict(275.0, history)
+    assert ok  # recoveries obviously pass
+    # zero/missing rounds are filtered; no history at all passes
+    ok, median = perf_gate_verdict(5.0, [0.0, None])
+    assert ok and median is None
+
+
+def test_measured_drift_warning_fields():
+    # shaped like the committed history rows (batch 8 / unroll 20 / cpu)
+    result = {
+        "metric": "impala_atari_env_frames_per_sec_per_chip",
+        "value": 6.4,
+        "device_kind": "cpu",
+        "batch": 8,
+        "unroll": 20,
+        "measured_s": 75.2,
+    }
+    _measured_drift(result)
+    drift = result.get("measured_s_drift")
+    assert drift is not None  # 75.2 vs the ~38 s history median
+    assert drift["ratio"] > 1.5
+    # a window matching history stays clean
+    ok_result = {**result, "measured_s": 38.5}
+    ok_result.pop("measured_s_drift", None)
+    _measured_drift(ok_result)
+    assert "measured_s_drift" not in ok_result
+    # unknown shapes (no history) never warn
+    other = {
+        "metric": "impala_atari_env_frames_per_sec_per_chip",
+        "value": 1.0,
+        "device_kind": "tpu v99",
+        "batch": 4096,
+        "unroll": 20,
+        "measured_s": 500.0,
+    }
+    _measured_drift(other)
+    assert "measured_s_drift" not in other

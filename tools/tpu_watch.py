@@ -140,6 +140,85 @@ def _telemetry_marker(telem_dir: str, bl) -> str:
         return ""
 
 
+def perf_gate_verdict(
+    new_value: float, prior_values, threshold: float = 0.2
+):
+    """The perf-regression gate: fail on a >``threshold`` drop vs history.
+
+    ``prior_values``: fps/chip numbers from the committed ``BENCH_r0N.json``
+    history (zeros/missing rounds already filtered).  Returns
+    ``(ok, median)`` — ``ok`` is True when there is no usable history or
+    the new value is within ``threshold`` of the median.  A slowdown fails
+    the payload step the same way a lint finding does (ISSUE 6 satellite).
+    """
+    vals = sorted(v for v in prior_values if v and v > 0)
+    if not vals:
+        return True, None
+    median = vals[len(vals) // 2]
+    return new_value >= (1.0 - threshold) * median, median
+
+
+def _bench_history_values(metric: str):
+    """fps values for ``metric`` from the committed bench history,
+    excluding alternate-mode rows (anakin runs carry a ``mode`` field and
+    gate only against other anakin runs)."""
+    sys.path.insert(0, REPO)
+    try:
+        from bench import load_bench_history
+    finally:
+        sys.path.remove(REPO)
+    return [
+        float(h.get("value") or 0.0)
+        for h in load_bench_history(REPO)
+        if h.get("metric") == metric and "mode" not in h
+    ]
+
+
+def _perf_gate_marker(bl, start_offset: int) -> str:
+    """Gate a bench step's result against the BENCH_r0N history.
+
+    Scans the step's log segment for its JSON result line; when the
+    fps/chip metric dropped >20% below the median of the committed prior
+    rounds, returns a ``+perf-drop(...)`` marker — ``run_payload`` turns
+    that into a FAILED outcome (excluded from the witness quorum), so a
+    perf regression blocks the payload step exactly like a lint finding.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        result = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "impala_atari_env_frames_per_sec_per_chip":
+                result = obj
+        if not result or not result.get("value"):
+            return ""
+        if "mode" in result:  # anakin etc.: no committed history yet
+            return ""
+        ok, median = perf_gate_verdict(
+            float(result["value"]),
+            _bench_history_values(result["metric"]),
+        )
+        if ok or median is None:
+            return ""
+        bl.write(
+            f"[watcher] PERF GATE: {result['value']} fps/chip is >20% below "
+            f"the committed history median {median} — failing the step\n"
+        )
+        return f"+perf-drop({result['value']}<0.8x{median})"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] perf gate failed: {e}\n")
+        return ""
+
+
 def _run_step(cmd, env, bl, timeout_s: float) -> str:
     """Run one payload step; on timeout SIGTERM first (bench.py's handler
     prints its banked JSON and reaps its JAX children — a straight SIGKILL
@@ -203,6 +282,11 @@ def run_payload(n_devices: int = 1) -> None:
         # try more lanes (banked to BENCH_TPU.md like any TPU success)
         ("bench-B1024", [sys.executable, "bench.py"], 1500,
          dict(env, BENCH_B="1024", BENCH_SKIP_MICRO="1")),
+        # Anakin whole-run fusion: one dispatch covers a super-chunk of
+        # rollout+learn chunks with the transfer guard armed; reports its
+        # own MFU from the super-chunk executable's cost analysis
+        ("bench-anakin", [sys.executable, "bench.py", "--mode", "anakin"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
         ("bench-learn", [sys.executable, "bench.py", "--learn"], 1500, env),
@@ -235,7 +319,15 @@ def run_payload(n_devices: int = 1) -> None:
             os.makedirs(telem_dir, exist_ok=True)
             step_env = dict(step_env, SCALERL_TELEMETRY_DIR=telem_dir)
             try:
+                step_start = bl.tell()
                 status = _run_step(cmd, step_env, bl, tmo)
+                if name.startswith("bench") and status == "ok":
+                    # perf-regression gate: a >20% fps/chip drop vs the
+                    # committed BENCH history fails the step like a lint
+                    # finding (and drops it from the witness quorum)
+                    gate = _perf_gate_marker(bl, step_start)
+                    if gate:
+                        status = "FAILED" + gate
                 outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
