@@ -296,6 +296,143 @@ def _run_learn_measurement() -> None:
     print(json.dumps(result), flush=True)
 
 
+def _run_sharded_measurement(mesh_spec: str | None) -> None:
+    """``--mode sharded``: the dp×mp pjit train step on the transformer
+    policy — the big-model learner plane's headline number.
+
+    Builds an IMPALA learn step over ``TransformerPolicyNet`` with the
+    policy's heads/mlp/vocab dims sharded over the named ``mp`` axis
+    (``parallel/logical.py`` rules), activations constrained batch-over-dp,
+    and the state donated; measures train frames/sec and MFU from the
+    pjit executable's own cost analysis.  The artifact carries
+    ``params_total`` / ``params_per_chip`` / ``mesh`` so the tpu_watch
+    perf gate compares like-for-like across mesh shapes: a dp=8 number
+    never gates a dp=4,mp=2 run.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.data.trajectory import Trajectory
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+    n_dev = len(jax.devices())
+
+    spec = mesh_spec or os.environ.get("BENCH_SHARD_MESH")
+    if not spec:
+        mp = 2 if n_dev % 2 == 0 and n_dev >= 2 else 1
+        spec = f"dp={n_dev // mp},mp={mp}" if mp > 1 else f"dp={n_dev}"
+    dp = _mesh_axis(spec, "dp")
+
+    # model sized to make the matmuls the story on accelerators; the CPU
+    # fallback proves the code path at toy scale
+    if on_accel:
+        T, B_chip = 16, 8
+        d_model, n_layers, n_heads = 1024, 8, 16
+    else:
+        T, B_chip = 8, 2
+        d_model, n_layers, n_heads = 64, 2, 4
+    B = B_chip * dp
+    args = ImpalaArguments(
+        policy_arch="transformer",
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+        bf16_params=on_accel,
+        rollout_length=T, batch_size=B, use_lstm=False, max_timesteps=0,
+        num_actors=1, num_buffers=2,
+    )
+    obs_dim = 64
+    agent = ImpalaAgent(
+        args, obs_shape=(obs_dim,), num_actions=16, obs_dtype=jnp.float32
+    )
+    agent.enable_mesh(spec)
+
+    key = jax.random.PRNGKey(0)
+    traj = agent._shard_batch(Trajectory(
+        obs=jax.random.normal(key, (T + 1, B, obs_dim), jnp.float32),
+        action=jax.random.randint(key, (T + 1, B), 0, 16, jnp.int32),
+        reward=jax.random.normal(key, (T + 1, B), jnp.float32),
+        done=jnp.zeros((T + 1, B), jnp.bool_),
+        logits=jax.random.normal(key, (T + 1, B, 16), jnp.float32),
+        core_state=(),
+    ))
+
+    def _leaf_elems(x):
+        return int(np.prod(x.shape)) if hasattr(x, "shape") else 0
+
+    def _leaf_local_elems(x):
+        if not hasattr(x, "sharding"):
+            return _leaf_elems(x)
+        return int(np.prod(x.sharding.shard_shape(x.shape)))
+
+    p_leaves = jax.tree_util.tree_leaves(agent.state.params)
+    params_total = sum(_leaf_elems(x) for x in p_leaves)
+    params_per_chip = sum(_leaf_local_elems(x) for x in p_leaves)
+
+    flops_per_step = None
+    run_fn = agent._learn
+    try:
+        compiled = agent._learn.lower(agent.state, traj).compile()
+        run_fn = compiled
+        flops_per_step = _cost_analysis_flops(compiled)
+    except Exception:  # noqa: BLE001 — jit path still measures, no MFU
+        pass
+
+    state, m = run_fn(agent.state, traj)
+    float(m["total_loss"])  # host-fetch sync (tunnel-safe warmup barrier)
+
+    from scalerl_tpu.runtime.dispatch import MetricsPipeline
+
+    target_s = 15.0 if on_accel else 4.0
+    pipe = MetricsPipeline(depth=2)
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < target_s or steps < 2:
+        state, m = run_fn(state, traj)
+        steps += 1
+        pipe.push(steps, m)
+    pipe.drain()
+    elapsed = time.perf_counter() - t0
+    frames = steps * T * B
+    result = {
+        "metric": "sharded_train_step_frames_per_sec",
+        "mode": "sharded",
+        "value": round(frames / elapsed, 1),
+        "unit": f"train frames/sec ({platform}, mesh {spec})",
+        "mesh": spec,
+        "device_kind": device_kind,
+        "batch": B,
+        "unroll": T,
+        "d_model": d_model,
+        "num_layers": n_layers,
+        "params_total": params_total,
+        "params_per_chip": params_per_chip,
+        "steps_per_sec": round(steps / elapsed, 2),
+        "measured_s": round(elapsed, 1),
+    }
+    if flops_per_step is not None:
+        achieved = flops_per_step * steps / elapsed
+        result["achieved_tflops_per_s"] = round(achieved / 1e12, 2)
+        peak = _peak_flops(device_kind)
+        if peak is not None:
+            # fleet MFU: achieved FLOPs/s over the peak of ALL chips in the
+            # mesh — the per-chip utilization figure for the sharded step
+            result["mfu"] = round(achieved / (peak * n_dev), 4)
+    print(json.dumps(result))
+
+
+def _mesh_axis(mesh_spec: str, axis: str) -> int:
+    import re as _re
+
+    m = _re.search(rf"{axis}=(\d+)", mesh_spec or "")
+    return int(m.group(1)) if m else 1
+
+
 def _run_measurement(
     mesh_spec: str | None = None, fast: str | None = None,
     mode: str | None = None,
@@ -329,6 +466,12 @@ def _run_measurement(
     from scalerl_tpu.envs.jax_envs.synthetic import SyntheticPixelEnv
     from scalerl_tpu.runtime.device_loop import DeviceActorLearnerLoop
     from scalerl_tpu.utils.platform import setup_platform
+
+    if mode == "sharded":
+        # its own program entirely (dp×mp pjit train step on the
+        # transformer policy); prints backend + one JSON line itself
+        _run_sharded_measurement(mesh_spec)
+        return
 
     # backend already pinned by __main__ when --cpu; "auto" here just turns
     # on the persistent compilation cache (warm relaunches skip the 20-40 s
@@ -625,7 +768,12 @@ class _Child:
             env["JAX_PLATFORMS"] = "cpu"
             flags = env.get("XLA_FLAGS", "")
             if "xla_force_host_platform_device_count" not in flags:
-                n = _mesh_device_total(mesh_spec) if mesh_spec else 1
+                if mesh_spec:
+                    n = _mesh_device_total(mesh_spec)
+                elif mode == "sharded":
+                    n = 8  # default dp=4,mp=2 virtual mesh for the CPU path
+                else:
+                    n = 1
                 env["XLA_FLAGS"] = (
                     flags + f" --xla_force_host_platform_device_count={n}"
                 ).strip()
@@ -731,6 +879,7 @@ def main(
     # a bogus zero datapoint under the flagship metric
     fail_metric = (
         "impala_learn_step_frames_per_sec" if learn
+        else "sharded_train_step_frames_per_sec" if mode == "sharded"
         else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
         else "impala_atari_env_frames_per_sec_per_chip"
     )
@@ -954,10 +1103,12 @@ if __name__ == "__main__":
         if "--mode" in sys.argv[1:]:
             _mi = sys.argv.index("--mode")
             if _mi + 1 >= len(sys.argv):
-                raise SystemExit("--mode requires an argument (anakin)")
+                raise SystemExit("--mode requires an argument (anakin | sharded)")
             _mode = sys.argv[_mi + 1]
-            if _mode != "anakin":
-                raise SystemExit(f"unknown --mode {_mode!r}; supported: anakin")
+            if _mode not in ("anakin", "sharded"):
+                raise SystemExit(
+                    f"unknown --mode {_mode!r}; supported: anakin, sharded"
+                )
         try:
             main(
                 _argv_mesh(),
@@ -972,6 +1123,8 @@ if __name__ == "__main__":
                         "metric": (
                             "impala_learn_step_frames_per_sec"
                             if "--learn" in sys.argv[1:]
+                            else "sharded_train_step_frames_per_sec"
+                            if _mode == "sharded"
                             else "impala_atari_env_frames_per_sec_aggregate"
                             if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"

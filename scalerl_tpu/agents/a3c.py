@@ -144,7 +144,14 @@ def make_a3c_optimizer(args: A3CArguments) -> optax.GradientTransformation:
 def build_model(args: A3CArguments, obs_shape: Tuple[int, ...], num_actions: int):
     """Pixel obs -> conv+LSTM AtariNet (the reference's A3C Atari model,
     ``a3c/utils/atari_model.py:57-144``: convs + LSTMCell(256));
-    flat obs -> MLPPolicyNet (``parallel_a3c.py:27-68``)."""
+    flat obs -> MLPPolicyNet (``parallel_a3c.py:27-68``).
+    ``args.policy_arch`` overrides with the mp-shardable big-model families
+    (transformer/MoE adapters — the DD-PPO-on-a-big-policy story)."""
+    from scalerl_tpu.models.transformer_policy import build_mp_policy
+
+    mp_model = build_mp_policy(args, obs_shape, num_actions)
+    if mp_model is not None:
+        return mp_model
     norm_init = bool(getattr(args, "normalized_init", False))
     if len(obs_shape) == 3:
         return AtariNet(

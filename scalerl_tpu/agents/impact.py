@@ -1,0 +1,274 @@
+"""IMPACT: importance-weighted actor-learner with clipped target networks
+(Luo et al. 2020, arxiv 1912.00167).
+
+The sample-efficiency counterweight to the sharded big-model learner: when
+the learn step gets heavier (an mp-sharded transformer/MoE policy), the
+async actor plane can no longer feed it fresh chunks fast enough.  IMPACT
+keeps the learner busy by replaying each chunk ``replay_times`` times out
+of a circular surrogate buffer (``data/circular.py``) and makes that safe
+with a *clipped target-network* surrogate:
+
+- a slow-moving target network ``pi_target`` (refreshed from the learner
+  every ``target_update_frequency`` updates) anchors the objective, so the
+  K replays of a chunk all optimize against the same reference policy
+  instead of chasing their own tail;
+- V-trace corrections are computed target-vs-behavior (``rho =
+  pi_target / mu``), decoupling off-policy correction from the fast-moving
+  learner weights;
+- the policy loss is the PPO-style clipped surrogate on the
+  learner-vs-target ratio ``r = pi / pi_target``:
+  ``-sum(min(r * adv, clip(r, 1-eps, 1+eps) * adv))``.
+
+Drops into every IMPALA host/trainer surface unchanged: same uniform model
+signature, same ``learn(traj)`` contract (one incoming chunk -> K sharded
+updates -> ONE batched metric read), same ``enable_mesh`` path —
+``ImpactArguments(mp_size=2, policy_arch="transformer")`` runs the full
+dp×mp story.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from scalerl_tpu.agents.impala import build_model, make_impala_optimizer
+from scalerl_tpu.agents.policy_value import PolicyValueAgent, frames_counter
+from scalerl_tpu.config import ImpactArguments
+from scalerl_tpu.data.circular import CircularTrajectoryBuffer
+from scalerl_tpu.data.trajectory import Trajectory
+from scalerl_tpu.ops.losses import baseline_loss, entropy_loss
+from scalerl_tpu.ops.vtrace import vtrace_from_logits
+
+
+@struct.dataclass
+class ImpactTrainState:
+    params: Any
+    target_params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    env_frames: jnp.ndarray
+
+
+def _action_logp(logits: jnp.ndarray, actions: jnp.ndarray) -> jnp.ndarray:
+    """log pi(a_t | s_t) over [T, B] from [T, B, A] logits."""
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None], axis=-1)[..., 0]
+
+
+def impact_loss(
+    params,
+    target_params,
+    model,
+    traj: Trajectory,
+    discounting: float,
+    baseline_cost: float,
+    entropy_cost: float,
+    clip_eps: float,
+    reward_clipping: str = "abs_one",
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """The IMPACT objective over one [T+1, B] chunk.
+
+    Metric-name contract matches ``impala_loss``: ``mean_``-prefixed keys
+    are true means (pmean under a dp axis), the rest sum over the batch.
+    """
+    out, _ = model.apply(
+        params, traj.obs, traj.action, traj.reward, traj.done, traj.core_state
+    )
+    tout, _ = model.apply(
+        jax.lax.stop_gradient(target_params),
+        traj.obs, traj.action, traj.reward, traj.done, traj.core_state,
+    )
+    logits = out.policy_logits  # [T+1, B, A], learner policy
+    target_logits = jax.lax.stop_gradient(tout.policy_logits)
+    values = out.baseline  # [T+1, B], learner critic
+
+    actions_taken = traj.action[1:]
+    behavior_logits = traj.logits[:-1]
+    rewards = traj.reward[1:]
+    if reward_clipping == "abs_one":
+        rewards = jnp.clip(rewards, -1.0, 1.0)
+    discounts = discounting * (1.0 - traj.done[1:].astype(jnp.float32))
+
+    # V-trace corrections computed TARGET-vs-behavior: the slow-moving
+    # anchor absorbs the off-policyness, so K replays of this chunk see
+    # stable advantages
+    vt = vtrace_from_logits(
+        behavior_logits=behavior_logits,
+        target_logits=target_logits[:-1],
+        actions=actions_taken,
+        discounts=discounts,
+        rewards=rewards,
+        values=values[:-1],
+        bootstrap_value=values[-1],
+        clip_rho_threshold=rho_clip,
+        clip_pg_rho_threshold=rho_clip,
+        clip_c_threshold=c_clip,
+    )
+
+    # clipped surrogate on the learner-vs-target ratio (IMPACT eq. 1)
+    logp_cur = _action_logp(logits[:-1], actions_taken)
+    logp_tgt = _action_logp(target_logits[:-1], actions_taken)
+    ratio = jnp.exp(logp_cur - logp_tgt)
+    adv = jax.lax.stop_gradient(vt.pg_advantages)
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+    pg = -jnp.sum(jnp.minimum(ratio * adv, clipped * adv))
+    bl = baseline_cost * baseline_loss(vt.vs - values[:-1])
+    ent = entropy_cost * entropy_loss(logits[:-1])
+    total = pg + bl + ent
+    metrics = {
+        "total_loss": total,
+        "pg_loss": pg,
+        "baseline_loss": bl,
+        "entropy_loss": ent,
+        "mean_value": jnp.mean(values),
+        "mean_reward": jnp.mean(rewards),
+        "mean_ratio": jnp.mean(ratio),
+        "mean_clip_frac": jnp.mean(
+            (jnp.abs(ratio - 1.0) > clip_eps).astype(jnp.float32)
+        ),
+    }
+    return total, metrics
+
+
+def make_impact_learn_fn(
+    model,
+    optimizer: optax.GradientTransformation,
+    args: ImpactArguments,
+    grad_axis: Optional[str] = None,
+) -> Callable:
+    """Pure ``(state, traj) -> (state, metrics)`` IMPACT update.
+
+    The target network refreshes *inside* the jitted step — every
+    ``target_update_frequency`` updates a ``jnp.where`` select copies the
+    fresh params over the target leaves (no host round-trip, donation
+    keeps both copies in the same buffers across steps).
+    """
+
+    def learn(state: ImpactTrainState, traj: Trajectory):
+        (loss, metrics), grads = jax.value_and_grad(impact_loss, has_aux=True)(
+            state.params,
+            state.target_params,
+            model,
+            traj,
+            discounting=args.discounting,
+            baseline_cost=args.baseline_cost,
+            entropy_cost=args.entropy_cost,
+            clip_eps=args.impact_clip,
+            reward_clipping=args.reward_clipping,
+            rho_clip=args.vtrace_rho_clip,
+            c_clip=args.vtrace_c_clip,
+        )
+        n_shards = 1
+        if grad_axis is not None:
+            grads = jax.lax.psum(grads, grad_axis)
+            metrics = {
+                k: jax.lax.pmean(v, grad_axis)
+                if k.startswith("mean_")
+                else jax.lax.psum(v, grad_axis)
+                for k, v in metrics.items()
+            }
+            n_shards = jax.lax.psum(1, grad_axis)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_step = state.step + 1
+        refresh = (new_step % args.target_update_frequency) == 0
+        target_params = jax.tree_util.tree_map(
+            lambda p, t: jnp.where(refresh, p, t), params, state.target_params
+        )
+        del n_shards  # frames are counted at insertion, not per update
+        new_state = ImpactTrainState(
+            params=params,
+            target_params=target_params,
+            opt_state=opt_state,
+            step=new_step,
+            # replayed chunks don't consume new env frames: the agent
+            # counts frames once per inserted chunk (learn_device), so K
+            # replays don't inflate the frame axis of every curve
+            env_frames=state.env_frames,
+        )
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    return maybe_guard_nonfinite(learn, args)
+
+
+class ImpactAgent(PolicyValueAgent):
+    """Host-facing IMPACT agent: IMPALA's act surface + the clipped-target
+    replayed learner.  ``learn``/``learn_device`` insert the incoming chunk
+    into the circular surrogate buffer and run ``replay_times`` updates per
+    insertion — K dispatches, still ONE batched metric read per call."""
+
+    def __init__(
+        self,
+        args: ImpactArguments,
+        obs_shape: Tuple[int, ...],
+        num_actions: int,
+        obs_dtype=jnp.uint8,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        model = build_model(args, obs_shape, num_actions)
+        optimizer = make_impala_optimizer(args)
+        self._setup(
+            model=model,
+            optimizer=optimizer,
+            make_state=lambda params, opt_state: ImpactTrainState(
+                params=params,
+                # an independent copy: the donated learn step must never
+                # alias the same buffer into two argument slots
+                target_params=jax.tree_util.tree_map(jnp.copy, params),
+                opt_state=opt_state,
+                step=jnp.zeros((), jnp.int32),
+                env_frames=frames_counter(),
+            ),
+            learn_fn=make_impact_learn_fn(model, optimizer, args),
+            obs_shape=obs_shape,
+            num_actions=num_actions,
+            obs_dtype=obs_dtype,
+            seed=args.seed,
+            key=key,
+        )
+        self.surrogate = CircularTrajectoryBuffer(
+            capacity=args.surrogate_capacity, replay_times=args.replay_times
+        )
+
+    def make_learn_fn(self, grad_axis: Optional[str] = None):
+        """Learn fn from this agent's model/optimizer/args (the mesh
+        re-wrap contract shared with ``ImpalaAgent.make_learn_fn``)."""
+        return make_impact_learn_fn(
+            self.model, self.optimizer, self.args, grad_axis=grad_axis
+        )
+
+    def learn_device(self, traj) -> Dict[str, Any]:
+        """Insert ``traj`` and run ``replay_times`` surrogate updates.
+
+        Metrics of the LAST update are returned as device arrays — the
+        caller (or ``learn``) materializes them in one batched transfer,
+        so K replays still cost one host sync.
+        """
+        self.surrogate.add(traj)
+        metrics: Dict[str, Any] = {}
+        for _ in range(self.args.replay_times):
+            batch = self.surrogate.sample()
+            if self._shard_batch is not None:
+                batch = self._shard_batch(batch)
+            # callers own the mesh dispatch guard (HostPlaneMixin), same
+            # contract as PolicyValueAgent.learn_device
+            self.state, metrics = self._learn(self.state, batch)  # graftlint: disable=JG002 (guarded at call site)
+        # frame accounting at insertion: one chunk of fresh env frames per
+        # learn() call regardless of K (replays reuse frames, that's the
+        # point) — keep the counter on the host-visible state
+        T, B = traj.reward.shape[0] - 1, traj.reward.shape[1]
+        self.state = self.state.replace(
+            env_frames=self.state.env_frames + T * B
+        )
+        return metrics

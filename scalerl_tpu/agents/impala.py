@@ -199,14 +199,19 @@ def make_impala_learn_fn(
 
 
 def make_impala_optimizer(args: ImpalaArguments) -> optax.GradientTransformation:
-    """RMSProp + global-norm clip, matching ``impala_atari.py:313-320``."""
+    """RMSProp + global-norm clip, matching ``impala_atari.py:313-320``.
+
+    ``args.bf16_params``: the chain is wrapped in ``fp32_optimizer_state``
+    — grads/params upcast to f32 around the update, moments kept f32,
+    updates downcast to the (bf16) param dtype — the sharded learner's
+    mixed-precision layout."""
     lr: Any = args.learning_rate
     if args.total_steps > 0:
         # linear decay to 0 over total env frames, as the reference schedules
         lr = optax.linear_schedule(
             args.learning_rate, 0.0, max(args.total_steps // (args.rollout_length * args.batch_size), 1)
         )
-    return optax.chain(
+    tx = optax.chain(
         optax.clip_by_global_norm(args.max_grad_norm),
         optax.rmsprop(
             lr,
@@ -215,10 +220,22 @@ def make_impala_optimizer(args: ImpalaArguments) -> optax.GradientTransformation
             momentum=args.rmsprop_momentum,
         ),
     )
+    if getattr(args, "bf16_params", False):
+        from scalerl_tpu.parallel.train_step import fp32_optimizer_state
+
+        tx = fp32_optimizer_state(tx)
+    return tx
 
 
 def build_model(args: ImpalaArguments, obs_shape: Tuple[int, ...], num_actions: int):
-    """Pixel obs -> AtariNet; flat obs -> MLPPolicyNet (same signature)."""
+    """Pixel obs -> AtariNet; flat obs -> MLPPolicyNet (same signature).
+    ``args.policy_arch`` overrides with the mp-shardable big-model families
+    (transformer/MoE adapters, ``models/transformer_policy.py``)."""
+    from scalerl_tpu.models.transformer_policy import build_mp_policy
+
+    mp_model = build_mp_policy(args, obs_shape, num_actions)
+    if mp_model is not None:
+        return mp_model
     if len(obs_shape) == 3:
         return AtariNet(
             num_actions=num_actions,

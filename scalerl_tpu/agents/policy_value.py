@@ -137,15 +137,52 @@ class PolicyValueAgent(BaseAgent):
         return np.asarray(a)
 
     def enable_mesh(self, mesh_or_spec, batch_example=None) -> None:
-        """Shard the learn step over a device mesh (the ``--mesh-shape``
-        path): batch over dp×fsdp, params/opt state over fsdp/tp where
-        divisible, gradient psum inserted by GSPMD.  Call once, before
-        training; subsequent ``learn()`` calls shard incoming batches."""
-        from scalerl_tpu.parallel import make_parallel_learn_fn, resolve_mesh
+        """Shard the learn step over a device mesh (the ``--mesh-shape`` /
+        ``dp_size``×``mp_size`` path).  Call once, before training;
+        subsequent ``learn()`` calls shard incoming batches.
+
+        Pure-dp (and fsdp/tp) meshes keep the heuristic layout: batch over
+        dp×fsdp, params over fsdp/tp where divisible, gradient psum
+        inserted by GSPMD.  A mesh with ``mp > 1`` switches to the sharded
+        big-model learner plane: params/opt state laid out by the logical
+        rule table (heads/mlp/vocab/experts over ``mp``,
+        ``parallel/logical.py``), inter-layer activations pinned
+        batch-over-dp via ``with_sharding_constraint`` (the learn fn is
+        rebuilt against a constraint-carrying model clone), and the train
+        state donated so the sharded buffers are reused in place.
+        """
+        from scalerl_tpu.parallel import (
+            activation_constraint,
+            has_mp_params,
+            make_parallel_learn_fn,
+            mp_param_sharding,
+            resolve_mesh,
+        )
 
         mesh = resolve_mesh(mesh_or_spec)
+        param_specs = None
+        if mesh.shape.get("mp", 1) > 1:
+            if not has_mp_params(self.state.params):
+                raise ValueError(
+                    "mesh has mp > 1 but this agent's model has no "
+                    "model-parallel sharding rules — use a transformer/MoE "
+                    "policy (policy_arch='transformer'|'moe') or a pure-dp "
+                    "mesh"
+                )
+            if getattr(self.model, "constrain", "absent") is None and hasattr(
+                self, "make_learn_fn"
+            ):
+                # the constraint needs the mesh, which didn't exist at
+                # construction: clone the model with the seam filled and
+                # re-derive the pure learn fn from the clone
+                self.model = self.model.clone(
+                    constrain=activation_constraint(mesh)
+                )
+                self._learn_fn = self.make_learn_fn()
+            param_specs = mp_param_sharding(self.state, mesh)
         plearn = make_parallel_learn_fn(
-            self._learn_fn, mesh, self.state, batch_example=batch_example
+            self._learn_fn, mesh, self.state,
+            batch_example=batch_example, param_specs=param_specs,
         )
         self.mesh = mesh
         self.state = plearn.shard_state(self.state)
@@ -184,7 +221,14 @@ class PolicyValueAgent(BaseAgent):
         return save_checkpoint(path, self.state)
 
     def load_checkpoint(self, path: str) -> None:
-        self.state = load_checkpoint(path, self.state)
+        restored = load_checkpoint(path, self.state)
+        if self._shard_batch is not None and hasattr(self._learn, "shard_state"):
+            # meshed agent: re-place the restored leaves into the learn
+            # step's sharded layout (a no-op for leaves orbax already
+            # restored with their saved shardings; host arrays from an
+            # unsharded or differently-meshed checkpoint get re-sharded)
+            restored = self._learn.shard_state(restored)
+        self.state = restored
         self._eval_state.reset()
 
 

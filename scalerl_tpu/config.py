@@ -45,8 +45,30 @@ class RLArguments:
     # ``device: cuda`` + accelerate YAML, rl_args.py:25 + accelerate_config.yaml)
     platform: str = "auto"  # auto | tpu | cpu
     num_devices: int = 0  # 0 = all visible devices
-    mesh_shape: Optional[str] = None  # e.g. "dp=8" or "dp=4,tp=2"
+    mesh_shape: Optional[str] = None  # e.g. "dp=8" or "dp=4,mp=2"
     use_bfloat16: bool = True
+
+    # Sharded big-model learner (parallel/logical.py, docs/PERFORMANCE.md
+    # "Sharded learner"): mp_size > 1 shards the policy's heads/mlp/vocab/
+    # expert dims over the named `mp` mesh axis so policies too big for one
+    # chip's HBM train anyway; dp_size 0 = every remaining device
+    # (n_devices // mp_size).  The trainer families resolve these through
+    # maybe_enable_mesh_from_args; an explicit mesh_shape wins over both.
+    mp_size: int = 1
+    dp_size: int = 0
+    # Policy architecture override for the actor-learner families:
+    # "transformer" | "moe" pick the mp-shardable adapters
+    # (models/transformer_policy.py); "auto" keeps the conv/MLP zoo.
+    policy_arch: str = "auto"
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    moe_experts: int = 8
+    moe_hidden: int = 256
+    # bf16 params + compute with fp32 optimizer state (the sharded-learner
+    # mixed-precision layout: parallel.train_step.fp32_optimizer_state).
+    # Only honored by the mp-shardable architectures.
+    bf16_params: bool = False
 
     # Environment
     env_id: str = "CartPole-v1"
@@ -158,6 +180,15 @@ class RLArguments:
             raise ValueError(
                 "nonfinite_check_every must be >= 1, got "
                 f"{self.nonfinite_check_every}"
+            )
+        if self.mp_size < 1:
+            raise ValueError(f"mp_size must be >= 1, got {self.mp_size}")
+        if self.dp_size < 0:
+            raise ValueError(f"dp_size must be >= 0, got {self.dp_size}")
+        if self.policy_arch not in ("auto", "transformer", "moe"):
+            raise ValueError(
+                "policy_arch must be auto | transformer | moe, got "
+                f"{self.policy_arch!r}"
             )
 
 
@@ -520,6 +551,52 @@ class ImpalaArguments(RLArguments):
                 "num_buffers (slot count) must be at least "
                 "max(2, num_actors) "
                 f"(got {self.num_buffers}, num_actors={self.num_actors})"
+            )
+
+
+@dataclass
+class ImpactArguments(ImpalaArguments):
+    """IMPACT options (arxiv 1912.00167): clipped target networks + a
+    circular surrogate buffer on the IMPALA actor plane.
+
+    The sample-efficiency counterweight to the sharded big-model learner:
+    as the learner step gets heavier (mp-sharded transformer/MoE), the
+    async actors fall behind — IMPACT keeps the chips busy by replaying
+    each trajectory chunk ``replay_times`` times from a circular buffer,
+    while a slow-moving *target network* anchors the surrogate objective
+    (PPO-style ratio clip against the target policy, V-trace corrections
+    computed target-vs-behavior) so the extra replays don't destabilize
+    training the way raw IMPALA replays would.
+    """
+
+    algo_name: str = "impact"
+    # learner steps between target-network refreshes (pi_target <- pi)
+    target_update_frequency: int = 16
+    # how many learner updates each inserted chunk participates in
+    replay_times: int = 2
+    # circular surrogate buffer depth, in trajectory chunks
+    surrogate_capacity: int = 16
+    # PPO-style clip width for the pi/pi_target surrogate ratio
+    impact_clip: float = 0.3
+
+    def validate(self) -> None:
+        super().validate()
+        if self.target_update_frequency < 1:
+            raise ValueError(
+                "target_update_frequency must be >= 1, got "
+                f"{self.target_update_frequency}"
+            )
+        if self.replay_times < 1:
+            raise ValueError(
+                f"replay_times must be >= 1, got {self.replay_times}"
+            )
+        if self.surrogate_capacity < 1:
+            raise ValueError(
+                f"surrogate_capacity must be >= 1, got {self.surrogate_capacity}"
+            )
+        if not 0.0 < self.impact_clip < 1.0:
+            raise ValueError(
+                f"impact_clip must be in (0, 1), got {self.impact_clip}"
             )
 
 

@@ -15,7 +15,7 @@ capacity pass through the residual only.  A load-balancing auxiliary loss
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -98,12 +98,18 @@ class MoEPolicy(nn.Module):
     num_experts: int = 8
     d_hidden: int = 256
     capacity_factor: float = 2.0
+    # Sharded-activation seam (``parallel.logical.activation_constraint``):
+    # pins the token stream to batch-over-dp around the expert layer, so
+    # GSPMD derives the dispatch/combine all-to-alls from the expert-bank
+    # shardings (``w_in``/``w_out`` leading dim over ``mp``) alone.
+    constrain: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, obs: jnp.ndarray):
-        x = nn.relu(nn.Dense(self.d_model, name="embed")(
+        c = self.constrain if self.constrain is not None else (lambda x: x)
+        x = c(nn.relu(nn.Dense(self.d_model, name="embed")(
             obs.reshape(obs.shape[0], -1).astype(jnp.float32)
-        ))
+        )))
         moe = MoEMLP(
             self.num_experts,
             self.d_model,
@@ -111,7 +117,7 @@ class MoEPolicy(nn.Module):
             self.capacity_factor,
             name="moe",
         )(x)
-        x = nn.LayerNorm()(x + moe.out)
+        x = c(nn.LayerNorm()(x + moe.out))
         policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
         baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
         return policy_logits, baseline, moe.aux_loss

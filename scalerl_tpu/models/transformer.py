@@ -37,24 +37,27 @@ class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int
     attn_fn: AttentionFn
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         B, T, _ = x.shape
         head_dim = self.d_model // self.num_heads
-        h = nn.LayerNorm(use_bias=False)(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv")(h)
+        dt = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+        h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv", **dt)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.num_heads, head_dim)
         out = self.attn_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
-        out = nn.Dense(self.d_model, use_bias=False, name="proj")(
+        out = nn.Dense(self.d_model, use_bias=False, name="proj", **dt)(
             out.reshape(B, T, self.d_model)
         )
         x = x + out
-        h = nn.LayerNorm(use_bias=False)(x)
-        h = nn.Dense(self.mlp_ratio * self.d_model, name="mlp_in")(h)
+        h = nn.LayerNorm(use_bias=False, dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, name="mlp_in", **dt)(h)
         h = nn.gelu(h)
-        h = nn.Dense(self.d_model, name="mlp_out")(h)
+        h = nn.Dense(self.d_model, name="mlp_out", **dt)(h)
         return x + h
 
 
@@ -81,6 +84,17 @@ class TransformerPolicy(nn.Module):
     max_len: int = 4096
     attn_fn: Optional[AttentionFn] = None
     use_flash: bool = False
+    # Mixed precision: blocks compute in ``dtype`` with params stored in
+    # ``param_dtype`` (bf16/bf16 on the sharded learner plane); the heads
+    # always emit float32 so the loss/V-trace math stays full precision.
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    # Sharded-activation seam: when set (``parallel.logical
+    # .activation_constraint``), applied to the residual stream after the
+    # embedding and after every block — pins inter-layer activations to
+    # batch-over-dp / replicated-over-mp so GSPMD derives the per-block
+    # head/mlp reshard from the weight shardings alone.
+    constrain: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -99,24 +113,33 @@ class TransformerPolicy(nn.Module):
             attn = lambda q, k, v: base(q, k, v, causal=True)  # noqa: E731
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-        x = nn.Dense(self.d_model, name="obs_embed")(
-            obs.reshape(B, T, -1).astype(jnp.float32)
-        )
+        c = self.constrain if self.constrain is not None else (lambda x: x)
+        x = nn.Dense(
+            self.d_model, name="obs_embed",
+            dtype=self.dtype, param_dtype=self.param_dtype,
+        )(obs.reshape(B, T, -1).astype(self.dtype))
         pos_tab = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
+            self.param_dtype,
         )
-        x = x + pos_tab[positions]
+        x = c(x + pos_tab[positions].astype(self.dtype))
         for i in range(self.num_layers):
-            x = _Block(
-                self.d_model,
-                self.num_heads,
-                self.mlp_ratio,
-                attn,
-                name=f"block_{i}",
-            )(x)
-        x = nn.LayerNorm(use_bias=False, name="final_norm")(x)
+            x = c(
+                _Block(
+                    self.d_model,
+                    self.num_heads,
+                    self.mlp_ratio,
+                    attn,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    name=f"block_{i}",
+                )(x)
+            )
+        x = nn.LayerNorm(use_bias=False, name="final_norm", dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
         policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
         baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
         return TransformerOutput(policy_logits, baseline)

@@ -1,0 +1,161 @@
+"""Transformer/MoE policies on the uniform recurrent-policy signature.
+
+``TransformerPolicy`` (``models/transformer.py``) and ``MoEPolicy``
+(``models/moe.py``) speak batch-major sequence/token interfaces; the
+actor-learner algorithm families (IMPALA/A3C/PPO, ``agents/``) drive every
+model through the time-major recurrent signature of ``models/policy.py``::
+
+    (obs[T,B,...], last_action[T,B], reward[T,B], done[T,B], core_state)
+        -> (AtariNetOutput(policy_logits[T,B,A], baseline[T,B]), core_state)
+
+These adapters bridge the two so the big-model families drop into every
+existing trainer unchanged — and, with ``mp_size > 1``, into the dp×mp
+sharded learner plane (``parallel/logical.py`` knows their param names).
+
+Context semantics: the transformer attends causally *within the trajectory
+chunk* it is given (``core_state`` is empty — attention over the ``T+1``
+unroll is the memory, the R2D2 "stored state" question doesn't arise).
+Acting calls see a length-1 chunk; V-trace's importance weights absorb the
+resulting actor/learner context mismatch exactly as they absorb parameter
+lag.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from scalerl_tpu.models.atari import AtariNetOutput
+from scalerl_tpu.models.moe import MoEPolicy
+from scalerl_tpu.models.transformer import TransformerPolicy
+
+
+class TransformerPolicyNet(nn.Module):
+    """Causal transformer actor-critic on the recurrent signature.
+
+    ``constrain``: the activation sharding seam, threaded to the inner
+    :class:`TransformerPolicy` (set by ``enable_mesh`` on the mp path).
+    ``dtype``/``param_dtype``: bf16 compute/params with f32 heads — the
+    mixed-precision layout of the sharded learner (optimizer state stays
+    f32 via ``parallel.train_step.fp32_optimizer_state``).
+    """
+
+    num_actions: int
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    max_len: int = 1024
+    use_flash: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    constrain: Optional[Callable] = None
+
+    def initial_state(self, batch_size: int):
+        return ()
+
+    @nn.compact
+    def __call__(self, obs, last_action, reward, done, core_state=()):
+        del last_action, reward, done  # context = the obs sequence itself
+        out = TransformerPolicy(
+            num_actions=self.num_actions,
+            d_model=self.d_model,
+            num_heads=self.num_heads,
+            num_layers=self.num_layers,
+            mlp_ratio=self.mlp_ratio,
+            max_len=self.max_len,
+            use_flash=self.use_flash,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            constrain=self.constrain,
+            name="transformer",
+        )(jnp.moveaxis(obs, 0, 1))  # [T, B, ...] -> [B, T, ...]
+        return (
+            AtariNetOutput(
+                policy_logits=jnp.moveaxis(out.policy_logits, 0, 1),
+                baseline=jnp.moveaxis(out.baseline, 0, 1),
+            ),
+            core_state,
+        )
+
+
+class MoEPolicyNet(nn.Module):
+    """Switch-routed MoE actor-critic on the recurrent signature.
+
+    Per-step obs features are flattened to a ``[T*B, obs]`` token stream
+    for the expert layer (expert capacity is sized off the full chunk's
+    token count).  The Switch load-balancing aux loss is computed inside
+    ``MoEPolicy`` but not surfaced through this signature — at policy
+    scale with ``capacity_factor >= 2`` top-1 routing stays balanced
+    enough; token-level sequence-RL workloads that need the aux term
+    should drive ``MoEPolicy`` directly.
+    """
+
+    num_actions: int
+    d_model: int = 128
+    num_experts: int = 8
+    d_hidden: int = 256
+    capacity_factor: float = 2.0
+    constrain: Optional[Callable] = None
+
+    def initial_state(self, batch_size: int):
+        return ()
+
+    @nn.compact
+    def __call__(self, obs, last_action, reward, done, core_state=()):
+        del last_action, reward, done
+        T, B = obs.shape[0], obs.shape[1]
+        flat = obs.reshape((T * B, -1))
+        logits, baseline, _aux = MoEPolicy(
+            num_actions=self.num_actions,
+            d_model=self.d_model,
+            num_experts=self.num_experts,
+            d_hidden=self.d_hidden,
+            capacity_factor=self.capacity_factor,
+            constrain=self.constrain,
+            name="moe_policy",
+        )(flat)
+        return (
+            AtariNetOutput(
+                policy_logits=logits.reshape(T, B, self.num_actions),
+                baseline=baseline.reshape(T, B),
+            ),
+            core_state,
+        )
+
+
+def build_mp_policy(args, obs_shape, num_actions):
+    """The ``policy_arch`` dispatch shared by the algorithm families'
+    ``build_model`` functions: ``"transformer"``/``"moe"`` return an
+    mp-shardable adapter sized from ``RLArguments`` (``d_model``,
+    ``n_layers``, ``n_heads``, ``moe_experts``, ``moe_hidden``,
+    ``bf16_params``); ``"auto"`` returns None — the caller keeps its
+    conv/MLP zoo.
+    """
+    arch = getattr(args, "policy_arch", "auto")
+    if arch in ("auto", "", None):
+        return None
+    bf16 = bool(getattr(args, "bf16_params", False))
+    if arch == "transformer":
+        return TransformerPolicyNet(
+            num_actions=num_actions,
+            d_model=getattr(args, "d_model", 128),
+            num_heads=getattr(args, "n_heads", 4),
+            num_layers=getattr(args, "n_layers", 2),
+            # learner chunks are [T+1, B]; acting sees T=1
+            max_len=int(getattr(args, "rollout_length", 20)) + 1,
+            dtype=jnp.bfloat16 if bf16 else jnp.float32,
+            param_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        )
+    if arch == "moe":
+        return MoEPolicyNet(
+            num_actions=num_actions,
+            d_model=getattr(args, "d_model", 128),
+            num_experts=getattr(args, "moe_experts", 8),
+            d_hidden=getattr(args, "moe_hidden", 256),
+        )
+    raise ValueError(
+        f"unknown policy_arch {arch!r}; expected auto | transformer | moe"
+    )

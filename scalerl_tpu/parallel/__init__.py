@@ -15,8 +15,10 @@ backend"):
   ``jax.distributed.initialize`` (``multihost.py``).
 
 Axis vocabulary (fixed, in mesh order):
-``dp`` (data), ``fsdp`` (param/optimizer shards), ``tp`` (tensor),
-``sp`` (sequence/context), ``ep`` (expert).  RL parity only *needs* ``dp``
+``dp`` (data), ``fsdp`` (param/optimizer shards), ``tp`` (tensor,
+heuristic), ``sp`` (sequence/context), ``ep`` (expert), ``mp`` (model —
+the named axis of the dp×mp sharded learner plane, driven by the logical
+rule table in ``parallel/logical.py``).  RL parity only *needs* ``dp``
 (SURVEY.md §2.4 parallelism inventory), but the mesh reserves the rest so
 long-context policies (ring attention over ``sp``) and sharded param states
 drop in without re-plumbing.
@@ -24,18 +26,28 @@ drop in without re-plumbing.
 
 from scalerl_tpu.parallel.mesh import (  # noqa: F401
     AXIS_NAMES,
+    mesh_spec_from_args,
     resolve_mesh,
     MeshSpec,
     make_mesh,
 )
 from scalerl_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
+    has_scanned_params,
     infer_param_spec,
     param_sharding,
     replicated,
     shard_batch,
     shard_params,
     trajectory_sharding,
+)
+from scalerl_tpu.parallel.logical import (  # noqa: F401
+    LOGICAL_RULES,
+    activation_constraint,
+    has_mp_params,
+    make_shard_and_gather_fns,
+    mp_param_sharding,
+    mp_param_spec,
 )
 from scalerl_tpu.parallel.pipeline import (  # noqa: F401
     hetero_sequential_apply,
@@ -45,8 +57,10 @@ from scalerl_tpu.parallel.pipeline import (  # noqa: F401
 )
 from scalerl_tpu.parallel.train_step import (  # noqa: F401
     enable_offpolicy_mesh,
+    fp32_optimizer_state,
     make_parallel_act_fn,
     make_parallel_learn_fn,
+    maybe_enable_mesh_from_args,
 )
 from scalerl_tpu.parallel.multihost import initialize_multihost  # noqa: F401
 from scalerl_tpu.parallel.sequence import (  # noqa: F401

@@ -1,0 +1,192 @@
+"""Named logical-axis sharding rules for the big-model policy families.
+
+The heuristic ``infer_param_spec`` (``parallel/sharding.py``) shards
+"whatever dims happen to divide" — fine for conv/fc stacks, wrong for a
+transformer, where the *meaning* of each dim decides its axis: attention
+heads, the MLP hidden, and the vocab/action head shard over the model axis
+while embeddings and residual-stream dims replicate (Megatron layout).
+This module is the declarative counterpart, the SNIPPETS.md patterns made
+load-bearing:
+
+- snippet [3]'s ``DEFAULT_RULES`` table — logical axis name -> mesh axis —
+  becomes :data:`LOGICAL_RULES` with ``"mp"`` as the model axis;
+- parameter leaves are classified by their trailing path names (module +
+  param), so the same table covers the raw params, the optimizer moments
+  (whose pytree paths mirror the params), and any wrapper state without
+  model surgery;
+- snippet [2]'s ``make_shard_and_gather_fns`` — per-leaf pjit'd placement
+  and fetch functions built from partition specs — is
+  :func:`make_shard_and_gather_fns`, used by the sharded checkpoint path.
+
+Divisibility guard: a rule only shards a dim when the mesh extent divides
+it; otherwise that dim silently replicates (a 6-action policy head on
+``mp=4`` replicates instead of erroring — the rule table describes *big*
+models, small heads degrade gracefully).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scalerl_tpu.parallel.sharding import _path_names
+
+# The model-parallel mesh axis of the dp×mp learner plane.
+MP_AXIS = "mp"
+
+# Logical axis -> mesh axis (None = replicated), snippet [3] shape.
+LOGICAL_RULES: Dict[str, Optional[str]] = {
+    "batch": "dp",
+    "embed": None,   # residual stream / d_model stays replicated
+    "heads": MP_AXIS,  # fused qkv output (num_heads * head_dim)
+    "mlp": MP_AXIS,    # MLP hidden (mlp_ratio * d_model)
+    "vocab": MP_AXIS,  # policy head output (actions / tokens)
+    "experts": MP_AXIS,  # MoE expert-leading tensors (ep folded onto mp)
+}
+
+# Trailing-path-name -> per-dim logical axes.  Keys are matched against the
+# last one or two path components of each leaf ((module, param) first, then
+# the bare leaf name), which makes the table apply equally to
+# ``params.block_0.qkv.kernel`` and the RMSProp moment
+# ``opt_state[1].nu.params.block_0.qkv.kernel``.
+PARAM_LOGICAL_AXES: Dict[Tuple[str, ...], Tuple[Optional[str], ...]] = {
+    ("qkv", "kernel"): ("embed", "heads"),
+    ("proj", "kernel"): ("heads", "embed"),
+    ("mlp_in", "kernel"): ("embed", "mlp"),
+    ("mlp_in", "bias"): ("mlp",),
+    ("mlp_out", "kernel"): ("mlp", "embed"),
+    ("mlp_out", "bias"): ("embed",),
+    ("policy_head", "kernel"): ("embed", "vocab"),
+    ("policy_head", "bias"): ("vocab",),
+    ("value_head", "kernel"): ("embed", None),
+    # MoE expert banks: the leading expert dim shards over the model axis
+    # (the GShard layout — XLA derives the token all-to-alls from it).
+    # The per-expert matmul dims stay unsharded: with ep folded onto mp, a
+    # second mp entry would double-map the axis (and expert-internal
+    # sharding buys nothing until experts outgrow a chip).
+    ("w_in",): ("experts", "embed", None),
+    ("w_out",): ("experts", None, "embed"),
+}
+
+
+def logical_to_spec(
+    axes: Tuple[Optional[str], ...],
+    shape: Tuple[int, ...],
+    mesh: Mesh,
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> P:
+    """Resolve per-dim logical axes into a PartitionSpec on ``mesh``.
+
+    A dim only shards when its mesh axis has extent > 1 AND divides the dim
+    size; everything else replicates.
+    """
+    rules = rules if rules is not None else LOGICAL_RULES
+    parts = []
+    used = set()  # a mesh axis may shard at most one dim per tensor
+    for dim, logical in enumerate(axes):
+        mesh_axis = rules.get(logical) if logical is not None else None
+        n = mesh.shape.get(mesh_axis, 1) if mesh_axis else 1
+        if mesh_axis and mesh_axis not in used and n > 1 and shape[dim] % n == 0:
+            parts.append(mesh_axis)
+            used.add(mesh_axis)
+        else:
+            parts.append(None)
+    return P(*parts)
+
+
+def _match_axes(path: Tuple[Any, ...]) -> Optional[Tuple[Optional[str], ...]]:
+    names = _path_names(path)
+    for key in (tuple(names[-2:]), (names[-1],) if names else ()):
+        if key and key in PARAM_LOGICAL_AXES:
+            return PARAM_LOGICAL_AXES[key]
+    return None
+
+
+def mp_param_spec(
+    path: Tuple[Any, ...],
+    leaf: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> P:
+    """PartitionSpec for one param/opt-state leaf under the logical rules.
+
+    Unmatched leaves (embeddings, LayerNorm scales, counters, schedule
+    state) replicate — safe by construction.
+    """
+    axes = _match_axes(path)
+    if axes is None or not hasattr(leaf, "ndim") or leaf.ndim != len(axes):
+        return P()
+    return logical_to_spec(axes, leaf.shape, mesh, rules)
+
+
+def mp_param_sharding(
+    tree: Any,
+    mesh: Mesh,
+    rules: Optional[Dict[str, Optional[str]]] = None,
+) -> Any:
+    """Per-leaf ``NamedSharding`` pytree for a train state under the
+    logical rule table (heads/mlp/vocab/experts over ``mp``)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: NamedSharding(mesh, mp_param_spec(path, x, mesh, rules)),
+        tree,
+    )
+
+
+def has_mp_params(tree: Any) -> bool:
+    """True when the pytree carries leaves the logical rule table knows how
+    to shard — i.e. the model is one of the mp-aware families
+    (transformer/MoE policies)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        axes = _match_axes(path)
+        if axes is not None and getattr(leaf, "ndim", -1) == len(axes):
+            return True
+    return False
+
+
+def activation_constraint(mesh: Mesh, batch_axis: str = "dp") -> Callable:
+    """``with_sharding_constraint`` closure for inter-layer activations.
+
+    Pins ``[B, ...]`` tensors to batch-over-``dp``, replicated over ``mp``
+    — the residual stream layout between transformer blocks.  GSPMD then
+    derives the per-block reshard (split on heads/mlp inside the block,
+    rejoin at the residual add) from the weight shardings alone, instead of
+    guessing a layout for the whole network and paying involuntary
+    reshards.  Carries the mesh inside each ``NamedSharding``, so it works
+    under plain ``jax.jit`` with no ambient mesh context.
+    """
+
+    def constrain(x):
+        if not hasattr(x, "ndim") or x.ndim == 0:
+            return x
+        spec = P(*([batch_axis] + [None] * (x.ndim - 1)))
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return constrain
+
+
+def make_shard_and_gather_fns(shardings: Any) -> Tuple[Any, Any]:
+    """Per-leaf placement/fetch functions from a ``NamedSharding`` pytree
+    (the SNIPPETS.md [2] pattern, pjit identity with pinned out/in specs).
+
+    Returns ``(shard_fns, gather_fns)`` pytrees matching ``shardings``:
+    ``shard_fns`` place a host/device leaf into its mesh layout;
+    ``gather_fns`` fetch a sharded leaf back to one host ndarray (used by
+    the shard-aware checkpoint path to digest and restore state that never
+    lives unsharded on any single chip).
+    """
+
+    def make_shard_fn(sh):
+        placed = jax.jit(lambda x: x, out_shardings=sh)
+        return lambda x: placed(x)
+
+    def make_gather_fn(sh):
+        gathered = jax.jit(
+            lambda x: x, out_shardings=NamedSharding(sh.mesh, P())
+        )
+        return lambda x: jax.device_get(gathered(x))
+
+    shard_fns = jax.tree_util.tree_map(make_shard_fn, shardings)
+    gather_fns = jax.tree_util.tree_map(make_gather_fn, shardings)
+    return shard_fns, gather_fns

@@ -18,9 +18,14 @@ from jax.sharding import Mesh
 
 # Fixed axis order.  dp outermost (DCN/ICI-friendly data parallel), then
 # pipeline stages, then the param-sharding axis, then tensor / sequence /
-# expert innermost where collectives are most frequent and must ride the
-# fastest ICI hops.
-AXIS_NAMES: Tuple[str, ...] = ("dp", "pp", "fsdp", "tp", "sp", "ep")
+# expert / model innermost where collectives are most frequent and must ride
+# the fastest ICI hops.  ``mp`` is the named model-parallel axis of the
+# big-policy learner plane (Podracer's dp×mp recipe): transformer/MoE
+# weights shard their heads/mlp/vocab/expert dims over it via the logical
+# rules in ``parallel/logical.py``, while ``tp`` remains the generic
+# heuristic tensor axis of :func:`scalerl_tpu.parallel.sharding
+# .infer_param_spec` — two different sharding policies, two names.
+AXIS_NAMES: Tuple[str, ...] = ("dp", "pp", "fsdp", "tp", "sp", "ep", "mp")
 
 
 @dataclass(frozen=True)
@@ -91,3 +96,34 @@ def resolve_mesh(mesh_or_spec) -> Mesh:
     if isinstance(mesh_or_spec, Mesh):
         return mesh_or_spec
     return make_mesh(mesh_or_spec)
+
+
+def mesh_spec_from_args(args, n_devices: Optional[int] = None) -> Optional[str]:
+    """The mesh spec an ``RLArguments`` asks for, or ``None``.
+
+    An explicit ``mesh_shape`` string wins (power-user escape hatch: any
+    axis combination).  Otherwise ``dp_size``/``mp_size`` compose the
+    sharded-learner topology ``"dp=D,mp=M"``: ``mp_size > 1`` (or
+    ``dp_size > 0``) opts in, and ``dp_size == 0`` takes every remaining
+    device (``n_devices // mp_size``) — the one-knob path the trainer
+    families resolve through ``maybe_enable_mesh_from_args``.
+    """
+    spec = getattr(args, "mesh_shape", None)
+    if spec:
+        return spec
+    mp = int(getattr(args, "mp_size", 1) or 1)
+    dp = int(getattr(args, "dp_size", 0) or 0)
+    if mp <= 1 and dp <= 0:
+        return None
+    if dp <= 0:
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        if n_devices % mp != 0:
+            raise ValueError(
+                f"mp_size={mp} does not divide the {n_devices} visible "
+                "devices; set dp_size explicitly or adjust mp_size"
+            )
+        dp = n_devices // mp
+    if mp <= 1:
+        return f"dp={dp}"
+    return f"dp={dp},mp={mp}"

@@ -111,10 +111,39 @@ def infer_param_spec(
     return P(*spec)
 
 
-def param_sharding(params: Any, mesh: Mesh) -> Any:
-    """NamedSharding pytree for a param/optimizer pytree (fsdp/tp rule)."""
+def has_scanned_params(tree: Any) -> bool:
+    """True when the pytree carries ``nn.scan`` core parameters (flax
+    prefixes the scanned module's name with ``Scan``, e.g.
+    ``Scan_LSTMCore_0``)."""
+    for path, _ in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if any(str(n).startswith("Scan") for n in _path_names(path)):
+            return True
+    return False
+
+
+def param_sharding(
+    params: Any, mesh: Mesh, axes: Tuple[str, ...] = ("fsdp", "tp")
+) -> Any:
+    """NamedSharding pytree for a param/optimizer pytree (fsdp/tp rule).
+
+    Recurrent exception (the ``test_r2d2_enable_mesh_matches_unsharded``
+    root cause): when the tree carries ``nn.scan`` core params, EVERY leaf
+    replicates — batch-parallel only.  The scan's transpose (backward)
+    pass stacks per-step residuals ``[T, B, feat]`` as while-loop carries;
+    with any fsdp/tp-sharded param feeding the scan, GSPMD must reshard
+    those carries from batch-sharded to feature-sharded layouts, which it
+    can only do via an *involuntary full rematerialization* of the loop
+    carry (spmd_partitioner "You probably want to enrich the sharding
+    annotations"), and with a non-divisible feature dim the padded remat
+    produces gradients that are numerically WRONG (~8% loss drift at
+    hidden=16, not reduction-reorder noise).  Replicated params make the
+    meshed step bitwise-identical to single-device at the same global
+    batch; the memory win of fsdp never mattered for LSTM-sized cores.
+    """
+    if axes and has_scanned_params(params):
+        axes = ()
     return jax.tree_util.tree_map_with_path(
-        lambda path, x: NamedSharding(mesh, infer_param_spec(path, x, mesh)),
+        lambda path, x: NamedSharding(mesh, infer_param_spec(path, x, mesh, axes=axes)),
         params,
     )
 

@@ -315,6 +315,13 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         re-raises in the learner via the rollout queue's error funnel)."""
         super().__init__(args, run_name=run_name)
         self.agent = agent
+        # dp×mp sharded learner hookup: RLArguments.{mesh_shape,dp_size,
+        # mp_size} resolve to agent.enable_mesh before any actor thread
+        # starts (idempotent; the mesh dispatch guard below covers the
+        # resulting multi-device dispatch sites)
+        from scalerl_tpu.parallel.train_step import maybe_enable_mesh_from_args
+
+        maybe_enable_mesh_from_args(agent, args)
         self.env_fns = env_fns
         self.stop_event = threading.Event()
         self.frame_lock = threading.Lock()

@@ -40,6 +40,12 @@ class OnPolicyTrainer(BaseTrainer):
     ) -> None:
         super().__init__(args, run_name=run_name)
         self.agent = agent
+        # dp×mp sharded learner hookup: RLArguments.{mesh_shape,dp_size,
+        # mp_size} resolve to agent.enable_mesh here (idempotent — entry
+        # scripts that already enabled a mesh are left alone)
+        from scalerl_tpu.parallel.train_step import maybe_enable_mesh_from_args
+
+        maybe_enable_mesh_from_args(agent, args)
         self.train_envs = train_envs
         self.eval_envs = eval_envs
         self.num_envs = getattr(train_envs, "num_envs", 1)

@@ -1,0 +1,112 @@
+"""Capability probe for the two multi-process (jax.distributed) CPU tests.
+
+Before this probe, an environment whose jaxlib cannot run multi-process
+computations on the CPU backend burned the tests' full subprocess budgets
+(150 s + 270 s of idle timeout per tier-1 run, known-failing since PR 2):
+the rendezvous itself succeeds, so the failure only surfaced once a rank
+died mid-collective and its peer idled out waiting at the barrier.
+
+The probe spawns the same two-rank topology the tests use but runs ONLY
+``initialize_multihost`` (which selects gloo CPU collectives) plus one
+``process_allgather`` — a few seconds either way — and caches the verdict
+for the whole pytest session.  Both multihost test modules gate on it with
+``pytest.mark.skipif``: supported environments run the real tests (fast,
+now that gloo is wired), unsupported ones skip with the probe's reason
+instead of idling.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+from typing import Optional
+
+REPO = Path(__file__).resolve().parent.parent
+
+PROBE_TIMEOUT_S = 90.0
+
+_PROBE_RANK = textwrap.dedent(
+    """
+    import os, sys
+
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from scalerl_tpu.parallel.multihost import initialize_multihost
+
+    assert initialize_multihost(
+        coordinator_address={coord!r}, num_processes=2, process_id={pid}
+    )
+    import jax.numpy as jnp
+    from jax.experimental.multihost_utils import process_allgather
+
+    total = process_allgather(jnp.asarray([float(jax.process_index() + 1)]))
+    assert total.ravel().tolist() == [1.0, 2.0], total
+    print("PROBE OK", flush=True)
+    """
+)
+
+_verdict: Optional[str] = None  # None = not probed; "" = supported
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_probe() -> str:
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                _PROBE_RANK.format(repo=str(REPO), coord=coord, pid=pid),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=PROBE_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                out = (out or "") + "\n<probe timeout>"
+            outs.append(out or "")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if all(p.returncode == 0 and "PROBE OK" in o for p, o in zip(procs, outs)):
+        return ""
+    # the last non-empty line of the first failing rank is the reason
+    # (typically "Multiprocess computations aren't implemented on the CPU
+    # backend" on jaxlib builds without gloo collectives)
+    for p, out in zip(procs, outs):
+        if p.returncode != 0 or "PROBE OK" not in out:
+            lines = [l.strip() for l in out.splitlines() if l.strip()]
+            tail = lines[-1] if lines else f"rank exited rc={p.returncode}"
+            return f"multi-process CPU computations unsupported: {tail[:200]}"
+    return "multi-process CPU probe failed"
+
+
+def multiprocess_cpu_unsupported() -> str:
+    """Session-cached probe verdict: empty string when two-process CPU
+    collectives work, else a skip reason."""
+    global _verdict
+    if _verdict is None:
+        _verdict = _run_probe()
+    return _verdict

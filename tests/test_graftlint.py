@@ -418,6 +418,100 @@ def test_jg005_known_data_plane_donators():
 
 
 # ---------------------------------------------------------------------------
+# the dp×mp sharded learner's dispatch discipline (ISSUE 7): the pjit train
+# step donates its sharded state buffers (JG005 pins the rebind idiom) and
+# is a multi-device program, so threaded hosts must dispatch it under the
+# mesh lock (JG002)
+
+
+BAD_JG005_SHARDED_STEP = """
+    import jax
+
+    def _step_impl(state, batch):
+        return state, {}
+
+    # the sharded train step: state donated so the mp-sharded buffers of
+    # step N back step N+1 in place (one HBM copy, not two)
+    train_sharded = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def drive(state, batches):
+        for b in batches:
+            new_state, metrics = train_sharded(state, b)
+        params = jax.device_get(state.params)  # donated buffer: deleted
+        return new_state, params
+"""
+
+GOOD_JG005_SHARDED_STEP = """
+    import jax
+
+    def _step_impl(state, batch):
+        return state, {}
+
+    train_sharded = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def drive(state, batches):
+        for b in batches:
+            state, metrics = train_sharded(state, b)  # rebind over donated
+        params = jax.device_get(state.params)  # ONE end-of-run gather
+        return state, params
+"""
+
+
+def test_jg005_sharded_step_read_after_donate_flags():
+    findings = lint(BAD_JG005_SHARDED_STEP, relpath="scalerl_tpu/parallel/fixture.py")
+    assert "JG005" in rules_of(findings)
+    assert any("donated" in f.message for f in findings)
+
+
+def test_jg005_sharded_step_rebind_passes():
+    assert lint(GOOD_JG005_SHARDED_STEP, relpath="scalerl_tpu/parallel/fixture.py") == []
+
+
+BAD_JG002_SHARDED_DISPATCH = """
+    import threading
+
+    import jax
+
+    class ShardedLearner:
+        def __init__(self, step_fn, mesh):
+            self.mesh = mesh  # dp x mp device mesh
+            self._dispatch_guard = threading.Lock
+            self._train_sharded = jax.jit(step_fn, donate_argnums=(0,))
+
+        def learn(self, state, batch):
+            # multi-device pjit dispatch with actor threads live: enqueue
+            # order can differ per device -> XLA client deadlock
+            return self._train_sharded(state, batch)
+"""
+
+GOOD_JG002_SHARDED_DISPATCH = """
+    import threading
+
+    import jax
+
+    class ShardedLearner:
+        def __init__(self, step_fn, mesh):
+            self.mesh = mesh
+            self._dispatch_guard = threading.Lock
+            self._train_sharded = jax.jit(step_fn, donate_argnums=(0,))
+
+        def learn(self, state, batch):
+            with self._dispatch_guard():
+                return self._train_sharded(state, batch)
+"""
+
+
+def test_jg002_sharded_dispatch_outside_guard_flags():
+    findings = lint(BAD_JG002_SHARDED_DISPATCH)
+    assert rules_of(findings) == ["JG002"]
+    assert "_train_sharded" in findings[0].message
+
+
+def test_jg002_sharded_dispatch_under_guard_passes():
+    assert lint(GOOD_JG002_SHARDED_DISPATCH) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions + baseline machinery
 
 

@@ -1,0 +1,185 @@
+"""IMPACT: clipped target networks + circular surrogate buffer (ISSUE 7).
+
+The sample-efficiency counterweight to the sharded big-model learner
+(arxiv 1912.00167): each trajectory chunk participates in ``replay_times``
+learner updates out of a circular buffer, anchored by a slow-moving target
+network so the replays stay stable.  Covers the buffer semantics, the
+target-refresh cadence inside the jitted step, the ratio-clip surrogate,
+frame accounting (replays must NOT inflate env_frames), and the dp×mp
+composition (an IMPACT transformer learner sharded over the mesh).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.agents.impact import ImpactAgent
+from scalerl_tpu.config import ImpactArguments
+from scalerl_tpu.data.circular import CircularTrajectoryBuffer
+from scalerl_tpu.data.trajectory import Trajectory
+
+
+def _args(**kw):
+    base = dict(
+        rollout_length=6, batch_size=8, use_lstm=False, max_timesteps=0,
+        num_actors=2, num_buffers=4, hidden_size=32,
+        logger_backend="none", telemetry_interval_s=0.0,
+        replay_times=2, surrogate_capacity=4, target_update_frequency=3,
+    )
+    base.update(kw)
+    return ImpactArguments(**base)
+
+
+def _traj(T1=7, B=8, seed=1):
+    ks = [jax.random.PRNGKey(seed + i) for i in range(4)]
+    return Trajectory(
+        obs=jax.random.normal(ks[0], (T1, B, 4)),
+        action=jax.random.randint(ks[1], (T1, B), 0, 2),
+        reward=jax.random.normal(ks[2], (T1, B)),
+        done=jnp.zeros((T1, B), bool),
+        logits=jax.random.normal(ks[3], (T1, B, 2)),
+        core_state=(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the circular surrogate buffer
+
+
+def test_circular_buffer_replay_credits():
+    buf = CircularTrajectoryBuffer(capacity=2, replay_times=2)
+    buf.add("a")
+    assert buf.sample() == "a" and buf.sample() == "a"
+    # credits spent: falls back to the freshest chunk, counted
+    assert buf.sample() == "a"
+    assert buf.overdraws == 1
+    buf.add("b")
+    got = [buf.sample(), buf.sample()]
+    assert got == ["b", "b"]
+
+
+def test_circular_buffer_round_robins_and_evicts():
+    buf = CircularTrajectoryBuffer(capacity=2, replay_times=2)
+    buf.add("a")
+    buf.add("b")
+    first_four = [buf.sample() for _ in range(4)]
+    assert sorted(first_four) == ["a", "a", "b", "b"]  # mixes both chunks
+    buf.add("c")  # ring full: overwrites the oldest ("a")
+    assert "a" not in buf._chunks
+    assert len(buf) == 2
+    assert buf.stats()["inserted"] == 3
+
+
+def test_circular_buffer_validation():
+    with pytest.raises(ValueError):
+        CircularTrajectoryBuffer(capacity=0, replay_times=1)
+    with pytest.raises(ValueError):
+        CircularTrajectoryBuffer(capacity=1, replay_times=0)
+    with pytest.raises(ValueError):
+        CircularTrajectoryBuffer(capacity=1, replay_times=1).sample()
+
+
+# ---------------------------------------------------------------------------
+# the clipped-target learner
+
+
+def test_target_network_refresh_cadence():
+    """pi_target stays FIXED between refreshes and syncs to pi exactly
+    every ``target_update_frequency`` updates — inside the jitted step."""
+    agent = ImpactAgent(
+        _args(target_update_frequency=3), obs_shape=(4,), num_actions=2,
+        obs_dtype=jnp.float32,
+    )
+    traj = _traj()
+    t0 = jax.tree_util.tree_map(np.asarray, agent.state.target_params)
+
+    def tree_equal(a, b):
+        return all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(
+                jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+            )
+        )
+
+    # replay_times=2 => each learn() call is 2 updates; after the first
+    # call step=2 (no refresh yet: 3 does not divide 1 or 2)
+    agent.learn(traj)
+    assert int(agent.state.step) == 2
+    assert tree_equal(t0, agent.state.target_params)
+    assert not tree_equal(agent.state.params, agent.state.target_params)
+    # next call crosses step 3: the target refreshes to the then-current
+    # params and diverges from its initial copy
+    agent.learn(traj)
+    assert int(agent.state.step) == 4
+    assert not tree_equal(t0, agent.state.target_params)
+
+
+def test_learn_counts_frames_once_per_chunk():
+    """K replays of a chunk must not inflate the frame axis: env_frames
+    advances by T*B per learn() call, independent of replay_times."""
+    agent = ImpactAgent(
+        _args(replay_times=3), obs_shape=(4,), num_actions=2,
+        obs_dtype=jnp.float32,
+    )
+    traj = _traj()
+    agent.learn(traj)
+    T, B = traj.reward.shape[0] - 1, traj.reward.shape[1]
+    assert int(agent.state.env_frames) == T * B
+    assert int(agent.state.step) == 3  # but the learner really stepped K times
+    assert agent.surrogate.stats()["sampled"] == 3
+
+
+def test_impact_metrics_and_clip():
+    agent = ImpactAgent(
+        _args(), obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32
+    )
+    m = agent.learn(_traj())
+    for key in ("total_loss", "pg_loss", "mean_ratio", "mean_clip_frac", "grad_norm"):
+        assert np.isfinite(m[key]), key
+    # first update: pi == pi_target, so every ratio is exactly 1 and
+    # nothing clips — the surrogate reduces to the unclipped objective
+    assert m["mean_clip_frac"] <= 0.5  # later replays may clip; first can't dominate
+
+
+def test_impact_first_update_ratio_is_one():
+    """With pi == pi_target (fresh agent, first update), the surrogate
+    ratio is identically 1."""
+    agent = ImpactAgent(
+        _args(replay_times=1), obs_shape=(4,), num_actions=2,
+        obs_dtype=jnp.float32,
+    )
+    m = agent.learn(_traj())
+    assert abs(m["mean_ratio"] - 1.0) < 1e-5
+    assert m["mean_clip_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# composition with the sharded learner plane
+
+
+def test_impact_transformer_sharded_learner():
+    """IMPACT + transformer + dp×mp: the heavier sharded learn step with
+    the replay counterweight, end to end on the virtual mesh."""
+    args = _args(
+        policy_arch="transformer", d_model=32, n_heads=2, n_layers=1,
+        replay_times=2,
+    )
+    agent = ImpactAgent(
+        args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32
+    )
+    agent.enable_mesh("dp=4,mp=2")
+    n_mp = sum(
+        1
+        for leaf in jax.tree_util.tree_leaves(agent.state.params)
+        if any(s == "mp" for s in leaf.sharding.spec if s is not None)
+    )
+    assert n_mp >= 2
+    traj = _traj()
+    m = agent.learn(traj)
+    assert np.isfinite(m["total_loss"])
+    assert int(agent.state.step) == 2
+    m = agent.learn(traj)
+    assert np.isfinite(m["total_loss"])
+    assert int(agent.state.step) == 4

@@ -91,3 +91,73 @@ def test_measured_drift_warning_fields():
     }
     _measured_drift(other)
     assert "measured_s_drift" not in other
+
+
+def test_bench_history_values_like_for_like(tmp_path, monkeypatch):
+    """The gate's history lookup is like-for-like (ISSUE 7 satellite):
+    only rows with the same metric AND mode AND mesh shape gate each
+    other — a dp=8 sharded number never fails a dp=4,mp=2 run, and
+    default-mode rows (no mode/mesh keys) keep gating each other exactly
+    as before."""
+    from tools.tpu_watch import _bench_history_values
+
+    rows = [
+        {"metric": "sharded_train_step_frames_per_sec", "mode": "sharded",
+         "mesh": "dp=4,mp=2", "value": 100.0},
+        {"metric": "sharded_train_step_frames_per_sec", "mode": "sharded",
+         "mesh": "dp=8", "value": 900.0},
+        {"metric": "impala_atari_env_frames_per_sec_per_chip",
+         "value": 42.0},
+        {"metric": "impala_atari_env_frames_per_sec_per_chip",
+         "mode": "anakin", "value": 77.0},
+    ]
+    artifact = tmp_path / "BENCH_r09.json"
+    artifact.write_text(
+        "".join(json.dumps({"n": i, "parsed": r}) for i, r in enumerate(rows))
+    )
+    import tools.tpu_watch as tw
+
+    monkeypatch.setattr(tw, "REPO", str(tmp_path))
+    assert _bench_history_values(
+        "sharded_train_step_frames_per_sec", "sharded", "dp=4,mp=2"
+    ) == [100.0]
+    assert _bench_history_values(
+        "sharded_train_step_frames_per_sec", "sharded", "dp=8"
+    ) == [900.0]
+    # default rows: no mode/mesh keys on either side
+    assert _bench_history_values(
+        "impala_atari_env_frames_per_sec_per_chip"
+    ) == [42.0]
+    assert _bench_history_values(
+        "impala_atari_env_frames_per_sec_per_chip", "anakin"
+    ) == [77.0]
+
+
+def test_sharded_bench_artifact_schema():
+    """bench --mode sharded artifacts carry the like-for-like comparison
+    keys the gate needs: mode, mesh, params_total, params_per_chip."""
+    import re
+    import subprocess
+    import sys as _sys
+
+    env = dict(
+        __import__("os").environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    out = subprocess.run(
+        [_sys.executable, str(REPO / "bench.py"), "--run", "--cpu",
+         "--bench-mode", "sharded"],
+        env=env, capture_output=True, text=True, timeout=500, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [
+        l for l in out.stdout.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ][-1]
+    result = json.loads(line)
+    assert result["metric"] == "sharded_train_step_frames_per_sec"
+    assert result["mode"] == "sharded"
+    assert re.fullmatch(r"dp=\d+(,mp=\d+)?", result["mesh"])
+    assert result["params_total"] > result["params_per_chip"] > 0
+    assert result["value"] > 0

@@ -11,6 +11,8 @@ On contact (re-armed up to 3 times, 30 min apart) it runs, in order:
   1. ``bench.py --fast`` (micro-witness banked within ~60 s),
   2. ``bench.py`` (fused-loop fps + MFU; appends to ``BENCH_TPU.md``),
   2b. ``bench.py --mesh dp=N`` when the tunnel exposes >1 chip,
+  2c. ``bench.py --mode sharded`` (dp×mp pjit transformer train step:
+      MFU + params-per-chip, perf-gated like-for-like per mesh shape),
   3. ``bench.py --learn`` (train-step-only MFU at the north-star shape),
   4. ``pytest tests_tpu`` (compiled Pallas kernels + shard_map legality),
   5. ``examples/profile_fused_loop.py`` (idle fraction),
@@ -158,10 +160,13 @@ def perf_gate_verdict(
     return new_value >= (1.0 - threshold) * median, median
 
 
-def _bench_history_values(metric: str):
-    """fps values for ``metric`` from the committed bench history,
-    excluding alternate-mode rows (anakin runs carry a ``mode`` field and
-    gate only against other anakin runs)."""
+def _bench_history_values(metric: str, mode=None, mesh=None):
+    """fps values from the committed bench history, LIKE-FOR-LIKE: only
+    rows with the same metric AND the same ``mode`` (anakin/sharded vs
+    default) AND the same ``mesh`` shape gate each other — a dp=8 number
+    must never fail a dp=4,mp=2 run (params-per-chip and collective mix
+    differ by design; the artifact schema records both so the comparison
+    stays honest)."""
     sys.path.insert(0, REPO)
     try:
         from bench import load_bench_history
@@ -170,7 +175,9 @@ def _bench_history_values(metric: str):
     return [
         float(h.get("value") or 0.0)
         for h in load_bench_history(REPO)
-        if h.get("metric") == metric and "mode" not in h
+        if h.get("metric") == metric
+        and h.get("mode") == mode
+        and h.get("mesh") == mesh
     ]
 
 
@@ -188,6 +195,10 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
         with open(bl.name, "r", errors="replace") as f:
             f.seek(start_offset)
             segment = f.read()
+        gated_metrics = {
+            "impala_atari_env_frames_per_sec_per_chip",
+            "sharded_train_step_frames_per_sec",
+        }
         result = None
         for line in segment.splitlines():
             line = line.strip()
@@ -197,21 +208,24 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
                 obj = json.loads(line)
             except ValueError:
                 continue
-            if obj.get("metric") == "impala_atari_env_frames_per_sec_per_chip":
+            if obj.get("metric") in gated_metrics:
                 result = obj
         if not result or not result.get("value"):
             return ""
-        if "mode" in result:  # anakin etc.: no committed history yet
-            return ""
         ok, median = perf_gate_verdict(
             float(result["value"]),
-            _bench_history_values(result["metric"]),
+            # like-for-like: same metric, same mode (anakin/sharded/default),
+            # same mesh shape — cross-shape comparisons never gate
+            _bench_history_values(
+                result["metric"], result.get("mode"), result.get("mesh")
+            ),
         )
         if ok or median is None:
             return ""
         bl.write(
-            f"[watcher] PERF GATE: {result['value']} fps/chip is >20% below "
-            f"the committed history median {median} — failing the step\n"
+            f"[watcher] PERF GATE: {result['value']} fps is >20% below "
+            f"the committed like-for-like history median {median} — "
+            "failing the step\n"
         )
         return f"+perf-drop({result['value']}<0.8x{median})"
     except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
@@ -286,6 +300,11 @@ def run_payload(n_devices: int = 1) -> None:
         # rollout+learn chunks with the transfer guard armed; reports its
         # own MFU from the super-chunk executable's cost analysis
         ("bench-anakin", [sys.executable, "bench.py", "--mode", "anakin"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # dp×mp sharded learner: the pjit transformer train step with
+        # heads/mlp/vocab over mp — reports MFU + params-per-chip and is
+        # perf-gated like-for-like against history at the same mesh shape
+        ("bench-sharded", [sys.executable, "bench.py", "--mode", "sharded"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
