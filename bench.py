@@ -426,6 +426,121 @@ def _run_sharded_measurement(mesh_spec: str | None) -> None:
     print(json.dumps(result))
 
 
+def _run_serving_measurement() -> None:
+    """``--mode serving``: the centralized inference plane's headline
+    numbers — act requests/sec through the InferenceServer's dynamic
+    batcher, the latency SLO quantiles (p50/p95/p99) from the serving
+    histogram, and mean batch occupancy.
+
+    Hermetic in-process shape: N client threads over codec pipe pairs
+    hammer a small MLP policy — every byte flows through the same framing/
+    batching/flush path remote env-shell hosts use over sockets, so the
+    number measures the serving machinery (admission, bucketing, one
+    upload + one read per flush), not env dynamics.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.serving import (
+        InferenceServer,
+        RemotePolicyClient,
+        ServingConfig,
+        local_pair,
+    )
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+    obs_dim, num_actions = 64, 16
+    if on_accel:
+        n_clients, lanes, max_batch, target_s = 16, 16, 256, 10.0
+    else:
+        n_clients, lanes, max_batch, target_s = 4, 4, 32, 4.0
+
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=256, rollout_length=8, batch_size=4,
+        num_actors=1, num_buffers=2, max_timesteps=0, logger_backend="none",
+    )
+    agent = ImpalaAgent(
+        args, obs_shape=(obs_dim,), num_actions=num_actions,
+        obs_dtype=jnp.float32,
+    )
+    server = InferenceServer(
+        agent, ServingConfig(max_batch=max_batch, max_wait_s=0.002)
+    )
+    server.start()
+    clients = []
+    for _ in range(n_clients):
+        c_end, s_end = local_pair()
+        server.add_connection(s_end)
+        clients.append(RemotePolicyClient(conn=c_end, request_timeout_s=60.0))
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(lanes, obs_dim)).astype(np.float32)
+    la = np.zeros(lanes, np.int32)
+    rew = np.zeros(lanes, np.float32)
+    done = np.zeros(lanes, bool)
+
+    # warmup: every client round-trips once so the flush buckets compile
+    # before the measured window (the steady-state guard arms after this)
+    for c in clients:
+        c.act(obs, la, rew, done, ())
+
+    stop = threading.Event()
+    counts = [0] * n_clients
+
+    def hammer(i: int) -> None:
+        c = clients[i]
+        while not stop.is_set():
+            c.act(obs, la, rew, done, ())
+            counts[i] += 1
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    flushes0 = server.flushes
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(target_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t0
+    requests = sum(counts)
+    slo = server.slo()
+    occ = slo["batch_occupancy_mean"]
+    result = {
+        "metric": "serving_requests_per_sec",
+        "mode": "serving",
+        "value": round(requests / elapsed, 1),
+        "unit": f"act requests/sec ({platform}, {n_clients} clients x "
+                f"{lanes} lanes)",
+        "lane_steps_per_sec": round(requests * lanes / elapsed, 1),
+        "p50_ms": round(slo["p50_ms"], 3),
+        "p95_ms": round(slo["p95_ms"], 3),
+        "p99_ms": round(slo["p99_ms"], 3),
+        "batch_occupancy": round(occ, 4),
+        "flushes": server.flushes - flushes0,
+        "shed_total": server.batcher.shed_total,
+        "n_clients": n_clients,
+        "lanes": lanes,
+        "max_batch": max_batch,
+        "device_kind": device_kind,
+        "measured_s": round(elapsed, 1),
+    }
+    for c in clients:
+        c.close()
+    server.stop()
+    print(json.dumps(result))
+
+
 def _mesh_axis(mesh_spec: str, axis: str) -> int:
     import re as _re
 
@@ -471,6 +586,10 @@ def _run_measurement(
         # its own program entirely (dp×mp pjit train step on the
         # transformer policy); prints backend + one JSON line itself
         _run_sharded_measurement(mesh_spec)
+        return
+    if mode == "serving":
+        # the centralized inference plane: requests/sec + latency SLO
+        _run_serving_measurement()
         return
 
     # backend already pinned by __main__ when --cpu; "auto" here just turns
@@ -880,6 +999,7 @@ def main(
     fail_metric = (
         "impala_learn_step_frames_per_sec" if learn
         else "sharded_train_step_frames_per_sec" if mode == "sharded"
+        else "serving_requests_per_sec" if mode == "serving"
         else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
         else "impala_atari_env_frames_per_sec_per_chip"
     )
@@ -1105,9 +1225,10 @@ if __name__ == "__main__":
             if _mi + 1 >= len(sys.argv):
                 raise SystemExit("--mode requires an argument (anakin | sharded)")
             _mode = sys.argv[_mi + 1]
-            if _mode not in ("anakin", "sharded"):
+            if _mode not in ("anakin", "sharded", "serving"):
                 raise SystemExit(
-                    f"unknown --mode {_mode!r}; supported: anakin, sharded"
+                    f"unknown --mode {_mode!r}; supported: anakin, sharded, "
+                    "serving"
                 )
         try:
             main(
@@ -1125,6 +1246,8 @@ if __name__ == "__main__":
                             if "--learn" in sys.argv[1:]
                             else "sharded_train_step_frames_per_sec"
                             if _mode == "sharded"
+                            else "serving_requests_per_sec"
+                            if _mode == "serving"
                             else "impala_atari_env_frames_per_sec_aggregate"
                             if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"

@@ -9,7 +9,13 @@ the reference, SURVEY.md §2.4 — this one runs).  Two backends:
   threads`` (default) runs SEED-RL topology (central batched inference);
   ``--actor-mode process`` runs monobeast topology (spawned actor processes
   with local CPU inference over the C++ shm ring — the reference's
-  ``impala_atari.py`` architecture, GIL-free across host cores).
+  ``impala_atari.py`` architecture, GIL-free across host cores);
+  ``--actor-mode serving`` runs the full centralized inference plane
+  (``scalerl_tpu/serving/``): actors act through ``RemotePolicyClient``
+  against an ``InferenceServer`` holding the one hot policy, with dynamic
+  batching, generation-tagged params, and a latency SLO printed at the end
+  (docs/DISTRIBUTED.md §4; knobs ``--serve-max-batch``,
+  ``--serve-max-wait-ms``, ``--serve-max-pending``).
 
 Usage::
 
@@ -108,6 +114,9 @@ def main() -> None:
     try:
         result = trainer.train(total_frames=args.total_steps)
         print("final:", {k: round(float(v), 3) for k, v in result.items()})
+        if getattr(trainer, "inference_server", None) is not None:
+            slo = trainer.inference_server.slo()
+            print("serving SLO:", {k: round(float(v), 3) for k, v in slo.items()})
         if args.save_model and not args.disable_checkpoint:
             path = agent.save_checkpoint(os.path.join(trainer.model_save_dir, "ckpt_final"))
             print("checkpoint:", path)

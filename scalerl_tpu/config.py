@@ -494,8 +494,21 @@ class ImpalaArguments(RLArguments):
     # host actor topology: "threads" = SEED-style central inference
     # (HostActorLearnerTrainer); "process" = monobeast-style actor processes
     # with local CPU inference over the shm ring (the reference's topology,
-    # impala_atari.py:153-220)
+    # impala_atari.py:153-220); "serving" = the full centralized inference
+    # plane (scalerl_tpu/serving/): actors act through RemotePolicyClient
+    # against an InferenceServer holding the one hot policy, with dynamic
+    # batching, generation-tagged params, and latency SLO telemetry
     actor_mode: str = "threads"
+    # Inference-plane knobs (ServingConfig.from_args; only read when
+    # actor_mode="serving" or by the standalone server entrypoints):
+    # flush a serve batch at this many pending env lanes ...
+    serve_max_batch: int = 64
+    # ... or once the oldest pending request has waited this long
+    serve_max_wait_ms: float = 5.0
+    # bounded admission: shed act requests beyond this queue depth instead
+    # of letting the queue (and therefore latency + policy lag) grow
+    # without bound; 0 disables shedding
+    serve_max_pending: int = 256
     num_buffers: int = 32  # free/full queue depth (impala_atari.py:72)
     num_learner_threads: int = 1
     batch_size: int = 8
@@ -551,6 +564,23 @@ class ImpalaArguments(RLArguments):
                 "num_buffers (slot count) must be at least "
                 "max(2, num_actors) "
                 f"(got {self.num_buffers}, num_actors={self.num_actors})"
+            )
+        if self.actor_mode not in ("threads", "process", "serving"):
+            raise ValueError(
+                "actor_mode must be threads | process | serving, got "
+                f"{self.actor_mode!r}"
+            )
+        if self.serve_max_batch < 1:
+            raise ValueError(
+                f"serve_max_batch must be >= 1, got {self.serve_max_batch}"
+            )
+        if self.serve_max_wait_ms < 0:
+            raise ValueError(
+                f"serve_max_wait_ms must be >= 0, got {self.serve_max_wait_ms}"
+            )
+        if self.serve_max_pending < 0:
+            raise ValueError(
+                f"serve_max_pending must be >= 0, got {self.serve_max_pending}"
             )
 
 

@@ -94,6 +94,13 @@ class FleetConfig:
     reconnect_backoff_s: float = 0.5
     reconnect_backoff_cap_s: float = 10.0
     max_reconnects: int = 5
+    # Bounded admission (the fleet-wide max_pending/shed_total vocabulary,
+    # shared with RolloutQueue and the inference batcher): when > 0, the
+    # server hub sheds the stalest queued inbound message once this many
+    # are pending instead of blocking its recv pump on a slow consumer —
+    # unbounded queue growth silently becomes latency and policy lag.
+    # 0 (default) keeps the pre-serving block-on-full behavior.
+    max_pending: int = 0
     # Telemetry plane (runtime/telemetry.py): gathers piggyback compact
     # registry snapshots (their own counters + per-worker payloads relayed
     # from worker results) on heartbeat pongs and result-upload frames; the
@@ -567,6 +574,7 @@ class WorkerServer:
             else 0.0,
             on_dead=self._on_dead_connection,
             on_telemetry=lambda _conn, payload: self.telemetry.absorb_payload(payload),
+            max_pending=config.max_pending,
         )
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
         self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()

@@ -63,12 +63,21 @@ class QueueHub:
         first_contact_grace: float = 120.0,
         on_dead: Optional[Callable[[Connection, str], None]] = None,
         on_telemetry: Optional[Callable[[Connection, Any], None]] = None,
+        max_pending: int = 0,
     ) -> None:
+        # max_pending > 0 arms BOUNDED ADMISSION on the inbound queue: when
+        # the consumer lags that far behind, the stalest queued message is
+        # shed (counted in shed_total) instead of the recv pump blocking on
+        # a full queue — a blocked pump stops answering pings and the whole
+        # liveness plane rots behind one slow consumer.  0 keeps the old
+        # block-on-full behavior (maxsize still bounds memory).
         self.input_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
         self.output_queue: "queue.Queue[Tuple[Connection, Any]]" = queue.Queue(maxsize)
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_timeout = heartbeat_timeout or 2.0 * heartbeat_interval
         self.first_contact_grace = max(first_contact_grace, self.heartbeat_timeout)
+        self.max_pending = max_pending
+        self.shed_total = 0
         self.on_dead = on_dead
         # piggybacked telemetry: any inbound dict carrying a "telem" key —
         # heartbeat pongs and result-upload frames — has the payload handed
@@ -82,6 +91,7 @@ class QueueHub:
             lambda: {
                 "protocol_errors": self.protocol_errors,
                 "peers_dropped": self.peers_dropped,
+                "shed_total": self.shed_total,
                 "connections": self.connection_count(),
                 "input_depth": self.input_queue.qsize(),
                 "output_depth": self.output_queue.qsize(),
@@ -184,7 +194,30 @@ class QueueHub:
                     if msg.get("kind") == "ping":
                         self.send(conn, make_pong(msg))
                     continue
-                self.input_queue.put((conn, msg))
+                if self.max_pending > 0:
+                    # bounded admission: shed the STALEST queued message so
+                    # the freshest data survives and the pump never blocks
+                    # (a blocked pump stops answering pings); the loop also
+                    # covers max_pending >= queue maxsize, where put_nowait
+                    # is the binding constraint
+                    while True:
+                        if self.input_queue.qsize() >= self.max_pending:
+                            self._shed_one()
+                        try:
+                            self.input_queue.put_nowait((conn, msg))
+                            break
+                        except queue.Full:
+                            self._shed_one()
+                else:
+                    self.input_queue.put((conn, msg))
+
+    def _shed_one(self) -> None:
+        try:
+            self.input_queue.get_nowait()
+        except queue.Empty:
+            return
+        self.shed_total += 1
+        telemetry.get_registry().counter("hub.shed_total").inc()
 
     def _send_loop(self) -> None:
         while not self._stop.is_set():

@@ -29,11 +29,25 @@ from scalerl_tpu.runtime import telemetry
 
 
 class RolloutQueue:
-    def __init__(self, spec: TrajectorySpec, num_slots: int) -> None:
+    def __init__(
+        self, spec: TrajectorySpec, num_slots: int, max_pending: int = 0
+    ) -> None:
+        """``max_pending`` > 0 arms bounded admission on the full queue:
+        a ``commit`` that would leave more than ``max_pending`` consumable
+        slots sheds the STALEST one back to the free pool instead
+        (``shed_total``).  Queue depth IS worst-case policy lag (the
+        host-plane Breakout stall, docs/PERFORMANCE.md), so a slow learner
+        now costs dropped-oldest rollouts — bounded staleness — rather
+        than unbounded lag.  0 keeps the old behavior (depth bounded only
+        by ``num_slots``)."""
         if num_slots < 2:
             raise ValueError(f"num_slots must be >= 2, got {num_slots}")
+        if max_pending < 0:
+            raise ValueError(f"max_pending must be >= 0, got {max_pending}")
         self.spec = spec
         self.num_slots = num_slots
+        self.max_pending = max_pending
+        self.shed_total = 0
         self.slots: List[Dict[str, np.ndarray]] = [
             spec.host_zeros() for _ in range(num_slots)
         ]
@@ -63,6 +77,17 @@ class RolloutQueue:
         return None
 
     def commit(self, idx: int) -> None:
+        if self.max_pending > 0 and self.full.qsize() >= self.max_pending:
+            # bounded admission: recycle the stalest full slot so the
+            # freshest rollout is what the learner trains on next
+            try:
+                stale = self.full.get_nowait()
+            except queue.Empty:
+                stale = None
+            if stale is not None:
+                self.free.put(stale)
+                self.shed_total += 1
+                telemetry.get_registry().counter("queue.shed_total").inc()
         self.full.put(idx)
 
     def report_error(self, exc: BaseException) -> None:
@@ -143,6 +168,7 @@ class RolloutQueue:
             "free": free,
             "full": full,
             "in_flight": max(self.num_slots - free - full, 0),
+            "shed_total": self.shed_total,
             "closed": int(self._closed.is_set()),
         }
 

@@ -170,7 +170,8 @@ class Histogram:
         with self._lock:
             if self.count == 0:
                 return {"count": 0.0, "sum": 0.0, "mean": 0.0,
-                        "min": 0.0, "max": 0.0, "p50": 0.0, "p99": 0.0}
+                        "min": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0,
+                        "p99": 0.0}
             out = {
                 "count": float(self.count),
                 "sum": self.sum,
@@ -179,6 +180,7 @@ class Histogram:
                 "max": self.max,
             }
         out["p50"] = self.quantile(0.50)
+        out["p95"] = self.quantile(0.95)  # the serving SLO quantile
         out["p99"] = self.quantile(0.99)
         return out
 
@@ -377,7 +379,7 @@ class MetricsRegistry:
         out: Dict[str, float] = {}
         for name, value in self.scalars(prefix).items():
             # drop the per-quantile histogram fields from the wire payload
-            if name.endswith((".p50", ".p99", ".min", ".max", ".sum")):
+            if name.endswith((".p50", ".p95", ".p99", ".min", ".max", ".sum")):
                 continue
             out[name] = value
         return out

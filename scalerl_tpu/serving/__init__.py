@@ -1,0 +1,55 @@
+"""Centralized inference plane: SEED-style batched serving on the learner host.
+
+One hot jitted policy on device (:class:`InferenceServer`), thin env-shell
+workers streaming observations to it over the codec-v2 fleet transport
+(:class:`RemotePolicyClient`), dynamic batching with bucketed static shapes
+(:class:`DynamicBatcher`), bounded admission with explicit load shedding,
+and generation-tagged parameters feeding V-trace's behavior-policy
+correction and a staleness gauge.  docs/DISTRIBUTED.md "Centralized
+inference plane" has the wire shape, knob tables, and the SLO row.
+"""
+
+from scalerl_tpu.serving.batcher import (
+    DynamicBatcher,
+    ServingConfig,
+    ServingRequest,
+    bucket_for,
+    default_buckets,
+)
+from scalerl_tpu.serving.client import (
+    PendingReply,
+    RemotePolicyClient,
+    ServingUnavailable,
+)
+from scalerl_tpu.serving.server import InferenceServer
+
+
+def local_pair(chaos_site: str = "serve_pipe"):
+    """An in-process duplex connection pair (client_end, server_end) for
+    same-host serving (the trainer's ``actor_mode='serving'`` wiring) —
+    both ends speak the codec, so the wire shape matches sockets exactly
+    and the chaos injector can fault the link under the ``serve`` site
+    prefix like any other transport."""
+    import multiprocessing as mp
+
+    from scalerl_tpu.fleet.transport import PipeConnection
+
+    a, b = mp.Pipe(duplex=True)
+    return (
+        PipeConnection(a, chaos_site=chaos_site),
+        PipeConnection(b, chaos_site=chaos_site),
+    )
+
+
+__all__ = [
+    "DynamicBatcher",
+    "InferenceServer",
+    "PendingReply",
+    "RemotePolicyClient",
+    "ServingConfig",
+    "ServingRequest",
+    "ServingUnavailable",
+    "bucket_for",
+    "default_buckets",
+    "local_pair",
+]

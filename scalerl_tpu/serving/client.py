@@ -1,0 +1,379 @@
+"""RemotePolicyClient: the thin env-shell worker's view of the inference plane.
+
+Implements the same acting facade the actor planes already consume
+(``act(obs, last_action, reward, done, core_state)`` + ``initial_state``),
+but every neural-net forward happens on the central
+:class:`~scalerl_tpu.serving.server.InferenceServer` — the worker keeps
+only envs and numpy buffers (SEED-RL's thin-actor shape).  jax-free by
+design: importing this in a spawned env-shell process costs pennies.
+
+Robustness contract (rides PR 2's vocabulary):
+
+- **pipelined async request/response** over ONE connection: requests carry
+  ids, a background reader demuxes replies, so multiple actor threads share
+  a single uplink and a request can be in flight while the caller prepares
+  the next one (``act_async``/``PendingReply``);
+- **reconnect with capped exponential backoff** on a lost/corrupt link
+  (``supervisor.exp_backoff``; a chaos bit-flip surfaces as
+  ``ProtocolError`` -> the server drops the link -> the client redials and
+  resends the in-flight request — at-least-once acting, harmless because
+  inference has no side effects);
+- **local fallback**: when the reconnect budget is exhausted (or the
+  server sheds under load and a fallback policy was provided), the client
+  flips to local inference instead of stalling the env loop — the worker
+  degrades to the pre-serving topology, it does not die.
+
+Every reply carries the parameter ``generation`` that served it; the
+client exposes the newest one (``.generation``) so the trainer can record
+per-transition behavior-policy versions and a staleness gauge.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import nullcontext
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from scalerl_tpu.fleet.transport import Connection
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.supervisor import exp_backoff, is_heartbeat, make_pong
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ServingUnavailable(ConnectionError):
+    """The server is unreachable and no local fallback was configured."""
+
+
+class PendingReply:
+    """A demuxed in-flight request: ``result()`` blocks for the reply."""
+
+    __slots__ = ("req_id", "_event", "_reply", "link_epoch")
+
+    def __init__(self, req_id: int, link_epoch: int) -> None:
+        self.req_id = req_id
+        self.link_epoch = link_epoch
+        self._event = threading.Event()
+        self._reply: Optional[Dict[str, Any]] = None
+
+    def deliver(self, reply: Optional[Dict[str, Any]]) -> None:
+        self._reply = reply
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"no reply for request {self.req_id}")
+        if self._reply is None:
+            raise ConnectionError("serving link lost while request in flight")
+        return self._reply
+
+
+def _as_core(core) -> Tuple:
+    """Normalize a codec-decoded core payload to a tuple of (c, h) pairs."""
+    if not core:
+        return ()
+    return tuple((np.asarray(pair[0]), np.asarray(pair[1])) for pair in core)
+
+
+class RemotePolicyClient:
+    """Acting facade over a serving connection, with reconnect + fallback.
+
+    ``conn``: an established :class:`Connection` (in-process pipe pair or a
+    pre-dialed socket).  ``connect``: zero-arg factory producing a fresh
+    connection — the reconnect path; without it a lost link goes straight
+    to the fallback (in-process pipes cannot be redialed).  ``fallback``:
+    an object with the same ``act``/``initial_state`` facade (typically the
+    local agent) used when the server is unreachable or sheds.
+    """
+
+    # duck-typing marker: trainers skip their mesh dispatch guard around a
+    # remote act (it is host IO — holding the mesh lock across a network
+    # round trip would serialize the learner against network latency)
+    _remote_policy = True
+
+    def __init__(
+        self,
+        conn: Optional[Connection] = None,
+        connect: Optional[Callable[[], Connection]] = None,
+        fallback: Any = None,
+        request_timeout_s: float = 30.0,
+        max_reconnects: int = 5,
+        reconnect_backoff_s: float = 0.2,
+        reconnect_backoff_cap_s: float = 2.0,
+        max_attempts: int = 8,
+        dispatch_guard: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        """``dispatch_guard``: context-manager factory entered around the
+        LOCAL fallback policy's dispatch (the remote path never needs it);
+        serving trainers pass their mesh guard so a degraded client cannot
+        interleave multi-device enqueues with the learner."""
+        if conn is None and connect is None:
+            raise ValueError("need a connection or a connect factory")
+        self._connect = connect
+        self._fallback = fallback
+        self._guard = dispatch_guard or nullcontext
+        self.request_timeout_s = request_timeout_s
+        self.max_reconnects = max_reconnects
+        self.reconnect_backoff_s = reconnect_backoff_s
+        self.reconnect_backoff_cap_s = reconnect_backoff_cap_s
+        self.max_attempts = max_attempts
+        self.reconnects_used = 0
+        self.fallen_back = False
+        self.generation = 0  # newest param generation seen in a reply
+        self._ids = itertools.count(1)
+        self._send_lock = threading.Lock()
+        self._link_lock = threading.Lock()
+        self._link_epoch = 0
+        self._waiters: Dict[int, PendingReply] = {}
+        self._waiters_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._reg = telemetry.get_registry()
+        self._conn = conn if conn is not None else connect()
+        self._reader = self._start_reader()
+
+    # -- link plumbing --------------------------------------------------
+    def _start_reader(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._read_loop,
+            args=(self._conn, self._link_epoch),
+            name="serve-client-reader",
+            daemon=True,
+        )
+        t.start()
+        return t
+
+    def _read_loop(self, conn: Connection, epoch: int) -> None:
+        while not self._closed.is_set():
+            try:
+                msg = conn.recv(timeout=0.2)
+            except TimeoutError:
+                continue
+            except (ConnectionError, EOFError, OSError, ValueError):
+                # includes ProtocolError (a chaos bit-flip on the downlink):
+                # the stream is desynchronized, fail every in-flight waiter
+                # so their attempt loops redial and resend
+                self._fail_waiters(epoch)
+                return
+            if is_heartbeat(msg):
+                if isinstance(msg, dict) and msg.get("kind") == "ping":
+                    try:
+                        with self._send_lock:
+                            conn.send(make_pong(msg))
+                    except (ConnectionError, OSError):
+                        self._fail_waiters(epoch)
+                        return
+                continue
+            if not isinstance(msg, dict):
+                continue
+            waiter = None
+            with self._waiters_lock:
+                waiter = self._waiters.pop(msg.get("req"), None)
+            if waiter is not None:
+                waiter.deliver(msg)
+            # replies for abandoned requests (a retried act whose original
+            # answer arrived late) are dropped here — harmless duplicates
+
+    def _fail_waiters(self, epoch: int) -> None:
+        with self._waiters_lock:
+            waiters, self._waiters = dict(self._waiters), {}
+        for w in waiters.values():
+            if w.link_epoch <= epoch:
+                w.deliver(None)
+
+    def _revive_link(self, seen_epoch: int, why: BaseException) -> None:
+        """Replace a dead link (one winner; racers adopt the result).
+
+        Exhausted budget or no factory -> flip to the local fallback when
+        one exists, else raise :class:`ServingUnavailable`.
+        """
+        with self._link_lock:
+            if self._closed.is_set():
+                # shutdown, not failure: callers route to the fallback
+                # without flipping the degraded-mode flag or redialing
+                raise ServingUnavailable("client closed")
+            if self.fallen_back:
+                return
+            if self._link_epoch != seen_epoch:
+                return  # another thread already revived the link
+            try:
+                self._conn.close()
+            except Exception:  # noqa: BLE001 — link already broken
+                pass
+            last: BaseException = why
+            while (
+                self._connect is not None
+                and self.reconnects_used < self.max_reconnects
+            ):
+                delay = exp_backoff(
+                    self.reconnects_used,
+                    self.reconnect_backoff_s,
+                    self.reconnect_backoff_cap_s,
+                )
+                self.reconnects_used += 1
+                self._reg.counter("serving_client.reconnects").inc()
+                telemetry.record_event(
+                    "serving_reconnect",
+                    attempt=self.reconnects_used,
+                    why=repr(why),
+                )
+                logger.warning(
+                    "serving client: link lost (%r); redialing in %.2fs "
+                    "(attempt %d/%d)",
+                    why, delay, self.reconnects_used, self.max_reconnects,
+                )
+                time.sleep(delay)
+                try:
+                    self._conn = self._connect()
+                    self._link_epoch += 1
+                    self._reader = self._start_reader()
+                    return
+                except (ConnectionError, OSError) as e:
+                    last = e
+            if self._fallback is not None:
+                self.fallen_back = True
+                self._reg.counter("serving_client.fallbacks").inc()
+                telemetry.record_event("serving_fallback", why=repr(last))
+                logger.error(
+                    "serving client: server unreachable (%r); falling back "
+                    "to LOCAL inference", last,
+                )
+                return
+            raise ServingUnavailable(
+                f"inference server unreachable after "
+                f"{self.reconnects_used} reconnect attempts"
+            ) from last
+
+    # -- request plumbing ----------------------------------------------
+    def _submit(self, msg: Dict[str, Any]) -> PendingReply:
+        req_id = next(self._ids)
+        msg["req"] = req_id
+        with self._link_lock:
+            epoch = self._link_epoch
+            conn = self._conn
+        waiter = PendingReply(req_id, epoch)
+        with self._waiters_lock:
+            self._waiters[req_id] = waiter
+        try:
+            with self._send_lock:
+                conn.send(msg)
+        except (ConnectionError, OSError) as e:
+            with self._waiters_lock:
+                self._waiters.pop(req_id, None)
+            self._revive_link(epoch, e)
+            raise ConnectionError("send failed; link revived or fallen back") from e
+        return waiter
+
+    def _rpc(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Send + wait with redial-and-resend; honors shed replies."""
+        shed_seen = 0
+        for attempt in range(self.max_attempts):
+            if self.fallen_back:
+                raise ServingUnavailable("client has fallen back to local")
+            if self._closed.is_set():
+                raise ServingUnavailable("client closed")
+            with self._link_lock:
+                epoch = self._link_epoch
+            waiter = None
+            try:
+                waiter = self._submit(dict(msg))
+                reply = waiter.result(timeout=self.request_timeout_s)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                if waiter is not None:  # abandoned: drop the demux slot
+                    with self._waiters_lock:
+                        self._waiters.pop(waiter.req_id, None)
+                self._reg.counter("serving_client.retries").inc()
+                self._revive_link(epoch, e)
+                continue
+            if reply.get("shed"):
+                # explicit load shed: bounded admission pushed back — yield
+                # briefly so the batcher drains, then retry (the fallback
+                # covers sustained overload via shed_to_fallback_after)
+                shed_seen += 1
+                self._reg.counter("serving_client.sheds").inc()
+                if self._fallback is not None and shed_seen >= 3:
+                    return {"use_fallback": True}
+                time.sleep(0.002 * shed_seen)
+                continue
+            if "error" in reply:
+                self._reg.counter("serving_client.errors").inc()
+                raise RuntimeError(f"serving error: {reply['error']}")
+            return reply
+        if self._fallback is not None:
+            return {"use_fallback": True}
+        raise ServingUnavailable(
+            f"no reply after {self.max_attempts} attempts"
+        )
+
+    # -- the acting facade ---------------------------------------------
+    def initial_state(self, batch_size: int):
+        if self.fallen_back and self._fallback is not None:
+            return self._fallback.initial_state(batch_size)
+        try:
+            reply = self._rpc({"kind": "core_init", "batch": int(batch_size)})
+        except ServingUnavailable:
+            if self._fallback is None:
+                raise
+            with self._guard():
+                return self._fallback.initial_state(batch_size)
+        if reply.get("use_fallback"):
+            with self._guard():
+                return self._fallback.initial_state(batch_size)
+        return _as_core(reply.get("core"))
+
+    def act_async(self, obs, last_action, reward, done, core_state) -> PendingReply:
+        """Fire one act request without waiting (pipelined callers)."""
+        return self._submit(self._act_msg(obs, last_action, reward, done,
+                                          core_state))
+
+    def _act_msg(self, obs, last_action, reward, done, core_state) -> Dict:
+        return {
+            "kind": "act",
+            "obs": np.asarray(obs),
+            "last_action": np.asarray(last_action, np.int32),
+            "reward": np.asarray(reward, np.float32),
+            "done": np.asarray(done, bool),
+            "core": tuple(
+                (np.asarray(c), np.asarray(h)) for c, h in core_state
+            ),
+        }
+
+    def act(self, obs, last_action, reward, done, core_state):
+        """Central batched inference with the local facade's signature:
+        returns ``(action, logits, new_core)`` as host numpy."""
+        if not self.fallen_back:
+            self._reg.counter("serving_client.requests").inc()
+            try:
+                reply = self._rpc(
+                    self._act_msg(obs, last_action, reward, done, core_state)
+                )
+            except ServingUnavailable:
+                if self._fallback is None:
+                    raise
+                reply = {"use_fallback": True}
+            if not reply.get("use_fallback"):
+                self.generation = int(reply.get("gen", self.generation))
+                return (
+                    np.asarray(reply["action"]),
+                    np.asarray(reply["logits"]),
+                    _as_core(reply.get("core")),
+                )
+        # degraded mode: local inference on the fallback policy keeps the
+        # env loop alive (the pre-serving topology); guarded — under a mesh
+        # this is a multi-device dispatch racing the learner's
+        with self._guard():
+            return self._fallback.act(obs, last_action, reward, done, core_state)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001 — teardown
+            pass
+        # wake every blocked waiter NOW: the reader may exit via its stop
+        # check without ever seeing the closed fd
+        self._fail_waiters(self._link_epoch)

@@ -162,6 +162,15 @@ class _ActorThread(threading.Thread):
     def _act_loop(self) -> None:
         tr = self.trainer
         agent = self.policy
+        # remote policies (serving plane) are host IO: entering the mesh
+        # dispatch guard around them would serialize the learner against
+        # network latency for no safety gain (the InferenceServer holds
+        # the guard around its own device dispatch)
+        dispatch_guard = (
+            None
+            if getattr(agent, "_remote_policy", False)
+            else getattr(tr, "_dispatch_guard", None)
+        )
         q = tr.queue
         T = tr.args.rollout_length
         B = self.envs.num_envs
@@ -190,7 +199,7 @@ class _ActorThread(threading.Thread):
                     T,
                     on_step=metrics.step,
                     timings=self.timings,
-                    dispatch_guard=getattr(tr, "_dispatch_guard", None),
+                    dispatch_guard=dispatch_guard,
                 )
                 q.commit(idx)
                 committed = True
@@ -356,6 +365,40 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         ]
         self.learn_timings = Timings()
 
+        # actor_mode="serving": the full centralized inference plane — the
+        # ONE hot policy lives in an InferenceServer (dynamic batcher,
+        # generation tags, SLO telemetry) and actor threads act through
+        # RemotePolicyClients over in-process codec links, exactly the wire
+        # shape remote env-shell hosts speak over sockets.  The agent
+        # doubles as each client's local fallback, so a dead server
+        # degrades the run to the thread topology instead of killing it.
+        self.inference_server = None
+        self._serving_clients: list = []
+        if getattr(args, "actor_mode", "threads") == "serving":
+            from scalerl_tpu.serving import (
+                InferenceServer,
+                RemotePolicyClient,
+                ServingConfig,
+                local_pair,
+            )
+
+            self.inference_server = InferenceServer(
+                agent,
+                ServingConfig.from_args(args),
+                dispatch_guard=self._dispatch_guard,
+            )
+            self.inference_server.start()
+            for _ in env_fns:
+                client_end, server_end = local_pair()
+                self.inference_server.add_connection(server_end)
+                self._serving_clients.append(
+                    RemotePolicyClient(
+                        conn=client_end,
+                        fallback=agent,
+                        dispatch_guard=self._dispatch_guard,
+                    )
+                )
+
     # grant_actor_restart / _resume_pytree / save_resume / try_resume come
     # from HostPlaneMixin (shared with the R2D2 plane)
 
@@ -380,7 +423,8 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         actors = []
         for i, fn in enumerate(self.env_fns):
             envs = self._probe_env if i == 0 else fn()
-            actors.append(_ActorThread(i, self, envs))
+            policy = self._serving_clients[i] if self._serving_clients else None
+            actors.append(_ActorThread(i, self, envs, policy=policy))
         self.actors = actors  # exposed for phase-timing inspection (bench)
         # supervision: SIGTERM/SIGINT -> save_resume at the next learn-step
         # boundary; watchdog dumps all-thread stacks + queue occupancy when
@@ -410,6 +454,7 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         )
         n_slots = max(args.batch_size // self.envs_per_actor, 1)
         metrics: Dict = {}
+        learn_steps_done = 0  # host-side counter (no device sync)
 
         # Optional assembly prefetch (wires the reference's num_learners
         # knob, ``impala_atari.py:439-456``): num_learner_threads - 1
@@ -485,6 +530,7 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                 with self._dispatch_guard():
                     metrics = self.agent.learn_device(traj)
                 self.learn_timings.time("learn")
+                learn_steps_done += 1
                 if learn_progress is not None:
                     learn_progress.bump()
                 # version bump only — actors do central inference on the
@@ -493,6 +539,14 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                 # device-side snapshot copy is itself a program: guard it
                 with self._dispatch_guard():
                     self.param_server.push(self.agent.get_weights(), to_host=False)
+                    if self.inference_server is not None:
+                        # serving plane: monotonic generation bump; every
+                        # act reply from here on is tagged with the new
+                        # generation (in-flight flushes keep their old tag)
+                        self.inference_server.push_params(
+                            self.agent.get_weights(),
+                            learner_step=learn_steps_done,
+                        )
 
                 if (
                     args.save_model
@@ -516,6 +570,14 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                     # one batched device->host transfer for the whole dict
                     # (per-key float() would pay a round trip per metric)
                     host_metrics = get_metrics(metrics)
+                    if self.inference_server is not None and self._serving_clients:
+                        # generation tags close the loop here: the lag
+                        # between the newest push and the oldest client's
+                        # last-served generation is the staleness V-trace
+                        # is correcting (serving.staleness gauge)
+                        self.inference_server.observe_staleness(
+                            min(c.generation for c in self._serving_clients)
+                        )
                     if self._instrument:
                         telemetry.observe_train_metrics(host_metrics)
                         reg = telemetry.get_registry()
@@ -542,6 +604,13 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
             if guard is not None:
                 guard.restore()
             self.queue.close()
+            if self.inference_server is not None:
+                # clients first: close() wakes blocked actors, which finish
+                # their current slot on the local fallback (no degraded-mode
+                # flip, no reconnect churn) and exit on stop_event
+                for c in self._serving_clients:
+                    c.close()
+                self.inference_server.stop()
             for t in assemble_threads:
                 t.join(timeout=3.0)
             if prefetch_q is not None:

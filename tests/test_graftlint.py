@@ -626,3 +626,102 @@ def test_jg001_flags_device_value_fed_to_gauge_in_loop():
     findings = lint(BAD_TELEMETRY_DEVICE_READ_LOOP)
     assert rules_of(findings) == ["JG001"]
     assert "float()" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# serving plane fixtures (ISSUE 8): scalerl_tpu/serving is a HOT package —
+# the inference server's flush loop must stay JG001-clean (one batched
+# upload + one batched read per flush) and its threaded device dispatch
+# must run under the mesh dispatch guard (JG002)
+
+SERVING = "scalerl_tpu/serving/fixture.py"
+
+GOOD_SERVING_FLUSH_LOOP = """
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.runtime.dispatch import get_metrics
+
+    def flush_loop(batcher, serve, params, key):
+        while True:
+            batch = batcher.next_batch()
+            if batch is None:
+                return
+            host = np.concatenate([r.payload["obs"] for r in batch])
+            dev = jax.device_put(host)        # ONE explicit batched upload
+            action, logits = serve(params, dev, key)
+            out = get_metrics((action, logits))  # ONE sanctioned batched read
+            for r in batch:                   # host-side demux only
+                r.reply(out)
+"""
+
+BAD_SERVING_PER_REQUEST_READ = """
+    import jax
+    import jax.numpy as jnp
+
+    def flush_loop(batcher, serve, params, key):
+        while True:
+            batch = batcher.next_batch()
+            if batch is None:
+                return
+            for r in batch:
+                logits = jnp.asarray(serve(params, r.obs, key))
+                # per-request host syncs: the transfer storm dynamic
+                # batching exists to prevent
+                r.reply(float(jnp.max(logits)), jax.device_get(logits))
+"""
+
+
+def test_jg001_serving_flush_loop_one_batched_transfer_is_clean():
+    """The server's sanctioned hot-loop shape — batch, ONE device_put, ONE
+    device_get, host demux — lints clean in the serving package."""
+    assert lint(GOOD_SERVING_FLUSH_LOOP, relpath=SERVING) == []
+
+
+def test_jg001_serving_per_request_transfers_flag():
+    """Serving is a HOT package: per-request float()/device_get inside the
+    flush loop is exactly the transfer storm dynamic batching exists to
+    prevent, and JG001 flags each site."""
+    findings = lint(BAD_SERVING_PER_REQUEST_READ, relpath=SERVING)
+    assert sorted(rules_of(findings)) == ["JG001", "JG001"]
+
+
+GOOD_SERVING_GUARDED_DISPATCH = """
+    import threading
+
+    class InferenceServer:
+        def __init__(self, agent, mesh, guard):
+            self._serve = __import__("jax").jit(lambda p, x: x)
+            self._dispatch_guard = guard  # the trainer's mesh lock factory
+            self.mesh = mesh
+
+        def _flush(self, params, dev, key):
+            with self._dispatch_guard():
+                return self._serve(params, dev)
+"""
+
+BAD_SERVING_UNGUARDED_DISPATCH = """
+    import threading
+    import jax
+
+    class InferenceServer:
+        def __init__(self, agent, mesh):
+            self._serve = jax.jit(lambda p, x: x)
+            self.mesh = mesh
+
+        def _flush(self, params, dev, key):
+            return self._serve(params, dev)  # races the learner's enqueues
+"""
+
+
+def test_jg002_serving_dispatch_under_guard_is_clean():
+    assert lint(GOOD_SERVING_GUARDED_DISPATCH, relpath=SERVING) == []
+
+
+def test_jg002_serving_unguarded_flush_dispatch_flags():
+    """The flush thread's jitted serve call in a threaded+meshed module
+    without the dispatch guard is the XLA enqueue-order deadlock class
+    (the apex mesh hang) on the serving plane — JG002 flags it."""
+    findings = lint(BAD_SERVING_UNGUARDED_DISPATCH, relpath=SERVING)
+    assert rules_of(findings) == ["JG002"]
+    assert "_dispatch_guard" in findings[0].hint

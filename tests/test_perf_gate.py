@@ -161,3 +161,31 @@ def test_sharded_bench_artifact_schema():
     assert re.fullmatch(r"dp=\d+(,mp=\d+)?", result["mesh"])
     assert result["params_total"] > result["params_per_chip"] > 0
     assert result["value"] > 0
+
+
+def test_serving_bench_artifact_schema():
+    """bench --mode serving artifacts carry the SLO fields the docs table
+    promises (p50/p95/p99, occupancy) and the like-for-like gate keys
+    (metric + mode) so serving history only gates serving runs."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [_sys.executable, str(REPO / "bench.py"), "--run", "--cpu",
+         "--bench-mode", "serving"],
+        env=env, capture_output=True, text=True, timeout=500, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [
+        l for l in out.stdout.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ][-1]
+    result = json.loads(line)
+    assert result["metric"] == "serving_requests_per_sec"
+    assert result["mode"] == "serving"
+    assert result["value"] > 0
+    assert result["lane_steps_per_sec"] >= result["value"]
+    assert result["p99_ms"] >= result["p95_ms"] >= result["p50_ms"] > 0
+    assert 0.0 < result["batch_occupancy"] <= 1.0
+    assert result["flushes"] > 0

@@ -1,0 +1,501 @@
+"""Centralized inference plane tests: batcher, server, client, trainer e2e.
+
+Covers the ISSUE 8 acceptance surface:
+- dynamic batching (flush on size OR deadline, bucketed static shapes,
+  FIFO whole-request batches);
+- bounded admission with explicit load shedding (``max_pending`` /
+  ``shed_total`` — the same vocabulary as QueueHub/RolloutQueue);
+- generation-tagged parameters: push -> monotonic bump; an in-flight
+  flush keeps the generation that actually served it; the staleness gauge
+  reports lag in learner steps;
+- the JG001 invariant at runtime: ONE explicit batched host->device upload
+  and ONE device->host read per flush, under the transfer guard once a
+  bucket is warm;
+- serving math parity with local acting, client reconnect/fallback, and
+  the serving-mode IMPALA trainer end to end.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalerl_tpu.agents.impala import ImpalaAgent
+from scalerl_tpu.config import ImpalaArguments
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.serving import (
+    DynamicBatcher,
+    InferenceServer,
+    RemotePolicyClient,
+    ServingConfig,
+    ServingRequest,
+    ServingUnavailable,
+    bucket_for,
+    default_buckets,
+    local_pair,
+)
+from scalerl_tpu.serving import server as serving_server
+
+
+def _args(**kw):
+    base = dict(
+        env_id="CartPole-v1",
+        rollout_length=8,
+        batch_size=4,
+        num_actors=2,
+        num_buffers=8,
+        use_lstm=False,
+        hidden_size=32,
+        logger_backend="none",
+    )
+    base.update(kw)
+    return ImpalaArguments(**base)
+
+
+def _agent(args=None, obs_dim=4, num_actions=2):
+    args = args or _args()
+    return ImpalaAgent(
+        args, obs_shape=(obs_dim,), num_actions=num_actions,
+        obs_dtype=jnp.float32,
+    )
+
+
+def _act_payload(lanes=2, obs_dim=4):
+    return {
+        "obs": np.random.default_rng(0).normal(size=(lanes, obs_dim)).astype(np.float32),
+        "last_action": np.zeros(lanes, np.int32),
+        "reward": np.zeros(lanes, np.float32),
+        "done": np.ones(lanes, bool),
+        "core": (),
+    }
+
+
+def _req(conn=None, req_id=1, lanes=2, obs_dim=4):
+    return ServingRequest(
+        conn=conn, req_id=req_id, lanes=lanes,
+        payload=_act_payload(lanes, obs_dim),
+    )
+
+
+# ---------------------------------------------------------------------------
+# batcher
+
+
+def test_default_buckets_ladder_and_bucket_for():
+    assert default_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert default_buckets(6) == (1, 2, 4, 6)
+    assert bucket_for(3, (1, 2, 4, 8)) == 4
+    assert bucket_for(8, (1, 2, 4, 8)) == 8
+    # oversize requests get a next-power-of-two bucket, never an error
+    assert bucket_for(20, (1, 2, 4, 8)) == 32
+
+
+def test_batcher_flushes_on_size_immediately():
+    b = DynamicBatcher(ServingConfig(max_batch=4, max_wait_s=60.0))
+    b.submit(_req(req_id=1, lanes=2))
+    b.submit(_req(req_id=2, lanes=2))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    # size trigger: no deadline wait even with a 60 s max_wait
+    assert time.monotonic() - t0 < 5.0
+    assert [r.req_id for r in batch] == [1, 2]
+
+
+def test_batcher_flushes_on_deadline_with_partial_batch():
+    b = DynamicBatcher(ServingConfig(max_batch=64, max_wait_s=0.05))
+    b.submit(_req(req_id=1, lanes=2))
+    batch = b.next_batch()
+    assert [r.req_id for r in batch] == [1]
+
+
+def test_batcher_never_splits_a_request():
+    b = DynamicBatcher(ServingConfig(max_batch=4, max_wait_s=0.01))
+    b.submit(_req(req_id=1, lanes=3))
+    b.submit(_req(req_id=2, lanes=3))
+    first = b.next_batch()
+    second = b.next_batch()
+    # 3 + 3 > max_batch=4: whole requests, one per flush, FIFO order
+    assert [r.req_id for r in first] == [1]
+    assert [r.req_id for r in second] == [2]
+
+
+def test_batcher_bounded_admission_sheds():
+    b = DynamicBatcher(ServingConfig(max_batch=64, max_wait_s=60.0, max_pending=2))
+    assert b.submit(_req(req_id=1))
+    assert b.submit(_req(req_id=2))
+    assert not b.submit(_req(req_id=3))  # shed, answered by the server
+    assert not b.submit(_req(req_id=4))
+    assert b.shed_total == 2
+    assert b.stats()["pending_requests"] == 2
+    b.close()
+    assert b.submit(_req(req_id=5)) is False  # closed -> always rejected
+
+
+# ---------------------------------------------------------------------------
+# bounded admission siblings (hub + rollout queue share the vocabulary)
+
+
+def test_queue_hub_sheds_stalest_at_max_pending():
+    import multiprocessing as mp
+
+    from scalerl_tpu.fleet.hub import QueueHub
+    from scalerl_tpu.fleet.transport import PipeConnection
+
+    hub = QueueHub(max_pending=2)
+    a, b = mp.Pipe(duplex=True)
+    hub.add_connection(PipeConnection(a))
+    sender = PipeConnection(b)
+    for i in range(5):
+        sender.send({"kind": "x", "i": i})
+    deadline = time.monotonic() + 10.0
+    while hub.shed_total < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert hub.shed_total == 3
+    # the two FRESHEST messages survived (stalest shed first)
+    got = [hub.recv(timeout=5.0)[1]["i"], hub.recv(timeout=5.0)[1]["i"]]
+    assert got == [3, 4]
+    hub.close()
+
+
+def test_rollout_queue_sheds_stalest_full_slot():
+    from scalerl_tpu.data.trajectory import TrajectorySpec
+    from scalerl_tpu.runtime.rollout_queue import RolloutQueue
+
+    spec = TrajectorySpec(
+        unroll_length=2, batch_size=1, obs_shape=(3,), num_actions=2,
+        obs_dtype=np.float32,
+    )
+    q = RolloutQueue(spec, num_slots=6, max_pending=2)
+    slots = [q.acquire(timeout=1.0) for _ in range(4)]
+    for i, s in enumerate(slots):
+        q.slots[s]["reward"][:] = float(i)
+        q.commit(s)
+    # commits 3 and 4 each shed the then-stalest full slot back to free
+    assert q.shed_total == 2
+    assert q.stats()["full"] == 2 and q.stats()["shed_total"] == 2
+    batch, idxs = q.get_batch(2)
+    # the freshest two rollouts survived
+    assert sorted(np.unique(batch["reward"]).tolist()) == [2.0, 3.0]
+    q.recycle(idxs)
+    q.close()
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+def test_server_act_roundtrip_and_generation_tag():
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start()
+    c_end, s_end = local_pair()
+    server.add_connection(s_end)
+    client = RemotePolicyClient(conn=c_end)
+    try:
+        core = client.initial_state(2)
+        assert core == ()
+        p = _act_payload()
+        action, logits, core = client.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], core
+        )
+        assert action.shape == (2,) and logits.shape == (2, 2)
+        assert client.generation == 0  # nothing pushed yet
+        gen = server.push_params(agent.get_weights())
+        assert gen == 1
+        client.act(p["obs"], p["last_action"], p["reward"], p["done"], core)
+        assert client.generation == 1
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_serving_logits_match_local_act():
+    """Parity proof independent of sampling: the served logits are the same
+    program the local facade runs (one model, one math), so a serving
+    trainer's behavior logits feed V-trace exactly like local acting."""
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start()
+    c_end, s_end = local_pair()
+    server.add_connection(s_end)
+    client = RemotePolicyClient(conn=c_end)
+    try:
+        p = _act_payload(lanes=3)
+        _, logits, _ = client.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        _, local_logits, _ = agent.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        np.testing.assert_allclose(
+            logits, np.asarray(local_logits), rtol=1e-5, atol=1e-5
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_in_flight_request_keeps_served_generation(monkeypatch):
+    """Param push -> generation bump DURING a flush: the reply is tagged
+    with the generation whose params actually served it, not the newest."""
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    c_end, s_end = local_pair()
+    server.hub.add_connection(s_end)
+    pushed = {"done": False}
+    orig_get = serving_server._device_get
+
+    def get_with_push_in_flight(x):
+        if not pushed["done"]:
+            pushed["done"] = True
+            server.push_params(agent.get_weights())  # lands mid-flush
+        return orig_get(x)
+
+    monkeypatch.setattr(serving_server, "_device_get", get_with_push_in_flight)
+    server._flush([_req(conn=s_end, req_id=7)])
+    reply = c_end.recv(timeout=10.0)
+    assert reply["kind"] == "act_result" and reply["req"] == 7
+    assert reply["gen"] == 0  # the generation that served it...
+    assert server.generation == 1  # ...not the one pushed mid-flight
+    server.hub.close()
+
+
+def test_staleness_gauge_reports_learner_step_lag():
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig())
+    server.push_params(agent.get_weights(), learner_step=10)  # gen 1
+    server.push_params(agent.get_weights(), learner_step=25)  # gen 2
+    server.push_params(agent.get_weights(), learner_step=40)  # gen 3
+    # a transition served at gen 1 is 40 - 10 = 30 learner steps stale
+    assert server.observe_staleness(1) == 30.0
+    assert telemetry.get_registry().gauge("serving.staleness").value == 30.0
+    assert server.observe_staleness(3) == 0.0
+    server.hub.close()
+
+
+def test_one_batched_transfer_each_way_per_flush(monkeypatch):
+    """The JG001 invariant, counted: per flush exactly ONE explicit
+    device_put (the stacked request batch) and ONE device_get (the output
+    triple) — and warm-bucket flushes run with the transfer guard armed."""
+    counts = {"put": 0, "get": 0}
+    orig_put, orig_get = serving_server._device_put, serving_server._device_get
+
+    def counting_put(x):
+        counts["put"] += 1
+        return orig_put(x)
+
+    def counting_get(x):
+        counts["get"] += 1
+        return orig_get(x)
+
+    monkeypatch.setattr(serving_server, "_device_put", counting_put)
+    monkeypatch.setattr(serving_server, "_device_get", counting_get)
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    c_end, s_end = local_pair()
+    server.hub.add_connection(s_end)
+    # same lane count every time -> one bucket; flush 1 compiles (cold),
+    # flushes 2..5 run inside steady_state_guard()
+    for i in range(5):
+        server._flush([_req(conn=s_end, req_id=i)])
+        assert c_end.recv(timeout=10.0)["req"] == i
+    assert server.flushes == 5
+    assert counts["put"] == 5 and counts["get"] == 5
+    assert server._warm_buckets == {2}
+    server.hub.close()
+
+
+def test_server_sheds_over_max_pending_and_replies_immediately():
+    agent = _agent()
+    # flush never fires on its own (huge batch + deadline), queue depth 1:
+    # the second act request must come back as an explicit shed
+    server = InferenceServer(
+        agent,
+        ServingConfig(max_batch=1024, max_wait_s=60.0, max_pending=1),
+    )
+    server.start()
+    c_end, s_end = local_pair()
+    server.add_connection(s_end)
+    client = RemotePolicyClient(conn=c_end)
+    try:
+        p = _act_payload()
+        first = client.act_async(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        deadline = time.monotonic() + 10.0
+        while (
+            server.batcher.stats()["pending_requests"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        second = client.act_async(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        reply = second.result(timeout=10.0)
+        assert reply.get("shed") is True
+        assert server.batcher.shed_total == 1
+        assert not first._event.is_set()  # still queued, not lost
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# client robustness
+
+
+class _StubFallback:
+    """Local policy stub with a recognizable output."""
+
+    def initial_state(self, batch_size):
+        return ()
+
+    def act(self, obs, last_action, reward, done, core_state):
+        B = np.asarray(obs).shape[0]
+        return np.full(B, 9, np.int32), np.zeros((B, 2), np.float32), ()
+
+
+def test_client_falls_back_to_local_on_server_loss():
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start()
+    c_end, s_end = local_pair()
+    server.add_connection(s_end)
+    client = RemotePolicyClient(
+        conn=c_end, fallback=_StubFallback(), request_timeout_s=2.0,
+        max_attempts=3,
+    )
+    p = _act_payload()
+    client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+    server.stop()  # the server goes away; no reconnect factory exists
+    action, logits, core = client.act(
+        p["obs"], p["last_action"], p["reward"], p["done"], ()
+    )
+    assert client.fallen_back
+    np.testing.assert_array_equal(action, np.full(2, 9, np.int32))
+    client.close()
+
+
+def test_client_without_fallback_raises_on_server_loss():
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start()
+    c_end, s_end = local_pair()
+    server.add_connection(s_end)
+    client = RemotePolicyClient(conn=c_end, request_timeout_s=2.0, max_attempts=2)
+    p = _act_payload()
+    client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+    server.stop()
+    with pytest.raises(ServingUnavailable):
+        client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+    client.close()
+
+
+def test_client_reconnects_over_sockets():
+    """Cut the established serving link server-side: the client redials
+    through the accept loop (capped backoff) and the next act succeeds —
+    PR 2's reconnect path on the inference plane."""
+    import socket as socket_mod
+
+    from scalerl_tpu.fleet.transport import connect_socket
+
+    def _free_port():
+        s = socket_mod.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    port = _free_port()
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start(listen_port=port)
+    client = RemotePolicyClient(
+        connect=lambda: connect_socket("127.0.0.1", port, retries=5),
+        request_timeout_s=5.0,
+        reconnect_backoff_s=0.05,
+        reconnect_backoff_cap_s=0.2,
+        max_reconnects=10,
+    )
+    try:
+        p = _act_payload()
+        client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+        # sever every established link at the server; accept loop stays up
+        with server.hub._lock:
+            conns = list(server.hub._conns)
+        assert conns
+        for c in conns:
+            server.hub.disconnect(c)
+        action, logits, _ = client.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        assert action.shape == (2,)
+        assert client.reconnects_used >= 1
+        assert not client.fallen_back
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving-mode IMPALA trainer (the acceptance e2e)
+
+
+def test_serving_impala_trainer_end_to_end(tmp_path):
+    """A serving-mode IMPALA run — workers on RemotePolicyClient, ONE hot
+    policy in the InferenceServer — completes with learning metrics of the
+    same shape and finiteness as the local-policy baseline, every act
+    served remotely (no fallback), and generation-tagged params flowing."""
+    from scalerl_tpu.envs import make_vect_envs
+    from scalerl_tpu.trainer.actor_learner import HostActorLearnerTrainer
+
+    def run(mode, subdir):
+        args = _args(
+            actor_mode=mode,
+            serve_max_batch=8,
+            serve_max_wait_ms=2.0,
+            logger_frequency=128,
+            work_dir=str(tmp_path / subdir),
+        )
+        agent = _agent(args)
+        env_fns = [
+            (lambda i=i: make_vect_envs(
+                "CartPole-v1", num_envs=2, seed=i, async_envs=False))
+            for i in range(2)
+        ]
+        trainer = HostActorLearnerTrainer(args, agent, env_fns)
+        result = trainer.train(total_frames=512)
+        return trainer, result
+
+    base_tr, base = run("threads", "base")
+    serv_tr, serv = run("serving", "serv")
+
+    # parity-level: same metric surface, finite, full frame budget
+    assert set(base).issubset(set(serv)) or set(serv).issubset(set(base))
+    assert serv["env_frames"] >= 512
+    assert np.isfinite(serv["total_loss"])
+    server = serv_tr.inference_server
+    assert server is not None and server.flushes > 0
+    # the learner pushed a generation per learn step and clients saw them
+    assert server.generation > 0
+    assert all(not c.fallen_back for c in serv_tr._serving_clients)
+    assert max(c.generation for c in serv_tr._serving_clients) > 0
+    # SLO instruments measured real traffic
+    slo = server.slo()
+    assert slo["requests"] > 0 and slo["p95_ms"] >= slo["p50_ms"] >= 0.0
+    # staleness gauge was maintained (lag in learner steps, bounded small
+    # for an in-process run)
+    assert telemetry.get_registry().gauge("serving.staleness").value >= 0.0
+
+
+def test_serving_config_validation():
+    with pytest.raises(ValueError, match="actor_mode"):
+        _args(actor_mode="nonsense").validate()
+    with pytest.raises(ValueError, match="serve_max_batch"):
+        _args(serve_max_batch=0).validate()
+    cfg = ServingConfig.from_args(_args(serve_max_batch=16, serve_max_wait_ms=3.0))
+    assert cfg.max_batch == 16
+    assert cfg.max_wait_s == pytest.approx(0.003)

@@ -13,6 +13,8 @@ On contact (re-armed up to 3 times, 30 min apart) it runs, in order:
   2b. ``bench.py --mesh dp=N`` when the tunnel exposes >1 chip,
   2c. ``bench.py --mode sharded`` (dp×mp pjit transformer train step:
       MFU + params-per-chip, perf-gated like-for-like per mesh shape),
+  2d. ``bench.py --mode serving`` (centralized inference plane: act
+      requests/sec + latency SLO quantiles + batch occupancy),
   3. ``bench.py --learn`` (train-step-only MFU at the north-star shape),
   4. ``pytest tests_tpu`` (compiled Pallas kernels + shard_map legality),
   5. ``examples/profile_fused_loop.py`` (idle fraction),
@@ -198,6 +200,7 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
         gated_metrics = {
             "impala_atari_env_frames_per_sec_per_chip",
             "sharded_train_step_frames_per_sec",
+            "serving_requests_per_sec",
         }
         result = None
         for line in segment.splitlines():
@@ -305,6 +308,12 @@ def run_payload(n_devices: int = 1) -> None:
         # heads/mlp/vocab over mp — reports MFU + params-per-chip and is
         # perf-gated like-for-like against history at the same mesh shape
         ("bench-sharded", [sys.executable, "bench.py", "--mode", "sharded"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # centralized inference plane: act requests/sec through the
+        # InferenceServer's dynamic batcher + the latency SLO quantiles
+        # (p50/p95/p99) and batch occupancy; perf-gated like-for-like
+        # against serving-mode history exactly like the other bench steps
+        ("bench-serving", [sys.executable, "bench.py", "--mode", "serving"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
