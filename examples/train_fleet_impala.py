@@ -130,6 +130,16 @@ def main() -> None:
     parser.add_argument("--publish-every", type=int, default=1)
     parser.add_argument("--learning-rate", type=float, default=2e-3)
     parser.add_argument("--platform", default="cpu")
+    parser.add_argument(
+        "--autoscale", action="store_true",
+        help="run the telemetry-driven autoscaler over the fleet "
+             "(runtime/autoscaler.py): backfills preempted gathers to "
+             "--num-workers and scales on the fps/queue/shed signals",
+    )
+    parser.add_argument(
+        "--autoscale-max-workers", type=int, default=0,
+        help="scale-up ceiling (0 = 2x --num-workers)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -220,6 +230,25 @@ def main() -> None:
     # spawn, not fork: this process holds a JAX runtime
     cluster = LocalCluster(server, config, runner, mp_context="spawn")
     cluster.start()
+    autoscaler = None
+    if args.autoscale:
+        from scalerl_tpu.fleet import ClusterExecutor
+        from scalerl_tpu.runtime.autoscaler import (
+            Autoscaler,
+            AutoscalerConfig,
+            fleet_signal_source,
+        )
+
+        autoscaler = Autoscaler(
+            AutoscalerConfig(
+                min_workers=args.num_workers,
+                max_workers=args.autoscale_max_workers or 2 * args.num_workers,
+                interval_s=1.0,
+                cooldown_s=10.0,
+            ),
+            executor=ClusterExecutor(server, cluster),
+            signal_source=fleet_signal_source(server),
+        ).start()
     chunks = []
     returns: list = []
     learn_steps = 0
@@ -263,6 +292,12 @@ def main() -> None:
             chunks.clear()
             metrics = agent.learn(batch_to_trajectory(batch))
             learn_steps += 1
+            if autoscaler is not None:
+                # the learner-consumption half of the autoscaler's signal
+                # triad (actor fps rides server.results_per_s already)
+                from scalerl_tpu.runtime import telemetry
+
+                telemetry.get_registry().meter("rates.learn_steps_per_s").mark()
             if learn_steps % args.publish_every == 0:
                 server.publish(jax.tree_util.tree_map(np.asarray, agent.get_weights()))
             if learn_steps % 50 == 0:
@@ -275,6 +310,8 @@ def main() -> None:
                     flush=True,
                 )
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         cluster.join()
         server.stop()
     dt = time.time() - t0

@@ -159,6 +159,28 @@ class RLArguments:
     # guard still skips individual bad steps.
     divergence_rollback_steps: int = 0
 
+    # Elastic fleet (runtime/autoscaler.py + fleet dynamic admission/drain)
+    # Autoscaler control loop over the DCN actor fleet: reads the telemetry
+    # plane's tuning triad (actor fps vs learner steps/s vs queue occupancy)
+    # plus the bounded-admission shed counters, and issues scale-up /
+    # drain decisions through the cluster executor — with hysteresis and a
+    # cooldown so heartbeat jitter never flaps the fleet.  Off by default;
+    # the fleet entry scripts wire it when enabled.
+    autoscale: bool = False
+    # Hard floor: a preemption wave dropping the fleet below this is
+    # backfilled immediately (no hysteresis, no cooldown).
+    autoscale_min_workers: int = 1
+    # Hard ceiling for scale-up decisions.
+    autoscale_max_workers: int = 32
+    # Evaluation cadence of the control loop, seconds.
+    autoscale_interval_s: float = 5.0
+    # Hold window after any scale action (spawn/drain take seconds to bite;
+    # re-acting on pre-action signals is how fleets flap).
+    autoscale_cooldown_s: float = 30.0
+    # Consecutive same-direction pressure verdicts required before acting
+    # (scale-down requires one more than scale-up).
+    autoscale_hysteresis: int = 2
+
     # Pallas kernels (ops/pallas_vtrace.py, ops/pallas_per.py): route the
     # V-trace target computation and the PER priority/sum-tree update
     # through the fused TPU kernels (interpret-mode on CPU for parity
@@ -189,6 +211,26 @@ class RLArguments:
             raise ValueError(
                 "policy_arch must be auto | transformer | moe, got "
                 f"{self.policy_arch!r}"
+            )
+        if self.autoscale_min_workers < 0:
+            raise ValueError(
+                "autoscale_min_workers must be >= 0, got "
+                f"{self.autoscale_min_workers}"
+            )
+        if self.autoscale_max_workers < self.autoscale_min_workers:
+            raise ValueError(
+                f"autoscale_max_workers ({self.autoscale_max_workers}) must "
+                f"be >= autoscale_min_workers ({self.autoscale_min_workers})"
+            )
+        if self.autoscale and self.autoscale_interval_s <= 0:
+            raise ValueError(
+                "autoscale_interval_s must be positive with autoscale on, "
+                f"got {self.autoscale_interval_s}"
+            )
+        if self.autoscale_hysteresis < 1:
+            raise ValueError(
+                "autoscale_hysteresis must be >= 1, got "
+                f"{self.autoscale_hysteresis}"
             )
 
 

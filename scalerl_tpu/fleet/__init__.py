@@ -7,11 +7,13 @@ generation — rebuilt on a flat binary codec instead of pickle.
 """
 
 from scalerl_tpu.fleet.cluster import (
+    ClusterExecutor,
     FleetConfig,
     Gather,
     LocalCluster,
     RemoteCluster,
     WorkerServer,
+    apply_mass_kill,
     worker_loop,
 )
 from scalerl_tpu.fleet.framing import (
@@ -38,7 +40,9 @@ from scalerl_tpu.fleet.transport import (
 )
 
 __all__ = [
+    "ClusterExecutor",
     "FleetConfig",
+    "apply_mass_kill",
     "Gather",
     "LocalCluster",
     "RemoteCluster",

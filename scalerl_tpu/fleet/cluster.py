@@ -33,6 +33,34 @@ Wire protocol (dicts over ``fleet.transport.Connection``):
     never a lost or double-counted episode.
     entry handshake {"kind": "entry", "num_workers": n, "host": h}
                     → {"kind": "entry_ack", "base_worker_id": b, "config": {...}}
+
+Elasticity plane (dynamic admission / draining — the scale-events layer the
+autoscaler in ``runtime/autoscaler.py`` drives):
+
+    gather→server   {"kind": "gather_hello", "base_worker_id": b,
+                     "num_workers": n, "gather_epoch": e}
+                                          membership announce, sent on connect
+                                          AND after every reconnect — the
+                                          server's live roster for scale
+                                          decisions and targeted drains
+                    {"kind": "task_return", "v": [t...]}
+                                          unstarted prefetched tasks handed
+                                          back on drain (the server reissues
+                                          them; no episode is lost to a drain)
+                    {"kind": "drain_done", "base_worker_id": b}
+                                          drain complete: results flushed,
+                                          every retained upload acked
+    server→gather   {"kind": "drain"}     stop starting episodes, return
+                                          unstarted tasks, flush + await acks,
+                                          close cleanly (exit 0 — distinct
+                                          from the kill-and-respawn path)
+
+    Tasks the server hands out are stamped with a monotonic ``_task_id`` and
+    tracked per gather link: a link that dies (EOF, protocol error, liveness
+    verdict, SIGTERMed spot node) has its outstanding tasks requeued for the
+    next gather, and results are deduplicated at TASK level too (a task that
+    raced its requeue and completed twice counts once) — at-least-once
+    execution, exactly-once episode accounting, across preemption waves.
 """
 
 from __future__ import annotations
@@ -41,8 +69,9 @@ import multiprocessing as mp
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
 
 from scalerl_tpu.fleet.hub import QueueHub
 from scalerl_tpu.fleet.transport import (
@@ -55,9 +84,15 @@ from scalerl_tpu.fleet.transport import (
     send_recv,
     wait_readable,
 )
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import chaos, telemetry
 from scalerl_tpu.runtime.param_server import ParameterServer
-from scalerl_tpu.runtime.supervisor import is_heartbeat, make_pong
+from scalerl_tpu.runtime.supervisor import (
+    DRAIN,
+    DRAIN_DONE,
+    is_heartbeat,
+    make_drain,
+    make_pong,
+)
 from scalerl_tpu.runtime.telemetry import TelemetryAggregator
 from scalerl_tpu.utils.logging import get_logger
 
@@ -126,7 +161,12 @@ class FleetConfig:
 # worker
 
 
-def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None:
+def worker_loop(
+    conn: Connection,
+    worker_id: int,
+    runner: EpisodeRunner,
+    epoch_salt: int = 0,
+) -> None:
     """Task loop: parity with ``Worker.run`` (``hpc/worker.py:96-120``).
 
     Runner exceptions are *reported upstream* before the worker exits —
@@ -140,14 +180,19 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
     the per-worker monotonic ``episode_seq`` lets it drop the duplicate
     instead of double-counting the episode into replay.  ``upload_epoch``
     is a random per-worker-process nonce so an elastically *respawned*
-    worker (same id, fresh seq counter) is not mistaken for a replay.
+    worker (same id, fresh seq counter) is not mistaken for a replay —
+    and ``epoch_salt`` (the owning gather's ``gather_epoch`` nonce) rides
+    its high bits, so every worker of a respawned gather is provably in a
+    fresh epoch even against a per-worker randomness collision: a slow
+    duplicate from the corpse gather can never collide with the
+    replacement's live sequence.
     """
     import os as _os
     import traceback
 
     weights: Any = None
     version = -1
-    upload_epoch = int.from_bytes(_os.urandom(4), "big")
+    upload_epoch = (int(epoch_salt) << 32) | int.from_bytes(_os.urandom(4), "big")
     episode_seq = 0
     reg = telemetry.get_registry()
     ep_meter = reg.meter("worker.episodes_per_s")
@@ -186,6 +231,11 @@ def worker_loop(conn: Connection, worker_id: int, runner: EpisodeRunner) -> None
             result["upload_epoch"] = upload_epoch
             result["episode_seq"] = episode_seq
             episode_seq += 1
+            # echo the server's task id so it can close the outstanding-task
+            # entry (and requeue-survivors dedup at task level)
+            tid = task.get("_task_id") if isinstance(task, dict) else None
+            if tid is not None:
+                result["_task_id"] = tid
             reg.counter("worker.episodes").inc()
             ep_meter.mark()
             # compact telemetry piggyback: rides the existing result frame
@@ -225,6 +275,8 @@ class Gather:
         num_workers: int,
         reconnect: Optional[Callable[[], Connection]] = None,
     ) -> None:
+        import os as _os
+
         self.server = server_conn
         self.config = config
         self.reconnect = reconnect
@@ -232,6 +284,17 @@ class Gather:
         self._server_seen = time.monotonic()
         self.tasks: "queue.Queue[Any]" = queue.Queue()
         self.results: List[Dict[str, Any]] = []
+        self.num_workers = num_workers
+        # gather-level incarnation nonce: salts every child worker's
+        # upload_epoch (high bits), so a respawned gather's whole worker
+        # range is provably a fresh epoch — a slow duplicate from the dead
+        # predecessor can never collide with this incarnation's sequences
+        self.gather_epoch = int.from_bytes(_os.urandom(4), "big")
+        # drain protocol (scale-down / spot SIGTERM): a server "drain" frame
+        # stops new episodes, returns unstarted tasks, flushes + awaits acks,
+        # then exits cleanly with a "drain_done"
+        self.draining = False
+        self._drain_requested = False
         # at-least-once uploads, completed: every result batch is RETAINED
         # under a gather-local upload seq until the server acks it
         # ("result_ack").  A batch the server never processed — the link
@@ -261,11 +324,24 @@ class Gather:
         self.worker_conns, self.worker_procs = open_worker_pipes(
             num_workers,
             worker_loop,
-            lambda i: (base_worker_id + i, runner),
+            lambda i: (base_worker_id + i, runner, self.gather_epoch),
         )
         # task source exhausted: serve None to further requests, but keep
         # running until every worker has drained its final result and closed
         self._exhausted = False
+        # membership announce: the server's roster (scale decisions, targeted
+        # drains) learns about this gather before any task traffic flows
+        self._send_hello()
+
+    def _send_hello(self) -> None:
+        self.server.send(
+            {
+                "kind": "gather_hello",
+                "base_worker_id": self.base_worker_id,
+                "num_workers": self.num_workers,
+                "gather_epoch": self.gather_epoch,
+            }
+        )
 
     # -- server link ---------------------------------------------------
     def _replace_server_conn(self, why: Exception) -> None:
@@ -298,6 +374,10 @@ class Gather:
             try:
                 self.server = self.reconnect()
                 self._server_seen = time.monotonic()
+                # re-announce membership FIRST: the server requeued this
+                # gather's outstanding tasks when the old link dropped, and
+                # the fresh roster entry is what targeted drains address
+                self._send_hello()
                 # the cut may have eaten in-flight uploads (or the server
                 # rejected a corrupt frame and dropped the link): resend
                 # everything unacked on the fresh link; a failure here is
@@ -338,6 +418,12 @@ class Gather:
                 # reply — filter them like heartbeats
                 self._unacked.pop(int(msg.get("seq", -1)), None)
                 continue
+            if isinstance(msg, dict) and msg.get("kind") == DRAIN:
+                # drain is unsolicited too; flag it and let the main loop
+                # run the protocol outside any in-flight RPC (sending the
+                # task_return from here would re-enter the reconnect path)
+                self._drain_requested = True
+                continue
             return msg
 
     def _server_rpc(self, msg: Dict[str, Any], compress: bool = False) -> Any:
@@ -368,6 +454,8 @@ class Gather:
                         self.server.send(self._make_pong(msg))
                 elif isinstance(msg, dict) and msg.get("kind") == "result_ack":
                     self._unacked.pop(int(msg.get("seq", -1)), None)
+                elif isinstance(msg, dict) and msg.get("kind") == DRAIN:
+                    self._drain_requested = True
                 else:
                     logger.warning(
                         "gather: unsolicited server message %r",
@@ -407,6 +495,51 @@ class Gather:
                 )
             )
 
+    # -- drain protocol -------------------------------------------------
+    def _begin_drain(self) -> None:
+        """Stop starting episodes: serve None to further task requests and
+        hand every unstarted prefetched task back to the server for
+        reissue.  Workers finish the episode they hold (its result flushes
+        normally), then exit on the None task; the run loop completes the
+        protocol once the last worker is gone."""
+        if self.draining:
+            return
+        self.draining = True
+        self._exhausted = True
+        self._reg.counter("gather.drains").inc()
+        telemetry.record_event("drain_begin", base=self.base_worker_id)
+        returned: List[Any] = []
+        while True:
+            try:
+                t = self.tasks.get_nowait()
+            except queue.Empty:
+                break
+            if t is not None:
+                returned.append(t)
+        if returned:
+            self._server_send({"kind": "task_return", "v": returned})
+        logger.info(
+            "gather %d: draining (%d unstarted tasks returned, %d workers "
+            "finishing)",
+            self.base_worker_id, len(returned), len(self.worker_conns),
+        )
+
+    def _await_acks(self, timeout: float = 30.0) -> bool:
+        """Pump the server link until every retained upload is acked (or the
+        deadline passes) — the zero-lost-uploads half of a clean close."""
+        deadline = time.monotonic() + timeout
+        while self._unacked and time.monotonic() < deadline:
+            try:
+                if self.server.poll(0.1):
+                    self._pump_server()
+                self._check_server_liveness()
+            except (ConnectionError, EOFError, OSError, TimeoutError) as e:
+                try:
+                    self._replace_server_conn(e)
+                except (ConnectionError, EOFError, OSError):
+                    return False  # reconnect budget spent: uploads stay retained
+        return not self._unacked
+
     # -- main loop -----------------------------------------------------
     def run(self) -> None:
         try:
@@ -440,6 +573,21 @@ class Gather:
                         continue
                     self._handle(conn, msg)
                 self._check_server_liveness()
+                if self._drain_requested and not self.draining:
+                    self._begin_drain()
+            # every worker exited cleanly: final flush, then hold for the
+            # server's acks so a drain/scale-down loses zero retained
+            # uploads (the at-least-once retention is pointless if the
+            # process exits before redelivery could happen)
+            self._flush_results()
+            acked = self._await_acks()
+            if self.draining:
+                telemetry.record_event(
+                    "drain_done", base=self.base_worker_id, acked=acked
+                )
+                self._server_send(
+                    {"kind": DRAIN_DONE, "base_worker_id": self.base_worker_id}
+                )
         finally:
             self._flush_results()
             for c in self.worker_conns:
@@ -554,6 +702,7 @@ class WorkerServer:
         config: FleetConfig,
         task_source: Callable[[], Optional[Dict[str, Any]]],
         result_maxsize: int = 4096,
+        worker_error_maxsize: int = 256,
     ) -> None:
         self.config = config
         self.task_source = task_source
@@ -575,11 +724,43 @@ class WorkerServer:
             on_dead=self._on_dead_connection,
             on_telemetry=lambda _conn, payload: self.telemetry.absorb_payload(payload),
             max_pending=config.max_pending,
+            on_disconnect=self._on_disconnect,
         )
         self.results: "queue.Queue[Dict[str, Any]]" = queue.Queue(result_maxsize)
-        self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue()
+        # bounded error funnel: nobody is REQUIRED to poll this on a long
+        # elastic run (gathers churn constantly on preemptible capacity), so
+        # it must never grow without bound — the stalest entry is evicted on
+        # overflow while the full history survives as the
+        # server.worker_errors_total counter + per-error FlightRecorder
+        # events (report_worker_error)
+        self.worker_errors: "queue.Queue[Dict[str, Any]]" = queue.Queue(
+            worker_error_maxsize
+        )
+        self.worker_errors_total = 0
+        self.worker_errors_dropped = 0
         self.total_results = 0
         self.dropped_results = 0
+        # elastic membership roster: conn -> {base_worker_id, num_workers,
+        # gather_epoch, draining, joined_t}, fed by gather_hello frames and
+        # pruned on disconnect/drain_done — what scale decisions and
+        # targeted drains address
+        self.gather_links: Dict[Connection, Dict[str, Any]] = {}
+        self._roster_lock = threading.Lock()
+        self.gathers_joined = 0
+        self.gathers_drained = 0
+        # exactly-once task accounting across elastic churn: every task
+        # handed out carries a monotonic _task_id tracked per link; a dead
+        # link's outstanding tasks requeue, and completions dedup at task
+        # level so a requeue that raced its original execution counts once
+        self._task_lock = threading.Lock()
+        self._next_task_id = 0
+        self._outstanding: Dict[int, Tuple[Connection, Any]] = {}
+        self._conn_tasks: Dict[Connection, Set[int]] = {}
+        self._completed_tasks: "OrderedDict[int, None]" = OrderedDict()
+        self._completed_cap = 65536
+        self._returned_tasks: Deque[Any] = deque()
+        self.requeued_tasks = 0
+        self.duplicate_tasks = 0
         reg = telemetry.get_registry()
         reg.bind("fleet", self.telemetry.tree)
         reg.bind(
@@ -590,19 +771,56 @@ class WorkerServer:
                 "dropped_results": self.dropped_results,
                 "results_queued": self.results.qsize(),
                 "worker_errors": self.worker_errors.qsize(),
+                "worker_errors_total": self.worker_errors_total,
+                "worker_errors_dropped": self.worker_errors_dropped,
                 "param_version": self.params.version,
+                "live_gathers": self.live_gather_count(),
+                "live_workers": self.live_worker_count(),
+                "gathers_joined": self.gathers_joined,
+                "gathers_drained": self.gathers_drained,
+                "outstanding_tasks": len(self._outstanding),
+                "requeued_tasks": self.requeued_tasks,
+                "duplicate_tasks": self.duplicate_tasks,
             },
         )
-        # at-least-once dedup: per worker, the (upload_epoch, newest
-        # episode_seq) accepted; a reconnect-resent duplicate has the same
-        # epoch and a seq we already consumed
-        self._dedup_seen: Dict[int, Tuple[int, int]] = {}
+        # at-least-once dedup: per worker, per upload_epoch, the newest
+        # episode_seq accepted (a bounded few epochs retained per worker) —
+        # a reconnect-resent duplicate has the same epoch and a seq we
+        # already consumed, and a SLOW duplicate from a dead gather's old
+        # epoch stays recognizable even after its respawn registered a
+        # fresh epoch (the single-(epoch, seq) table this replaces would
+        # have been reset by the late frame and double-counted it)
+        self._dedup_seen: Dict[int, "OrderedDict[int, int]"] = {}
+        self._dedup_epochs_per_worker = 4
         self.duplicate_results = 0
         self._next_worker_id = 0
         self._id_lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._server_socks: List[Any] = []
+
+    def report_worker_error(self, err: Dict[str, Any]) -> None:
+        """One funnel for every fleet failure report: bounded queue for
+        pollers, monotonic counter + FlightRecorder event for everyone else
+        (the queue may overflow on a long elastic run; the telemetry plane
+        never loses the count)."""
+        self.worker_errors_total += 1
+        telemetry.get_registry().counter("server.worker_errors_total").inc()
+        telemetry.record_event(
+            "worker_error",
+            worker_id=err.get("worker_id"),
+            error=str(err.get("error"))[:200],
+        )
+        while True:
+            try:
+                self.worker_errors.put_nowait(err)
+                return
+            except queue.Full:
+                try:
+                    self.worker_errors.get_nowait()
+                    self.worker_errors_dropped += 1
+                except queue.Empty:
+                    pass
 
     def _on_dead_connection(self, conn: Connection, reason: str) -> None:
         """Hub liveness verdict: mark the gather's workers dead so the
@@ -611,30 +829,62 @@ class WorkerServer:
         healed) reconnects on its own and re-registers via the accept
         loop."""
         logger.error("fleet: gather connection declared dead (%s)", reason)
-        self.worker_errors.put(
+        self.report_worker_error(
             {"worker_id": None, "task": None, "error": f"gather link dead: {reason}"}
         )
+
+    def _on_disconnect(self, conn: Connection) -> None:
+        """ANY removal of a gather link (EOF, corrupt frame, liveness
+        verdict, preempted node): drop its roster entry and requeue its
+        outstanding tasks so the remaining/backfilled fleet picks them up.
+        A reconnecting gather still runs those tasks — the task-level
+        completion dedup makes the double execution count once."""
+        with self._roster_lock:
+            self.gather_links.pop(conn, None)
+        requeued = []
+        with self._task_lock:
+            for tid in self._conn_tasks.pop(conn, set()):
+                entry = self._outstanding.pop(tid, None)
+                if entry is not None and tid not in self._completed_tasks:
+                    requeued.append(entry[1])
+            self._returned_tasks.extend(requeued)
+            self.requeued_tasks += len(requeued)
+        if requeued:
+            telemetry.get_registry().counter("server.requeued_tasks").inc(
+                len(requeued)
+            )
+            telemetry.record_event(
+                "tasks_requeued", count=len(requeued), why="disconnect"
+            )
+            logger.warning(
+                "fleet: requeued %d outstanding tasks from a dropped gather "
+                "link", len(requeued),
+            )
 
     def _is_duplicate(self, result: Dict[str, Any]) -> bool:
         """At-least-once dedup on the (worker_id, upload_epoch, episode_seq)
         key stamped by ``worker_loop``.  Per-worker results flow through one
         gather in order (reconnect resends preserve order), so "seq <= newest
-        accepted within the same epoch" identifies a resend exactly.  Results
-        without the key (foreign runners) are always accepted."""
+        accepted within the same epoch" identifies a resend exactly.  A
+        bounded history of recent epochs is kept PER WORKER so a slow
+        duplicate from a dead gather (old epoch) arriving after its
+        respawn's fresh epoch is still recognized instead of resetting the
+        table.  Results without the key (foreign runners) are always
+        accepted."""
         wid = result.get("worker_id")
         seq = result.get("episode_seq")
         if wid is None or seq is None:
             return False
         epoch = int(result.get("upload_epoch", 0))
         seq = int(seq)
-        last = self._dedup_seen.get(wid)
-        if last is not None and last[0] == epoch and seq <= last[1]:
+        epochs = self._dedup_seen.setdefault(wid, OrderedDict())
+        last = epochs.get(epoch)
+        if last is not None and seq <= last:
             return True
-        self._dedup_seen[wid] = (
-            (epoch, seq)
-            if last is None or last[0] != epoch
-            else (epoch, max(last[1], seq))
-        )
+        epochs[epoch] = seq if last is None else max(last, seq)
+        epochs.move_to_end(epoch)
+        while len(epochs) > self._dedup_epochs_per_worker:
+            epochs.popitem(last=False)
         return False
 
     # -- trainer API ---------------------------------------------------
@@ -658,6 +908,57 @@ class WorkerServer:
             base = self._next_worker_id
             self._next_worker_id += n
             return base
+
+    # -- elastic membership --------------------------------------------
+    def live_gather_count(self) -> int:
+        with self._roster_lock:
+            return len(self.gather_links)
+
+    def live_worker_count(self) -> int:
+        """Workers behind currently-registered, non-draining gather links —
+        the roster view of fleet capacity (spawned-but-booting gathers are
+        invisible here until their hello lands; executors that spawn
+        processes should count those themselves)."""
+        with self._roster_lock:
+            return sum(
+                info["num_workers"]
+                for info in self.gather_links.values()
+                if not info.get("draining")
+            )
+
+    def drain_workers(self, n_workers: int) -> int:
+        """Scale-down: ask the newest-joined gathers covering ``n_workers``
+        to drain — stop starting episodes, return unstarted tasks, flush and
+        await acks, then exit cleanly (``drain_done``).  Returns the worker
+        count actually asked to drain.  Zero episodes are lost: in-flight
+        episodes complete and upload, unstarted tasks reissue elsewhere."""
+        with self._roster_lock:
+            candidates = sorted(
+                (
+                    (conn, info)
+                    for conn, info in self.gather_links.items()
+                    if not info.get("draining")
+                ),
+                key=lambda item: item[1].get("joined_t", 0.0),
+                reverse=True,  # LIFO: drain the newest capacity first
+            )
+            picked = []
+            covered = 0
+            for conn, info in candidates:
+                if covered >= n_workers:
+                    break
+                info["draining"] = True
+                picked.append((conn, info))
+                covered += info["num_workers"]
+        for conn, info in picked:
+            telemetry.record_event(
+                "drain_request",
+                base=info["base_worker_id"],
+                workers=info["num_workers"],
+            )
+            telemetry.get_registry().counter("server.drain_requests").inc()
+            self.hub.send(conn, make_drain())
+        return covered
 
     # -- bring-up ------------------------------------------------------
     def start(self, listen: bool = False) -> None:
@@ -734,13 +1035,38 @@ class WorkerServer:
             except Exception:
                 logger.exception("server: failed handling %r", msg.get("kind"))
 
+    def _next_task(self) -> Optional[Any]:
+        """Requeued tasks (returned on drain, or orphaned by a dead gather)
+        take priority over the source — they were already accounted as
+        handed out, and reissue is how a scale event loses zero episodes."""
+        with self._task_lock:
+            if self._returned_tasks:
+                return self._returned_tasks.popleft()
+        return None if self._stop.is_set() else self.task_source()
+
+    def _record_outstanding(self, conn: Connection, task: Any) -> Any:
+        """Stamp (once) and track the task under the issuing link."""
+        if not isinstance(task, dict):
+            return task
+        task = dict(task)
+        with self._task_lock:
+            if "_task_id" not in task:
+                task["_task_id"] = self._next_task_id
+                self._next_task_id += 1
+            tid = task["_task_id"]
+            self._outstanding[tid] = (conn, task)
+            self._conn_tasks.setdefault(conn, set()).add(tid)
+        return task
+
     def _handle(self, conn: Connection, msg: Dict[str, Any]) -> None:
         kind = msg["kind"]
         if kind == "task_batch":
             n = int(msg["n"])
             tasks = []
             for _ in range(n):
-                t = None if self._stop.is_set() else self.task_source()
+                t = self._next_task()
+                if t is not None:
+                    t = self._record_outstanding(conn, t)
                 tasks.append(t)
                 if t is None:
                     break
@@ -764,6 +1090,28 @@ class WorkerServer:
                     self.duplicate_results += 1
                     reg.counter("server.duplicate_results").inc()
                     continue
+                # task-level exactly-once: a task orphaned by a dead/drained
+                # gather was requeued and may complete TWICE (the corpse's
+                # workers finished it, and so did the reissue) — the second
+                # completion is dropped here, keeping the episode count
+                # exact across preemption waves
+                tid = r.pop("_task_id", None) if isinstance(r, dict) else None
+                if tid is not None:
+                    with self._task_lock:
+                        if tid in self._completed_tasks:
+                            self.duplicate_tasks += 1
+                            dup_task = True
+                        else:
+                            self._completed_tasks[tid] = None
+                            while len(self._completed_tasks) > self._completed_cap:
+                                self._completed_tasks.popitem(last=False)
+                            entry = self._outstanding.pop(tid, None)
+                            if entry is not None:
+                                self._conn_tasks.get(entry[0], set()).discard(tid)
+                            dup_task = False
+                    if dup_task:
+                        reg.counter("server.duplicate_tasks").inc()
+                        continue
                 self.total_results += 1
                 reg.meter("server.results_per_s").mark()
                 try:
@@ -780,6 +1128,61 @@ class WorkerServer:
                         self.results.put_nowait(r)
                     except queue.Full:
                         self.dropped_results += 1
+        elif kind == "gather_hello":
+            # dynamic admission: a gather (initial, respawned, late-joining,
+            # or reconnecting) announces its worker range — the roster entry
+            # is what scale decisions count and targeted drains address
+            with self._roster_lock:
+                self.gather_links[conn] = {
+                    "base_worker_id": int(msg.get("base_worker_id", -1)),
+                    "num_workers": int(msg.get("num_workers", 0)),
+                    "gather_epoch": int(msg.get("gather_epoch", 0)),
+                    "draining": False,
+                    "joined_t": time.monotonic(),
+                }
+                self.gathers_joined += 1
+            telemetry.get_registry().counter("server.gathers_joined").inc()
+            telemetry.record_event(
+                "gather_join",
+                base=msg.get("base_worker_id"),
+                workers=msg.get("num_workers"),
+            )
+        elif kind == "task_return":
+            # drain protocol: unstarted prefetched tasks come home for
+            # reissue — accounting-wise they were never started
+            requeued = 0
+            with self._task_lock:
+                for t in msg["v"]:
+                    tid = t.get("_task_id") if isinstance(t, dict) else None
+                    if tid is not None:
+                        entry = self._outstanding.pop(tid, None)
+                        if entry is not None:
+                            self._conn_tasks.get(entry[0], set()).discard(tid)
+                        if tid in self._completed_tasks:
+                            continue  # raced a completion: nothing to redo
+                    self._returned_tasks.append(t)
+                    requeued += 1
+                self.requeued_tasks += requeued
+            if requeued:
+                telemetry.get_registry().counter("server.requeued_tasks").inc(
+                    requeued
+                )
+                telemetry.record_event(
+                    "tasks_requeued", count=requeued, why="drain"
+                )
+        elif kind == DRAIN_DONE:
+            with self._roster_lock:
+                info = self.gather_links.pop(conn, None)
+                self.gathers_drained += 1
+            telemetry.get_registry().counter("server.gathers_drained").inc()
+            telemetry.record_event(
+                "gather_drained",
+                base=msg.get("base_worker_id"),
+                workers=(info or {}).get("num_workers"),
+            )
+            logger.info(
+                "fleet: gather %s drained cleanly", msg.get("base_worker_id")
+            )
         elif kind == "worker_error":
             err = msg["v"]
             logger.error(
@@ -788,12 +1191,7 @@ class WorkerServer:
                 err.get("task"),
                 err.get("traceback", err.get("error")),
             )
-            telemetry.record_event(
-                "worker_error",
-                worker_id=err.get("worker_id"),
-                error=err.get("error"),
-            )
-            self.worker_errors.put(err)
+            self.report_worker_error(err)
         else:
             logger.warning("server: unknown message kind %r", kind)
 
@@ -818,10 +1216,18 @@ class LocalCluster:
     ``max_restarts``: elastic recovery, beyond the reference (whose fleet
     simply forgot dead workers — SURVEY.md §5).  When > 0, a supervisor
     thread respawns a gather that dies unexpectedly — same worker-id range,
-    fresh pipe registered with the server — up to ``max_restarts`` times
-    across the cluster.  The ``QueueHub`` already drops the dead pipe; the
-    learner sees at most a brief throughput dip.  0 (default) keeps the
-    fail-fast behavior (errors surface via ``server.worker_errors``).
+    fresh pipe registered with the server, and a fresh ``gather_epoch``
+    nonce salting its workers' upload epochs so a slow duplicate from the
+    corpse can never collide with the replacement's sequences — up to
+    ``max_restarts`` times across the cluster.  The ``QueueHub`` already
+    drops the dead pipe; the learner sees at most a brief throughput dip.
+    0 (default) keeps the fail-fast behavior (errors surface via
+    ``server.worker_errors``).
+
+    Deliberate elasticity rides next to the crash path: ``scale_up`` admits
+    fresh gathers mid-run (new worker-id ranges), the server's
+    ``drain_workers`` closes gathers with zero episode loss, and
+    ``ClusterExecutor`` packages both for ``runtime/autoscaler.py``.
     """
 
     def __init__(
@@ -845,8 +1251,37 @@ class LocalCluster:
         self.procs: List[mp.Process] = []
         self._spans: List[Tuple[int, int]] = []  # (base_worker_id, n) per gather
         self._ctx = None
+        self._scale_lock = threading.Lock()
         self._stopping = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
+
+    def spawned_worker_count(self) -> int:
+        """Workers behind live gather processes — the executor-side capacity
+        truth (includes gathers still booting, which the server roster
+        cannot see yet; excludes the dead and the cleanly exited)."""
+        with self._scale_lock:
+            return sum(
+                n for (base, n), p in zip(self._spans, self.procs) if p.is_alive()
+            )
+
+    def scale_up(self, num_workers: int) -> int:
+        """Dynamic admission: add ``num_workers`` of capacity mid-run as
+        fresh gather processes with FRESH worker-id ranges (never a reuse
+        of a dead range — the dedup epochs make reuse safe, fresh ranges
+        make it legible).  Returns the worker count actually added."""
+        if self._ctx is None:
+            raise RuntimeError("scale_up before start(): no mp context yet")
+        per = self.config.workers_per_gather
+        remaining = int(num_workers)
+        added = 0
+        while remaining > 0:
+            n = min(per, remaining)
+            remaining -= n
+            base = self.server.assign_worker_ids(n)
+            with self._scale_lock:
+                self._spawn(len(self.procs), base, n)
+            added += n
+        return added
 
     def _spawn(self, slot: int, base: int, n: int) -> None:
         parent, child = self._ctx.Pipe(duplex=True)
@@ -876,15 +1311,26 @@ class LocalCluster:
             remaining -= n
             base = self.server.assign_worker_ids(n)
             self._spawn(g, base, n)
-        if self.max_restarts > 0:
+        inj = chaos.active()
+        mass_kill_armed = inj is not None and inj.plan.rates.get("mass_kill", 0.0) > 0
+        if self.max_restarts > 0 or mass_kill_armed:
+            # the supervisor doubles as the chaos preemption-wave driver:
+            # with mass_kill configured it runs even at max_restarts=0 so
+            # the AUTOSCALER (not the respawn budget) does the backfilling
             self._supervisor = threading.Thread(
                 target=self._supervise, name="fleet-supervisor", daemon=True
             )
             self._supervisor.start()
 
+    def chaos_poll(self) -> List[int]:
+        """One seeded preemption-wave draw against the live gather procs
+        (``mass_kill`` chaos kind); returns the killed slot indices."""
+        return apply_mass_kill(self.procs, site="fleet")
+
     def _supervise(self) -> None:
         given_up: set = set()
         while not self._stopping.wait(0.5):
+            self.chaos_poll()
             for slot, proc in enumerate(self.procs):
                 if (
                     proc.is_alive()
@@ -906,7 +1352,7 @@ class LocalCluster:
                         "exhausted (%d used)",
                         slot, proc.exitcode, self.restarts,
                     )
-                    self.server.worker_errors.put(
+                    self.server.report_worker_error(
                         {
                             "worker_id": None,
                             "task": None,
@@ -956,6 +1402,9 @@ class RemoteCluster:
         self.num_workers = num_workers or config.num_workers
         self.mp_context = mp_context  # see LocalCluster: auto-spawn if JAX in parent
         self.procs: List[mp.Process] = []
+        self._spans: List[Tuple[int, int]] = []  # (base_worker_id, n) per proc
+        self._adopted: Optional[FleetConfig] = None
+        self._scale_lock = threading.Lock()
 
     def entry(self) -> Tuple[int, Dict[str, Any]]:
         conn = connect_socket(self.config.server_host, self.config.entry_port)
@@ -967,12 +1416,11 @@ class RemoteCluster:
         finally:
             conn.close()
 
-    def start(self) -> None:
+    def _adopt(self, remote_cfg: Dict[str, Any]) -> FleetConfig:
         import dataclasses
 
-        base, remote_cfg = self.entry()
         # adopt the learner side's fleet policy from the handshake
-        config = dataclasses.replace(
+        return dataclasses.replace(
             self.config,
             workers_per_gather=int(
                 remote_cfg.get("workers_per_gather", self.config.workers_per_gather)
@@ -1000,10 +1448,12 @@ class RemoteCluster:
             ),
             extra={**self.config.extra, **remote_cfg.get("extra", {})},
         )
+
+    def _launch(self, config: FleetConfig, base: int, num_workers: int) -> None:
         from scalerl_tpu.utils.platform import safe_mp_context
 
         per = config.workers_per_gather
-        remaining = self.num_workers
+        remaining = num_workers
         offset = 0
         ctx = mp.get_context(safe_mp_context(self.mp_context))
         while remaining > 0:
@@ -1020,9 +1470,37 @@ class RemoteCluster:
                 ),
             )
             proc.start()
-            self.procs.append(proc)
+            with self._scale_lock:
+                self.procs.append(proc)
+                self._spans.append((base + offset, n))
             remaining -= n
             offset += n
+
+    def start(self) -> None:
+        base, remote_cfg = self.entry()
+        self._adopted = self._adopt(remote_cfg)
+        self._launch(self._adopted, base, self.num_workers)
+
+    def scale_up(self, num_workers: int) -> int:
+        """Dynamic admission from the remote-host side: a FRESH entry
+        handshake mid-run assigns a new worker-id range and new socket
+        gathers join the live fleet — the late-join path a spot replacement
+        node takes.  Returns the worker count added."""
+        base, remote_cfg = self.entry()
+        config = self._adopted if self._adopted is not None else self._adopt(remote_cfg)
+        self._launch(config, base, int(num_workers))
+        return int(num_workers)
+
+    def spawned_worker_count(self) -> int:
+        """Executor-side capacity truth (see LocalCluster)."""
+        with self._scale_lock:
+            return sum(
+                n for (base, n), p in zip(self._spans, self.procs) if p.is_alive()
+            )
+
+    def chaos_poll(self) -> List[int]:
+        """One seeded preemption-wave draw against the gather procs."""
+        return apply_mass_kill(self.procs, site="fleet")
 
     def join(self, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout
@@ -1038,3 +1516,57 @@ def _remote_gather_main(host, port, config, runner, base, n) -> None:
     # exponential backoff schedule and the max_reconnects budget
     reconnect = lambda: connect_socket(host, port, retries=1)  # noqa: E731
     gather_main(conn, config, runner, base, n, reconnect=reconnect)
+
+
+# ---------------------------------------------------------------------------
+# elasticity: preemption waves + the autoscaler's reference executor
+
+
+def apply_mass_kill(procs: List[mp.Process], site: str = "fleet") -> List[int]:
+    """One ``mass_kill`` chaos draw against ``procs``: when the active
+    injector's seeded wave fires, SIGTERM the chosen live peers (a spot
+    preemption wave in miniature) and return their indices.  No injector or
+    no fire → empty list, zero cost."""
+    inj = chaos.active()
+    if inj is None:
+        return []
+    alive = [i for i, p in enumerate(procs) if p.is_alive()]
+    victims = inj.mass_kill_victims(len(alive), site=site)
+    if not victims:
+        return []
+    killed = [alive[v] for v in victims]
+    for i in killed:
+        procs[i].terminate()
+    telemetry.record_event("mass_kill", site=site, victims=killed)
+    logger.warning(
+        "chaos: mass_kill wave terminated %d/%d gathers (slots %s)",
+        len(killed), len(alive), killed,
+    )
+    return killed
+
+
+class ClusterExecutor:
+    """The autoscaler's reference ``ScaleExecutor`` over a ``WorkerServer``
+    plus a Local/RemoteCluster.
+
+    - ``worker_count``: the CLUSTER's spawned-process view (booting gathers
+      count; dead ones don't) — using the server roster here would re-fire
+      the floor rule every poll while a replacement boots.
+    - ``scale_up``: spawn fresh gathers with fresh worker-id ranges
+      (``cluster.scale_up``).
+    - ``scale_down``: the server's drain protocol (``drain_workers``) — a
+      deliberate zero-loss close, never a kill.
+    """
+
+    def __init__(self, server: WorkerServer, cluster: Any) -> None:
+        self.server = server
+        self.cluster = cluster
+
+    def worker_count(self) -> int:
+        return self.cluster.spawned_worker_count()
+
+    def scale_up(self, n: int) -> int:
+        return self.cluster.scale_up(n)
+
+    def scale_down(self, n: int) -> int:
+        return self.server.drain_workers(n)

@@ -64,6 +64,7 @@ class QueueHub:
         on_dead: Optional[Callable[[Connection, str], None]] = None,
         on_telemetry: Optional[Callable[[Connection, Any], None]] = None,
         max_pending: int = 0,
+        on_disconnect: Optional[Callable[[Connection], None]] = None,
     ) -> None:
         # max_pending > 0 arms BOUNDED ADMISSION on the inbound queue: when
         # the consumer lags that far behind, the stalest queued message is
@@ -84,6 +85,12 @@ class QueueHub:
         # to this callback in the recv pump (one merge point, no new
         # message kinds or round-trips)
         self.on_telemetry = on_telemetry
+        # membership: fired for EVERY removal of a registered connection
+        # (EOF, protocol error, liveness verdict) — unlike on_dead, which
+        # only covers heartbeat verdicts.  The elastic fleet uses this to
+        # requeue a dead gather's outstanding tasks and clean its roster
+        # entry; close() does not fire it (teardown is not churn).
+        self.on_disconnect = on_disconnect
         self.protocol_errors = 0  # corrupt frames rejected by the recv pump
         self.peers_dropped = 0  # liveness verdicts (silent peers dropped)
         telemetry.get_registry().bind(
@@ -124,6 +131,7 @@ class QueueHub:
 
     def disconnect(self, conn: Connection) -> None:
         with self._lock:
+            present = conn in self._conns
             self._conns.discard(conn)
             self._greeted.discard(conn)
         self._liveness.forget(conn)
@@ -131,6 +139,11 @@ class QueueHub:
             conn.close()
         except Exception:
             pass
+        if present and self.on_disconnect is not None:
+            try:
+                self.on_disconnect(conn)
+            except Exception:  # noqa: BLE001 — membership hooks must not kill the pump
+                logger.exception("hub: on_disconnect callback failed")
 
     def recv(self, timeout: Optional[float] = None) -> Tuple[Connection, Any]:
         """Next (connection, message); raises queue.Empty on timeout."""

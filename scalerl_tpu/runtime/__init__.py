@@ -22,6 +22,11 @@ if TYPE_CHECKING:  # static analyzers see the real symbols
         ChaosPlan,
         FaultInjector,
     )
+    from scalerl_tpu.runtime.autoscaler import (  # noqa: F401
+        Autoscaler,
+        AutoscalerConfig,
+        FleetSignals,
+    )
     from scalerl_tpu.runtime.supervisor import (  # noqa: F401
         CheckpointCadence,
         DivergenceTripwire,
@@ -45,6 +50,9 @@ _EXPORTS = {
     "RolloutQueue": "scalerl_tpu.runtime.rollout_queue",
     "ChaosPlan": "scalerl_tpu.runtime.chaos",
     "FaultInjector": "scalerl_tpu.runtime.chaos",
+    "Autoscaler": "scalerl_tpu.runtime.autoscaler",
+    "AutoscalerConfig": "scalerl_tpu.runtime.autoscaler",
+    "FleetSignals": "scalerl_tpu.runtime.autoscaler",
     "CheckpointCadence": "scalerl_tpu.runtime.supervisor",
     "DivergenceTripwire": "scalerl_tpu.runtime.supervisor",
     "PreemptionGuard": "scalerl_tpu.runtime.supervisor",

@@ -29,8 +29,9 @@ comma-separated ``kind=rate`` or ``kind=rate@max_count`` entries plus
 options ``minframe=<bytes>`` (frame faults only hit frames at least this
 large — scopes chaos to the rollout uplink, not the entry handshake),
 ``sites=<prefix>[|<prefix>...]`` (frame faults only at matching transport
-sites, e.g. ``sites=sock``), and ``delay=<seconds>`` (the ``frame_delay``
-duration).  Example::
+sites, e.g. ``sites=sock``), ``delay=<seconds>`` (the ``frame_delay``
+duration), and ``kills=<n>`` (victims per ``mass_kill`` wave; default half
+the live peers).  Example::
 
     SCALERL_CHAOS="42:frame_bitflip=0.05@3,grad_nan=0.2@10,minframe=1024"
 
@@ -70,6 +71,7 @@ KINDS = FRAME_KINDS + (
     "ckpt_partial",    # freshly-written checkpoint left truncated
     "grad_nan",        # NaN planted in the training batch
     "grad_inf",        # Inf planted in the training batch
+    "mass_kill",       # K fleet peers SIGTERMed in one window (spot wave)
 )
 
 _UNLIMITED = 1 << 62
@@ -85,6 +87,9 @@ class ChaosPlan:
     min_frame_bytes: int = 0
     site_prefixes: Tuple[str, ...] = ()  # empty = every site
     delay_s: float = 0.05
+    # mass_kill victim count per wave (spec option ``kills=<n>``); 0 means
+    # "half the live peers, rounded up" — the spot-preemption-wave default
+    kill_count: int = 0
 
     def __post_init__(self) -> None:
         for kind in self.rates:
@@ -110,6 +115,7 @@ class ChaosPlan:
         minframe = 0
         sites: Tuple[str, ...] = ()
         delay_s = 0.05
+        kill_count = 0
         for token in filter(None, (t.strip() for t in spec.split(","))):
             key, eq, value = token.partition("=")
             if not eq:
@@ -125,10 +131,12 @@ class ChaosPlan:
                 sites = tuple(filter(None, value.split("|")))
             elif key == "delay":
                 delay_s = float(value)
+            elif key == "kills":
+                kill_count = int(value)
             else:
                 raise ValueError(
                     f"unknown chaos spec key {key!r} (fault kinds: "
-                    f"{sorted(KINDS)}; options: minframe, sites, delay)"
+                    f"{sorted(KINDS)}; options: minframe, sites, delay, kills)"
                 )
         return cls(
             seed=seed,
@@ -137,6 +145,7 @@ class ChaosPlan:
             min_frame_bytes=minframe,
             site_prefixes=sites,
             delay_s=delay_s,
+            kill_count=kill_count,
         )
 
     def spec(self) -> str:
@@ -151,6 +160,8 @@ class ChaosPlan:
             parts.append("sites=" + "|".join(self.site_prefixes))
         if self.delay_s != 0.05:
             parts.append(f"delay={self.delay_s}")
+        if self.kill_count:
+            parts.append(f"kills={self.kill_count}")
         return f"{self.seed}:" + ",".join(parts)
 
 
@@ -245,6 +256,26 @@ class FaultInjector:
         if self.decide("frame_delay", site):
             time.sleep(self.plan.delay_s)
         return [data], None
+
+    # -- preemption waves ------------------------------------------------
+    def mass_kill_victims(self, n_peers: int, site: str = "fleet") -> List[int]:
+        """One preemption-wave draw: when the ``mass_kill`` stream fires,
+        return the indices (into the caller's list of ``n_peers`` live
+        peers) to kill inside this window — ``plan.kill_count`` of them, or
+        half the fleet rounded up when unset.  Empty list = no wave.
+
+        The victim choice draws from the same per-(kind, site) stream as
+        the fire decision, so the same seed preempts the same peers — the
+        autoscaler-backfill chaos tests replay identical waves.
+        """
+        if n_peers <= 0 or not self.decide("mass_kill", site):
+            return []
+        k = self.plan.kill_count or max(1, (n_peers + 1) // 2)
+        k = min(k, n_peers)
+        with self._lock:
+            g = self._gen("mass_kill", site)
+            victims = sorted(int(i) for i in g.choice(n_peers, size=k, replace=False))
+        return victims
 
     # -- shm ring slots ------------------------------------------------
     def tear_slot(self, payload, site: str = "shm_ring") -> bool:

@@ -48,6 +48,13 @@ logger = get_logger(__name__)
 PING = "ping"
 PONG = "pong"
 
+# Drain control frames (fleet elasticity plane): the server tells a gather to
+# stop starting episodes, return unstarted tasks, flush retained uploads, and
+# close cleanly — the scale-down / spot-preemption path that loses zero
+# episodes (kill-and-respawn is the crash path; this is the deliberate one).
+DRAIN = "drain"
+DRAIN_DONE = "drain_done"
+
 
 def make_ping() -> Dict[str, Any]:
     return {"kind": PING, "t": time.time()}
@@ -59,6 +66,14 @@ def make_pong(ping_msg: Dict[str, Any]) -> Dict[str, Any]:
 
 def is_heartbeat(msg: Any) -> bool:
     return isinstance(msg, dict) and msg.get("kind") in (PING, PONG)
+
+
+def make_drain() -> Dict[str, Any]:
+    return {"kind": DRAIN, "t": time.time()}
+
+
+def is_drain(msg: Any) -> bool:
+    return isinstance(msg, dict) and msg.get("kind") == DRAIN
 
 
 def exp_backoff(attempt: int, base: float = 0.5, cap: float = 10.0) -> float:

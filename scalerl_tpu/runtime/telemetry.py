@@ -415,9 +415,14 @@ class FlightRecorder:
             self._events.append(evt)
             self.total_recorded += 1
 
-    def events(self) -> List[Dict[str, Any]]:
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """The retained tail, oldest first; ``kind`` filters to one event
+        kind (``events("autoscale_decision")`` — the soak/chaos assertions)."""
         with self._lock:
-            return list(self._events)
+            evts = list(self._events)
+        if kind is None:
+            return evts
+        return [e for e in evts if e.get("kind") == kind]
 
     def clear(self) -> None:
         with self._lock:

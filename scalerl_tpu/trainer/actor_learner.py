@@ -449,6 +449,17 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
         start = time.time()
         start_frames = self.env_frames  # nonzero after resume
         last_log_frames = start_frames
+        # elasticity signals: the autoscaler's documented inputs (rates.fps
+        # / rates.learn_steps_per_s, docs/OBSERVABILITY.md) are fed with
+        # interval deltas at the log boundary — per-chunk cadence, and
+        # telemetry-off compiles the marks out entirely
+        fps_meter = learn_meter = None
+        if self._instrument:
+            _reg = telemetry.get_registry()
+            fps_meter = _reg.meter("rates.fps")
+            learn_meter = _reg.meter("rates.learn_steps_per_s")
+        meter_frames = start_frames
+        meter_steps = 0
         cadence = CheckpointCadence(
             args.save_frequency, args.checkpoint_interval_s, start_frames
         )
@@ -579,6 +590,12 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                             min(c.generation for c in self._serving_clients)
                         )
                     if self._instrument:
+                        if fps_meter is not None:
+                            fps_meter.mark(self.env_frames - meter_frames)
+                            meter_frames = self.env_frames
+                        if learn_meter is not None:
+                            learn_meter.mark(learn_steps_done - meter_steps)
+                            meter_steps = learn_steps_done
                         telemetry.observe_train_metrics(host_metrics)
                         reg = telemetry.get_registry()
                         reg.set_gauges(

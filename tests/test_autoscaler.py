@@ -1,0 +1,351 @@
+"""Elastic fleet: autoscaler decision table + the drain/admission protocol.
+
+The decision-table tests are jax-free and pure — synthetic signal vectors in,
+expected actions out, with injected clocks so hysteresis and cooldown are
+asserted deterministically (the anti-flap contract).  The fleet-level test
+runs a real ``LocalCluster``: scale-up mid-run (dynamic admission, fresh
+worker-id range) followed by a scripted drain, asserting zero lost and zero
+duplicated episodes — the scale-down half of the elasticity acceptance
+criterion.
+"""
+
+import threading
+import time
+
+import pytest
+
+from scalerl_tpu.fleet import FleetConfig, LocalCluster, WorkerServer
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.autoscaler import (
+    HOLD,
+    SCALE_DOWN,
+    SCALE_UP,
+    Autoscaler,
+    AutoscalerConfig,
+    FleetSignals,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _engine(**kw) -> Autoscaler:
+    defaults = dict(min_workers=1, max_workers=8, up_hysteresis=1,
+                    down_hysteresis=1, cooldown_s=0.0)
+    defaults.update(kw)
+    return Autoscaler(AutoscalerConfig(**defaults))
+
+
+# ---------------------------------------------------------------------------
+# decision table
+
+
+def test_steady_signals_hold():
+    a = _engine()
+    d = a.evaluate(FleetSignals(live_workers=4, queue_occupancy=0.5), now=0.0)
+    assert d.action == HOLD and d.reason == "steady"
+
+
+def test_floor_breach_backfills_immediately_bypassing_guards():
+    """A preemption wave below min_workers is backfilled with no hysteresis
+    and no cooldown — riding the wave, not flapping."""
+    a = _engine(min_workers=4, up_hysteresis=3, cooldown_s=1000.0)
+    d = a.evaluate(FleetSignals(live_workers=2), now=0.0)
+    assert d.action == SCALE_UP and d.delta == 2
+    assert d.reason == "below_min_workers"
+    # a second wave moments later (well inside the cooldown) still backfills
+    d = a.evaluate(FleetSignals(live_workers=1), now=1.0)
+    assert d.action == SCALE_UP and d.delta == 3
+
+
+def test_starved_learner_scales_up_after_hysteresis():
+    a = _engine(up_hysteresis=2)
+    starved = FleetSignals(live_workers=4, queue_occupancy=0.0)
+    d1 = a.evaluate(starved, now=0.0)
+    assert d1.action == HOLD and d1.reason.startswith("hysteresis:scale_up")
+    d2 = a.evaluate(starved, now=1.0)
+    assert d2.action == SCALE_UP and d2.delta == 1 and d2.reason == "learner_starved"
+
+
+def test_fps_target_suppresses_starved_verdict():
+    """With a production target set, an empty queue alone is not starvation
+    when actors already out-produce the learner's demand."""
+    a = _engine(fps_per_learn_step=100.0)
+    fast = FleetSignals(live_workers=4, queue_occupancy=0.0,
+                        fps=500.0, learn_steps_per_s=2.0)
+    assert a.evaluate(fast, now=0.0).action == HOLD
+    slow = FleetSignals(live_workers=4, queue_occupancy=0.0,
+                        fps=50.0, learn_steps_per_s=2.0)
+    assert a.evaluate(slow, now=1.0).action == SCALE_UP
+
+
+@pytest.mark.parametrize(
+    "signals, why",
+    [
+        (FleetSignals(live_workers=4, queue_occupancy=0.95), "flooded queue"),
+        (FleetSignals(live_workers=4, queue_occupancy=0.5, shed_delta=3.0),
+         "bounded-admission sheds"),
+    ],
+)
+def test_overload_scales_down(signals, why):
+    a = _engine(down_hysteresis=1)
+    d = a.evaluate(signals, now=0.0)
+    assert d.action == SCALE_DOWN and d.delta == 1, why
+
+
+def test_serving_slo_breach_scales_down():
+    a = _engine(serving_p95_slo_ms=50.0)
+    d = a.evaluate(
+        FleetSignals(live_workers=4, queue_occupancy=0.5, serving_p95_ms=80.0),
+        now=0.0,
+    )
+    assert d.action == SCALE_DOWN
+    # under the SLO: no pressure
+    d = a.evaluate(
+        FleetSignals(live_workers=4, queue_occupancy=0.5, serving_p95_ms=20.0),
+        now=1.0,
+    )
+    assert d.action == HOLD
+
+
+def test_jittered_signals_never_act():
+    """Hysteresis holds under jitter: pressure that never persists two
+    consecutive evaluations (heartbeat noise, one spiky queue sample) must
+    never move the fleet."""
+    a = _engine(up_hysteresis=2, down_hysteresis=2)
+    starved = FleetSignals(live_workers=4, queue_occupancy=0.0)
+    steady = FleetSignals(live_workers=4, queue_occupancy=0.5)
+    flooded = FleetSignals(live_workers=4, queue_occupancy=0.95)
+    for i in range(30):
+        d = a.evaluate([starved, steady, flooded][i % 3], now=float(i))
+        assert d.action == HOLD, f"acted on jitter at step {i}: {d}"
+    assert a.scale_ups == 0 and a.scale_downs == 0
+
+
+def test_direction_flip_resets_the_opposing_streak():
+    a = _engine(up_hysteresis=2, down_hysteresis=2)
+    starved = FleetSignals(live_workers=4, queue_occupancy=0.0)
+    flooded = FleetSignals(live_workers=4, queue_occupancy=0.95)
+    a.evaluate(starved, now=0.0)          # up streak = 1
+    a.evaluate(flooded, now=1.0)          # down streak = 1, up reset
+    d = a.evaluate(starved, now=2.0)      # up streak back to 1 — no action
+    assert d.action == HOLD
+
+
+def test_cooldown_suppresses_flapping():
+    a = _engine(up_hysteresis=1, cooldown_s=30.0, min_workers=1)
+    starved = FleetSignals(live_workers=4, queue_occupancy=0.0)
+    d = a.evaluate(starved, now=0.0)
+    assert d.action == SCALE_UP
+    d = a.evaluate(starved, now=5.0)
+    assert d.action == HOLD and d.reason.startswith("cooldown")
+    d = a.evaluate(starved, now=29.9)
+    assert d.action == HOLD
+    d = a.evaluate(starved, now=31.0)
+    assert d.action == SCALE_UP  # cooldown elapsed: pressure persists, act
+
+
+def test_bounds_clamp_actions():
+    a = _engine(min_workers=2, max_workers=4)
+    d = a.evaluate(FleetSignals(live_workers=4, queue_occupancy=0.0), now=0.0)
+    assert d.action == HOLD and d.reason == "at_max_workers"
+    d = a.evaluate(FleetSignals(live_workers=2, queue_occupancy=0.95), now=1.0)
+    assert d.action == HOLD and d.reason == "at_min_workers"
+
+
+def test_decisions_land_in_flight_recorder_and_registry():
+    a = _engine(min_workers=4)
+    a.evaluate(FleetSignals(live_workers=2), now=0.0)
+    ups = [
+        e for e in telemetry.get_recorder().events("autoscale_decision")
+        if e.get("action") == SCALE_UP
+    ]
+    assert ups and ups[-1]["reason"] == "below_min_workers"
+    assert telemetry.get_registry().counter("autoscaler.scale_ups").value == 1
+    snap = telemetry.snapshot()["autoscaler"]
+    assert snap["scale_ups"] == 1 and snap["decisions"] == 1
+
+
+def test_actions_per_min_window():
+    a = _engine(min_workers=8)
+    for t in (0.0, 10.0, 20.0):
+        a.evaluate(FleetSignals(live_workers=1), now=t)
+    assert a.actions_per_min(window_s=60.0, now=25.0) == pytest.approx(3.0)
+    # only the t=20 action is still inside the trailing minute at t=75
+    assert a.actions_per_min(window_s=60.0, now=75.0) == pytest.approx(1.0)
+
+
+def test_config_validation_and_from_args():
+    with pytest.raises(ValueError):
+        AutoscalerConfig(min_workers=4, max_workers=2)
+    with pytest.raises(ValueError):
+        AutoscalerConfig(up_hysteresis=0)
+    from scalerl_tpu.config import RLArguments
+
+    args = RLArguments(
+        autoscale=True, autoscale_min_workers=3, autoscale_max_workers=12,
+        autoscale_interval_s=2.0, autoscale_cooldown_s=7.0,
+        autoscale_hysteresis=2,
+    )
+    args.validate()
+    cfg = AutoscalerConfig.from_args(args)
+    assert cfg.min_workers == 3 and cfg.max_workers == 12
+    assert cfg.interval_s == 2.0 and cfg.cooldown_s == 7.0
+    assert cfg.up_hysteresis == 2 and cfg.down_hysteresis == 3
+    with pytest.raises(ValueError):
+        RLArguments(autoscale_min_workers=5, autoscale_max_workers=4).validate()
+    with pytest.raises(ValueError):
+        RLArguments(autoscale=True, autoscale_interval_s=0.0).validate()
+
+
+class _FakeExecutor:
+    def __init__(self, workers: int) -> None:
+        self.workers = workers
+        self.calls = []
+
+    def worker_count(self) -> int:
+        return self.workers
+
+    def scale_up(self, n: int) -> None:
+        self.calls.append(("up", n))
+        self.workers += n
+
+    def scale_down(self, n: int) -> None:
+        self.calls.append(("down", n))
+        self.workers -= n
+
+
+def test_step_reads_signals_and_applies_via_executor():
+    ex = _FakeExecutor(workers=2)
+    a = Autoscaler(
+        AutoscalerConfig(min_workers=4, max_workers=8),
+        executor=ex,
+        # the source reports a stale roster count; the executor's spawned
+        # count must win (booting gathers count as capacity)
+        signal_source=lambda: FleetSignals(live_workers=99, queue_occupancy=0.5),
+    )
+    d = a.step(now=0.0)
+    assert d.action == SCALE_UP and d.delta == 2
+    assert ex.calls == [("up", 2)] and ex.workers == 4
+    # floor restored: next step holds
+    assert a.step(now=1.0).action == HOLD
+
+
+def test_background_loop_backfills():
+    ex = _FakeExecutor(workers=1)
+    a = Autoscaler(
+        AutoscalerConfig(min_workers=2, max_workers=4, interval_s=0.05),
+        executor=ex,
+        signal_source=lambda: FleetSignals(queue_occupancy=0.5),
+    )
+    with a:
+        deadline = time.monotonic() + 5.0
+        while not ex.calls and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert ("up", 1) in ex.calls
+
+
+# ---------------------------------------------------------------------------
+# the drain/admission protocol over a real fleet (the scale-down satellite:
+# zero lost, zero duplicate episodes)
+
+
+def _elastic_runner(task, weights, worker_id):
+    # module-level: survives pickling into spawn children.  The short hold
+    # keeps tasks in flight while the drain lands mid-stream.
+    time.sleep(0.05)
+    return {"seed": int(task.get("seed", 0)), "worker_id_echo": worker_id}
+
+
+def _collect(server, n, timeout=180.0):
+    results = []
+    deadline = time.monotonic() + timeout
+    while len(results) < n and time.monotonic() < deadline:
+        r = server.get_result(timeout=0.2)
+        if r is not None:
+            results.append(r)
+    return results
+
+
+def test_scale_up_then_drain_loses_nothing():
+    """Dynamic admission + the drain protocol end to end: a gather joins
+    mid-run with a fresh worker-id range, then a scripted drain closes the
+    newest gather — all episodes arrive exactly once, the drained gather
+    exits 0, and the roster tracks every transition.
+
+    The task source stays open until the drain has been OBSERVED, so the
+    drain always lands mid-stream regardless of how slowly spawn children
+    boot on a loaded CI host."""
+    state = {"n": 0, "stop": False}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if state["stop"]:
+                return None
+            state["n"] += 1
+            return {"role": "rollout", "seed": state["n"]}
+
+    config = FleetConfig(
+        num_workers=2, workers_per_gather=2, upload_batch=1,
+        heartbeat_interval_s=0.2,
+    )
+    server = WorkerServer(config, source)
+    server.start(listen=False)
+    cluster = LocalCluster(server, config, _elastic_runner)
+    cluster.start()
+    try:
+        results = _collect(server, 5)
+        assert len(results) == 5
+        # dynamic admission: +1 gather (2 workers) mid-run, fresh id range
+        assert cluster.scale_up(2) == 2
+        deadline = time.monotonic() + 120.0
+        while server.live_worker_count() < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.live_worker_count() == 4
+        assert cluster.spawned_worker_count() == 4
+        # scripted drain: the NEWEST gather (the scale-up slot) stops
+        # starting episodes, returns unstarted tasks, flushes + awaits
+        # acks, and exits cleanly with a drain_done
+        assert server.drain_workers(2) == 2
+        deadline = time.monotonic() + 60.0
+        while server.gathers_drained < 1 and time.monotonic() < deadline:
+            r = server.get_result(timeout=0.1)
+            if r is not None:
+                results.append(r)
+        assert server.gathers_drained >= 1, "drain_done never arrived"
+        # stop the source and drain everything still in flight
+        with lock:
+            state["stop"] = True
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with lock:
+                handed = state["n"]
+            if len(results) >= handed:
+                break
+            r = server.get_result(timeout=0.2)
+            if r is not None:
+                results.append(r)
+        # exactly-once accounting across the join and the drain: every task
+        # handed out completed exactly once — zero lost, zero duplicated
+        with lock:
+            handed = state["n"]
+        seeds = [r["seed"] for r in results]
+        assert len(seeds) == len(set(seeds)), "duplicate episodes delivered"
+        assert set(seeds) == set(range(1, handed + 1)), (
+            f"lost episodes: handed {handed}, unique {len(set(seeds))} "
+            f"(requeued={server.requeued_tasks}, "
+            f"dup_tasks={server.duplicate_tasks})"
+        )
+        # the drained gather exited CLEANLY (exit code 0, not a kill)
+        drained_proc = cluster.procs[-1]
+        drained_proc.join(timeout=30.0)
+        assert not drained_proc.is_alive() and drained_proc.exitcode == 0
+        assert telemetry.get_recorder().events("gather_drained")
+    finally:
+        cluster.join()
+        server.stop()

@@ -145,6 +145,34 @@ def test_frame_faults_scoping():
     assert inj.frame_faults(b"x" * 200, "sock") == ([], None)
 
 
+def test_mass_kill_victims_deterministic_and_sized():
+    """The preemption-wave kind: same seed -> same victims; the default
+    wave size is half the live peers (rounded up); kills= overrides."""
+    plan = ChaosPlan(seed=21, rates={"mass_kill": 1.0}, limits={"mass_kill": 1})
+    a, b = FaultInjector(plan), FaultInjector(plan)
+    va, vb = a.mass_kill_victims(4), b.mass_kill_victims(4)
+    assert va == vb and len(va) == 2  # half of 4
+    assert all(0 <= v < 4 for v in va) and len(set(va)) == 2
+    # the @1 limit caps the wave count: a second draw never fires
+    assert a.mass_kill_victims(4) == []
+    # kills= overrides the half default (and clamps to the fleet size)
+    c = FaultInjector(ChaosPlan(seed=21, rates={"mass_kill": 1.0}, kill_count=3))
+    assert len(c.mass_kill_victims(4)) == 3
+    d = FaultInjector(ChaosPlan(seed=21, rates={"mass_kill": 1.0}, kill_count=9))
+    assert len(d.mass_kill_victims(4)) == 4
+    # no peers / no fire -> empty, and rate 0 never fires
+    assert FaultInjector(plan).mass_kill_victims(0) == []
+    assert FaultInjector(ChaosPlan(seed=1)).mass_kill_victims(4) == []
+
+
+def test_mass_kill_spec_roundtrip():
+    plan = ChaosPlan.parse("9:mass_kill=0.5@2,kills=3")
+    assert plan.rates["mass_kill"] == 0.5
+    assert plan.limits["mass_kill"] == 2
+    assert plan.kill_count == 3
+    assert ChaosPlan.parse(plan.spec()) == plan
+
+
 def test_env_var_activation_and_clear(monkeypatch):
     monkeypatch.setenv(chaos.ENV_VAR, "9:frame_dup=1.0")
     chaos.clear()
@@ -528,6 +556,124 @@ def test_chaos_matrix_fleet_survives_frame_faults(kind, monkeypatch):
             ).astype(np.float32)
             np.testing.assert_array_equal(r["frames"], expect)
     finally:
+        remote.join()
+        server.stop()
+        chaos.clear()
+
+
+def _wave_runner(task, weights, worker_id):
+    """Module-level (spawn-picklable): a short episode whose hold time keeps
+    tasks in flight while the preemption wave lands, with a bit-exact
+    payload derived from the seed so uniqueness accounting verifies content
+    integrity too."""
+    import numpy as _np
+    import time as _time
+
+    _time.sleep(0.25)
+    seed = int(task.get("seed", 0))
+    return {
+        "seed": seed,
+        "frames": _np.random.default_rng(seed).standard_normal(
+            (16, 32)
+        ).astype(_np.float32),
+    }
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_mass_kill_wave_autoscaler_backfills(monkeypatch):
+    """The elasticity acceptance criterion: a socket fleet hit by a seeded
+    ``mass_kill`` of HALF its gathers, with the autoscaler backfilling
+    through fresh entry handshakes (late-join dynamic admission), completes
+    with the exact unique episode count — dead gathers' outstanding tasks
+    requeue, task-level dedup absorbs any raced double execution — and the
+    scale-up decision is on the FlightRecorder."""
+    from scalerl_tpu.fleet import ClusterExecutor, FleetConfig, RemoteCluster, WorkerServer
+    from scalerl_tpu.runtime import telemetry
+    from scalerl_tpu.runtime.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        fleet_signal_source,
+    )
+
+    n_tasks = 32
+    monkeypatch.setenv(chaos.ENV_VAR, "777:mass_kill=1.0@1")  # kills half
+    chaos.clear()
+    entry_port, worker_port = _free_port(), _free_port()
+    config = FleetConfig(
+        num_workers=4,
+        workers_per_gather=1,  # 4 gather procs: the wave kills 2
+        upload_batch=1,
+        entry_port=entry_port,
+        worker_port=worker_port,
+        heartbeat_interval_s=0.2,
+        reconnect_backoff_s=0.05,
+        reconnect_backoff_cap_s=0.5,
+        max_reconnects=20,
+    )
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if counter["i"] >= n_tasks:
+                return None
+            counter["i"] += 1
+            return {"role": "rollout", "seed": counter["i"]}
+
+    server = WorkerServer(config, source)
+    server.start(listen=True)
+    remote = RemoteCluster(config, _wave_runner)
+    remote.start()
+    autoscaler = Autoscaler(
+        AutoscalerConfig(
+            min_workers=4, max_workers=8, interval_s=0.25, cooldown_s=1.0,
+            up_hysteresis=1, low_occupancy=-1.0,  # floor backfill only
+        ),
+        executor=ClusterExecutor(server, remote),
+        signal_source=fleet_signal_source(server),
+    ).start()
+    try:
+        pre = []
+        deadline = time.monotonic() + 180.0
+        while len(pre) < 4 and time.monotonic() < deadline:
+            r = server.get_result(timeout=0.2)
+            if r is not None:
+                pre.append(r)
+        assert len(pre) == 4, "fleet never warmed up"
+        # the seeded wave: rate 1.0@1 fires on this draw, killing half
+        killed = remote.chaos_poll()
+        assert len(killed) == 2, f"wave killed {killed}, wanted half of 4"
+        results = pre
+        deadline = time.monotonic() + 240.0
+        while len(results) < n_tasks and time.monotonic() < deadline:
+            r = server.get_result(timeout=0.2)
+            if r is not None:
+                results.append(r)
+        assert len(results) == n_tasks, (
+            f"only {len(results)}/{n_tasks} episodes after the wave "
+            f"(requeued={server.requeued_tasks}, "
+            f"scale_ups={autoscaler.scale_ups}, "
+            f"spawned={remote.spawned_worker_count()})"
+        )
+        # exact unique accounting on the PR 4 dedup keys + task ids,
+        # payloads bit-exact
+        assert {r["seed"] for r in results} == set(range(1, n_tasks + 1))
+        for r in results:
+            expect = np.random.default_rng(r["seed"]).standard_normal(
+                (16, 32)
+            ).astype(np.float32)
+            np.testing.assert_array_equal(r["frames"], expect)
+        # the autoscaler backfilled (>= 1 scale-up on the FlightRecorder)
+        assert autoscaler.scale_ups >= 1
+        ups = [
+            e for e in telemetry.get_recorder().events("autoscale_decision")
+            if e.get("action") == "scale_up"
+        ]
+        assert ups, "no scale_up decision recorded in the FlightRecorder"
+        assert telemetry.get_recorder().events("mass_kill")
+    finally:
+        autoscaler.stop()
         remote.join()
         server.stop()
         chaos.clear()

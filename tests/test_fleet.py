@@ -234,6 +234,124 @@ def test_worker_results_carry_dedup_key_and_server_drops_duplicates():
     assert server.total_results == 5
 
 
+def test_dedup_epoch_history_survives_respawn_interleave():
+    """The elastic-respawn dedup hole: a SLOW duplicate from a dead gather
+    (old epoch) landing after the replacement's fresh epoch must stay a
+    duplicate — the old single-epoch table was reset by the late frame and
+    double-counted it."""
+    server = WorkerServer(FleetConfig(num_workers=1), lambda: None)
+    server.hub.send = lambda c, m, compress=False: None  # type: ignore
+    conn = object()
+
+    def res(epoch, seq):
+        return {"worker_id": 0, "upload_epoch": epoch, "episode_seq": seq}
+
+    server._handle(conn, {"kind": "result_batch", "v": [res(1, 0), res(1, 1)]})
+    # respawned gather: same worker id, fresh epoch
+    server._handle(conn, {"kind": "result_batch", "v": [res(2, 0)]})
+    assert server.total_results == 3
+    # the corpse's retransmit arrives LATE, after the fresh epoch registered
+    server._handle(conn, {"kind": "result_batch", "v": [res(1, 1)]})
+    assert server.total_results == 3, "late old-epoch duplicate was re-counted"
+    assert server.duplicate_results == 1
+    # and the fresh epoch's stream is unaffected by the late frame
+    server._handle(conn, {"kind": "result_batch", "v": [res(2, 1)]})
+    assert server.total_results == 4
+    server.stop()
+
+
+def test_outstanding_tasks_requeue_on_disconnect_with_task_dedup():
+    """Exactly-once episode accounting across elastic churn: a dead link's
+    outstanding tasks requeue (same ``_task_id``), and a task that raced
+    its requeue and completed twice is counted once."""
+    tasks = iter([{"seed": i} for i in range(1, 4)])
+    server = WorkerServer(
+        FleetConfig(num_workers=1), lambda: next(tasks, None)
+    )
+    sent = []
+    server.hub.send = lambda c, m, compress=False: sent.append((c, m))  # type: ignore
+    conn_a, conn_b = object(), object()
+    server._handle(conn_a, {"kind": "task_batch", "n": 2})
+    issued = sent[-1][1]["v"]
+    assert [t["_task_id"] for t in issued] == [0, 1]
+    # the gather dies (EOF/liveness/preemption): its tasks requeue
+    server._on_disconnect(conn_a)
+    assert server.requeued_tasks == 2
+    # reissued to the next gather with the SAME ids (same episodes)
+    server._handle(conn_b, {"kind": "task_batch", "n": 2})
+    reissued = sent[-1][1]["v"]
+    assert [t["_task_id"] for t in reissued] == [0, 1]
+    assert [t["seed"] for t in reissued] == [1, 2]
+    # B completes task 0 — accepted, id closed, _task_id stripped
+    server._handle(conn_b, {"kind": "result_batch", "v": [
+        {"worker_id": 5, "upload_epoch": 7, "episode_seq": 0, "_task_id": 0},
+    ]})
+    assert server.total_results == 1
+    assert "_task_id" not in server.results.get_nowait()
+    # the corpse's completion of the SAME task surfaces late — dropped
+    server._handle(conn_a, {"kind": "result_batch", "v": [
+        {"worker_id": 9, "upload_epoch": 8, "episode_seq": 0, "_task_id": 0},
+    ]})
+    assert server.total_results == 1 and server.duplicate_tasks == 1
+    # a drain's task_return requeues without touching completed ids
+    server._handle(conn_b, {"kind": "task_return", "v": [reissued[1]]})
+    assert server.requeued_tasks == 3
+    server._handle(conn_b, {"kind": "task_batch", "n": 1})
+    assert sent[-1][1]["v"][0]["_task_id"] == 1
+    server.stop()
+
+
+def test_worker_errors_bounded_with_total_counter():
+    """The error funnel is bounded (a long elastic run churns gathers
+    forever and nobody is required to poll), while the count and the
+    FlightRecorder events keep the full history."""
+    from scalerl_tpu.runtime import telemetry as _telemetry
+
+    server = WorkerServer(
+        FleetConfig(num_workers=1), lambda: None, worker_error_maxsize=8
+    )
+    for i in range(20):
+        server.report_worker_error({"worker_id": i, "error": f"boom-{i}"})
+    assert server.worker_errors.qsize() == 8
+    assert server.worker_errors_total == 20
+    assert server.worker_errors_dropped == 12
+    # the NEWEST errors are retained (stalest evicted)
+    drained = []
+    while not server.worker_errors.empty():
+        drained.append(server.worker_errors.get_nowait())
+    assert [e["worker_id"] for e in drained] == list(range(12, 20))
+    events = _telemetry.get_recorder().events("worker_error")
+    assert any(e.get("error") == "boom-19" for e in events)
+    server.stop()
+
+
+def test_gather_hello_roster_and_targeted_drain():
+    """Membership roster: hellos register worker ranges, drain_workers
+    targets the newest non-draining gathers, drain_done retires them."""
+    from scalerl_tpu.runtime.supervisor import DRAIN
+
+    server = WorkerServer(FleetConfig(num_workers=4), lambda: None)
+    sent = []
+    server.hub.send = lambda c, m, compress=False: sent.append((c, m))  # type: ignore
+    c1, c2 = object(), object()
+    server._handle(c1, {"kind": "gather_hello", "base_worker_id": 0,
+                        "num_workers": 2, "gather_epoch": 11})
+    server._handle(c2, {"kind": "gather_hello", "base_worker_id": 2,
+                        "num_workers": 2, "gather_epoch": 22})
+    assert server.live_gather_count() == 2
+    assert server.live_worker_count() == 4
+    assert server.gathers_joined == 2
+    covered = server.drain_workers(2)
+    assert covered == 2
+    drains = [(c, m) for c, m in sent if m.get("kind") == DRAIN]
+    assert len(drains) == 1 and drains[0][0] is c2  # newest joined first
+    assert server.live_worker_count() == 2  # draining capacity not counted
+    server._handle(c2, {"kind": "drain_done", "base_worker_id": 2})
+    assert server.live_gather_count() == 1
+    assert server.gathers_drained == 1
+    server.stop()
+
+
 # ---------------------------------------------------------------------------
 # transport
 

@@ -144,6 +144,53 @@ def _telemetry_marker(telem_dir: str, bl) -> str:
         return ""
 
 
+def _elastic_marker(bl, start_offset: int, flap_per_min: float = 10.0) -> str:
+    """Gate the elastic-soak step on its JSON verdict line.
+
+    ``tools/elastic_soak.py`` prints one ``{"metric": "elastic_soak", ...}``
+    line: lost episodes, consumer-visible duplicates, and the autoscaler's
+    decisions/min.  Lost/duplicated episodes or a flapping fleet
+    (> ``flap_per_min`` scale actions/min) mark the outcome
+    ``!elastic(...)`` — the step absorbed a preemption wave *wrong* even if
+    its exit code said otherwise.  A clean wave marks ``+elastic``.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        verdict = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "elastic_soak":
+                verdict = obj
+        if not verdict:
+            return ""
+        lost = int(verdict.get("lost", 0))
+        dups = int(verdict.get("duplicates", 0))
+        flap = float(verdict.get("decisions_per_min", 0.0))
+        bad = []
+        if lost > 0:
+            bad.append(f"lost={lost}")
+        if dups > 0:
+            bad.append(f"dup={dups}")
+        if flap > flap_per_min:
+            bad.append(f"flap={flap}/min")
+        if bad:
+            bl.write(f"[watcher] ELASTIC GATE: {','.join(bad)} — flagging\n")
+            return "!elastic(" + ",".join(bad) + ")"
+        return "+elastic"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] elastic gate failed: {e}\n")
+        return ""
+
+
 def perf_gate_verdict(
     new_value: float, prior_values, threshold: float = 0.2
 ):
@@ -287,6 +334,15 @@ def run_payload(n_devices: int = 1) -> None:
          [sys.executable, "-m", "pytest", "tests/test_chaos.py", "-q",
           "-m", "chaos"],
          900, dict(env, JAX_PLATFORMS="cpu")),
+        # elastic soak third: a seeded mass_kill preemption wave against a
+        # live pipe fleet with the autoscaler backfilling
+        # (tools/elastic_soak.py).  jax-free and bounded; like lint and the
+        # chaos soak it records elasticity regressions even tunnel-down and
+        # does not count toward the witness quorum.  The verdict JSON is
+        # gated by _elastic_marker: lost/duplicated episodes or a flapping
+        # fleet mark the outcome !elastic(...)
+        ("elastic-soak", [sys.executable, "tools/elastic_soak.py"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
         # --fast first: banks a BENCH_TPU.md artifact within ~60 s of
         # contact, before the long steps gamble on the tunnel staying up
         ("bench-fast", [sys.executable, "bench.py", "--fast"], 450, fast_env),
@@ -356,6 +412,8 @@ def run_payload(n_devices: int = 1) -> None:
                     gate = _perf_gate_marker(bl, step_start)
                     if gate:
                         status = "FAILED" + gate
+                if name == "elastic-soak":
+                    status += _elastic_marker(bl, step_start)
                 outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
@@ -368,10 +426,11 @@ def run_payload(n_devices: int = 1) -> None:
     if not any(
         status.startswith("ok")
         for name, status in outcomes
-        if name not in ("lint", "chaos-soak")
+        if name not in ("lint", "chaos-soak", "elastic-soak")
     ):
-        # nothing TPU-witnessed succeeded (lint and the chaos soak are
-        # CPU-only and pass tunnel-down, so they do not count): there is no artifact to
+        # nothing TPU-witnessed succeeded (lint, the chaos soak, and the
+        # elastic soak are CPU-only and pass tunnel-down, so they do not
+        # count): there is no artifact to
         # record — a commit here would just stamp noise over the probe log
         log_probe("[watcher] no payload step succeeded; skipping witness commit")
         return
