@@ -541,6 +541,134 @@ def _run_serving_measurement() -> None:
     print(json.dumps(result))
 
 
+def _run_genrl_measurement() -> None:
+    """``--mode genrl``: the token-level sequence-RL plane's headline
+    numbers — prefill tokens/s/chip and decode tokens/s/chip through the
+    KV-cached generation engine, plus token-PPO learn steps/s.
+
+    Three timed phases over the same model/params, all shape-stable:
+
+    1. **prefill** — the jitted prefill-only program (one full-prompt
+       forward filling the KV cache) driven through a 2-deep
+       MetricsPipeline, ONE batched metric read per call;
+    2. **decode** — whole generation rounds through
+       ``GenerationEngine.generate`` (prefill + the fused decode loop in
+       one dispatch, one batched read per round — the steady-state guard
+       armed after the first round); decode tokens/s counts response
+       tokens only, against the full round wall-clock, so the number is
+       an honest end-to-end generation rate, not a prefill-subtracted
+       estimate;
+    3. **learn** — token-PPO steps on a packed batch, pipelined like the
+       other learn benches.
+    """
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.agents.token_ppo import TokenPPOAgent
+    from scalerl_tpu.config import GenRLArguments
+    from scalerl_tpu.genrl.rollout import pack_sequences
+    from scalerl_tpu.genrl.task import TokenRecallTask
+    from scalerl_tpu.runtime.dispatch import MetricsPipeline
+    from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+
+    if on_accel:
+        V, d_model, n_layers, n_heads = 1024, 256, 4, 8
+        P, R, B = 128, 128, 64
+        target_s = 10.0
+    else:
+        V, d_model, n_layers, n_heads = 32, 32, 1, 4
+        P, R, B = 8, 4, 4
+        target_s = 1.5
+
+    args = GenRLArguments(
+        vocab_size=V, prompt_len=P, max_new_tokens=R,
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+        genrl_batch=B, genrl_sample_batch=B,
+        genrl_buffer_sequences=2 * B,
+        telemetry_interval_s=0.0, logger_backend="none",
+    )
+    task = TokenRecallTask(vocab_size=V, prompt_len=P, response_len=R)
+    trainer = SequenceRLTrainer(args, task=task)
+    engine, agent = trainer.engine, trainer.agent
+    rng = np.random.default_rng(0)
+    prompts, lengths = task.sample_prompts(B, rng)
+
+    # phase 1: prefill-only tokens/s (pipelined, one batched read/call)
+    pre = engine.prefill_program(P, R)
+    aligned = engine._align_prompts(prompts, lengths, P)
+    dev_tokens, dev_lengths = jax.device_put((aligned, lengths))
+    params, _gen = engine._snapshot_params()
+    logits0, value0, _cache = pre(params, dev_tokens, dev_lengths)
+    float(value0[0])  # compile + host-fetch sync (tunnel-safe warmup)
+    pipe = MetricsPipeline(depth=2)
+    t0 = time.perf_counter()
+    pre_calls = 0
+    while time.perf_counter() - t0 < target_s / 2 or pre_calls < 2:
+        logits0, value0, _cache = pre(params, dev_tokens, dev_lengths)
+        pre_calls += 1
+        pipe.push(pre_calls, value0[0])
+    pipe.drain()
+    pre_elapsed = time.perf_counter() - t0
+    prefill_tps = pre_calls * B * P / pre_elapsed
+
+    # phase 2: whole generation rounds (the engine's own one-read round)
+    engine.generate(prompts, lengths)  # warm: compile the fused program
+    t0 = time.perf_counter()
+    rounds = 0
+    decode_tokens = 0
+    while time.perf_counter() - t0 < target_s or rounds < 2:
+        result = engine.generate(prompts, lengths)
+        rounds += 1
+        decode_tokens += result.decode_tokens
+    gen_elapsed = time.perf_counter() - t0
+    decode_tps = decode_tokens / gen_elapsed
+
+    # phase 3: token-PPO learn steps/s (pipelined batched metric reads)
+    rewards = task.score(
+        prompts, lengths, result.response_tokens, result.response_len
+    )
+    fields, _prio = pack_sequences(result, rewards)
+    batch = jax.device_put(fields)
+    m = agent.learn_device(batch)
+    float(jax.device_get(m["total_loss"]))  # warmup sync
+    pipe = MetricsPipeline(depth=2)
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < target_s / 2 or steps < 2:
+        m = agent.learn_device(batch)
+        steps += 1
+        pipe.push(steps, m)
+    pipe.drain()
+    learn_elapsed = time.perf_counter() - t0
+
+    result_obj = {
+        "metric": "genrl_decode_tokens_per_sec_per_chip",
+        "mode": "genrl",
+        "value": round(decode_tps, 1),
+        "unit": f"decode tokens/sec/chip ({platform})",
+        "prefill_tokens_per_sec": round(prefill_tps, 1),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "learn_steps_per_sec": round(steps / learn_elapsed, 2),
+        "rounds_per_sec": round(rounds / gen_elapsed, 2),
+        "vocab": V,
+        "d_model": d_model,
+        "num_layers": n_layers,
+        "prompt_bucket": P,
+        "response_bucket": R,
+        "batch": B,
+        "iter_mode": engine.iter_mode,
+        "device_kind": device_kind,
+        "measured_s": round(pre_elapsed + gen_elapsed + learn_elapsed, 1),
+    }
+    print(json.dumps(result_obj))
+
+
 def _mesh_axis(mesh_spec: str, axis: str) -> int:
     import re as _re
 
@@ -590,6 +718,11 @@ def _run_measurement(
     if mode == "serving":
         # the centralized inference plane: requests/sec + latency SLO
         _run_serving_measurement()
+        return
+    if mode == "genrl":
+        # the token-level sequence-RL plane: prefill/decode tokens/s +
+        # token-PPO learn steps/s through the KV-cached engine
+        _run_genrl_measurement()
         return
 
     # backend already pinned by __main__ when --cpu; "auto" here just turns
@@ -1000,6 +1133,7 @@ def main(
         "impala_learn_step_frames_per_sec" if learn
         else "sharded_train_step_frames_per_sec" if mode == "sharded"
         else "serving_requests_per_sec" if mode == "serving"
+        else "genrl_decode_tokens_per_sec_per_chip" if mode == "genrl"
         else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
         else "impala_atari_env_frames_per_sec_per_chip"
     )
@@ -1225,10 +1359,10 @@ if __name__ == "__main__":
             if _mi + 1 >= len(sys.argv):
                 raise SystemExit("--mode requires an argument (anakin | sharded)")
             _mode = sys.argv[_mi + 1]
-            if _mode not in ("anakin", "sharded", "serving"):
+            if _mode not in ("anakin", "sharded", "serving", "genrl"):
                 raise SystemExit(
                     f"unknown --mode {_mode!r}; supported: anakin, sharded, "
-                    "serving"
+                    "serving, genrl"
                 )
         try:
             main(
@@ -1248,6 +1382,8 @@ if __name__ == "__main__":
                             if _mode == "sharded"
                             else "serving_requests_per_sec"
                             if _mode == "serving"
+                            else "genrl_decode_tokens_per_sec_per_chip"
+                            if _mode == "genrl"
                             else "impala_atari_env_frames_per_sec_aggregate"
                             if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"

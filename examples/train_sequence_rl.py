@@ -1,0 +1,49 @@
+"""Sequence-RL training entry point: token-PPO on the generation engine.
+
+The token-level generate -> score -> learn plane (docs/SEQUENCE_RL.md):
+the KV-cached GenerationEngine decodes whole response batches in one
+jitted program per bucket pair, the hermetic recall/copy verifier scores
+them on the host, and the token-PPO learner trains off the prioritized
+sequence replay with per-token importance ratios.  The dp×mp mesh
+resolves from the args alone, exactly like the other trainer families.
+
+Usage (CPU smoke run)::
+
+    python examples/train_sequence_rl.py --genrl-rounds 100 \
+        --vocab-size 8 --prompt-len 4 --max-new-tokens 4
+
+Sharded learner (8 virtual devices, dp=4 × mp=2)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/train_sequence_rl.py --dp-size 4 --mp-size 2 \
+        --d-model 256 --n-layers 4 --genrl-rounds 200
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.config import GenRLArguments, parse_args
+
+
+def main() -> None:
+    args = parse_args(GenRLArguments)
+    from scalerl_tpu.utils.platform import setup_platform
+
+    print("backend:", setup_platform(args.platform))
+
+    from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer
+
+    trainer = SequenceRLTrainer(args)
+    result = trainer.train(args.genrl_rounds)
+    print("final:", {k: round(float(v), 4) for k, v in result.items()})
+    if args.save_model and not args.disable_checkpoint:
+        path = trainer.agent.save_checkpoint(
+            os.path.join(args.work_dir, "genrl_ckpt_final")
+        )
+        print("checkpoint:", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,7 @@ from scalerl_tpu.config import (  # noqa: F401
     A3CArguments,
     ApexArguments,
     DQNArguments,
+    GenRLArguments,
     ImpalaArguments,
     PPOArguments,
     RLArguments,

@@ -6,3 +6,7 @@ from scalerl_tpu.agents.ppo import PPOAgent, PPOTrainState  # noqa: F401
 from scalerl_tpu.agents.r2d2 import R2D2Agent, R2D2TrainState  # noqa: F401
 from scalerl_tpu.agents.sac import SACAgent, SACTrainState  # noqa: F401
 from scalerl_tpu.agents.td3 import TD3Agent, TD3TrainState  # noqa: F401
+from scalerl_tpu.agents.token_ppo import (  # noqa: F401
+    TokenPPOAgent,
+    TokenPPOTrainState,
+)

@@ -1,0 +1,324 @@
+"""Token-level PPO learner for the sequence-RL plane.
+
+The learning half of the MindSpeed-RL-shaped dataflow (``genrl/``): a
+PPO-clip update over *generated token sequences* where every response
+token is one action —
+
+- **per-token importance ratios** against the STORED behavior logprobs
+  (the sampling distribution the generation engine actually drew from),
+  so replayed / stale sequences are corrected exactly like IMPALA corrects
+  actor lag;
+- **KL-to-reference penalty**: a frozen reference copy of the initial
+  params rides the train state, and ``kl_cost > 0`` adds the forward KL
+  from the current policy to it per token (the RLHF anchor keeping the
+  policy from collapsing onto the reward);
+- **length-masked losses over padded buckets**: sequences live in static
+  (prompt bucket + response bucket) shapes; every loss/metric term is
+  masked by the real-token mask and normalized by real token count, so
+  bucket padding is numerically invisible;
+- the whole update is ONE pure jitted ``(state, batch) -> (state,
+  metrics)`` function riding the existing machinery: the nonfinite guard
+  (``maybe_guard_nonfinite``), the dp×mp sharded learn step
+  (``enable_mesh`` -> ``make_parallel_learn_fn`` with the logical mp rule
+  table), and the one-batched-transfer metric discipline
+  (``learn_device`` + ``get_metrics``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from scalerl_tpu.models.transformer import (
+    TransformerPolicy,
+    sequence_attention_mask,
+    sequence_positions,
+)
+from scalerl_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+@struct.dataclass
+class TokenPPOTrainState:
+    params: Any
+    ref_params: Any  # frozen KL anchor (identity through every update)
+    opt_state: Any
+    step: jnp.ndarray  # learner updates
+    tokens_seen: jnp.ndarray  # real (unmasked) response tokens consumed
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean of ``x`` over positions where ``mask`` is 1 (safe on empty)."""
+    return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def token_ppo_loss(
+    params,
+    ref_params,
+    model: TransformerPolicy,
+    batch: Dict[str, jnp.ndarray],
+    clip_range: float,
+    value_cost: float,
+    entropy_cost: float,
+    kl_cost: float,
+    adv_norm: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """PPO-clip over one ``[B, S]`` packed-sequence batch.
+
+    ``batch`` carries the ``genrl/rollout.py`` fields: ``tokens [B, S]``
+    (left-padded prompt + response), ``behavior_logp``/``value``/``mask``
+    ``[B, R]``, ``reward``/``prompt_len``/``generation`` ``[B]``, plus an
+    optional ``is_weight [B]`` (PER importance weights).  The prompt pad
+    ``P = S - R`` is static by shape, so one compile covers every batch at
+    the same bucket pair.
+    """
+    tokens = batch["tokens"]
+    behavior_logp = batch["behavior_logp"]
+    behavior_value = batch["value"]
+    mask = batch["mask"]
+    reward = batch["reward"]
+    prompt_len = batch["prompt_len"]
+    B, S = tokens.shape
+    R = behavior_logp.shape[1]
+    P = S - R
+    seq_w = batch.get("is_weight")
+    w_mask = mask if seq_w is None else mask * seq_w[:, None]
+
+    positions = sequence_positions(prompt_len, P, S)
+    attn_mask = sequence_attention_mask(prompt_len, P, S)
+    out = model.apply(
+        params, tokens, positions=positions, attn_mask=attn_mask
+    )
+    # token at absolute position p is predicted by the output at p-1:
+    # response tokens occupy [P, S) -> predicting slice [P-1, S-1)
+    pred_logits = out.policy_logits[:, P - 1:S - 1]  # [B, R, V]
+    values = out.baseline[:, P - 1:S - 1]  # [B, R]
+    resp_tokens = tokens[:, P:S]
+    logp_all = jax.nn.log_softmax(pred_logits, axis=-1)
+    new_logp = jnp.take_along_axis(
+        logp_all, resp_tokens[..., None], axis=-1
+    )[..., 0]
+
+    # terminal sequence-level reward, undiscounted credit to every real
+    # token; baseline = the sampling-time value estimate
+    adv = reward[:, None] - behavior_value
+    if adv_norm:
+        mu = masked_mean(adv, mask)
+        var = masked_mean(jnp.square(adv - mu), mask)
+        adv = (adv - mu) * jax.lax.rsqrt(var + 1e-8)
+    adv = jax.lax.stop_gradient(adv * mask)
+
+    log_ratio = new_logp - jax.lax.stop_gradient(behavior_logp)
+    ratio = jnp.exp(log_ratio)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_range, 1.0 + clip_range) * adv
+    pg_loss = -masked_mean(jnp.minimum(unclipped, clipped), w_mask)
+
+    value_loss = value_cost * 0.5 * masked_mean(
+        jnp.square(values - reward[:, None]), w_mask
+    )
+    # entropy bonus (negative entropy minimised, the ops/losses convention)
+    ent = jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+    entropy_term = entropy_cost * masked_mean(ent, w_mask)
+
+    total = pg_loss + value_loss + entropy_term
+    metrics = {
+        "pg_loss": pg_loss,
+        "value_loss": value_loss,
+        "entropy": -masked_mean(ent, mask),
+        "mean_ratio": masked_mean(ratio, mask),
+        "mean_approx_kl": masked_mean((ratio - 1.0) - log_ratio, mask),
+        "mean_clip_frac": masked_mean(
+            (jnp.abs(ratio - 1.0) > clip_range).astype(jnp.float32), mask
+        ),
+        "mean_reward": jnp.mean(reward),
+        "mean_value": masked_mean(values, mask),
+        "mean_generation": jnp.mean(batch["generation"].astype(jnp.float32)),
+        "mean_response_len": jnp.mean(jnp.sum(mask, axis=1)),
+    }
+    if kl_cost > 0.0:
+        ref_out = model.apply(
+            ref_params, tokens, positions=positions, attn_mask=attn_mask
+        )
+        ref_logp = jax.lax.stop_gradient(
+            jax.nn.log_softmax(ref_out.policy_logits[:, P - 1:S - 1], axis=-1)
+        )
+        # forward KL(pi || pi_ref), per token, over the full vocab
+        kl = jnp.sum(jnp.exp(logp_all) * (logp_all - ref_logp), axis=-1)
+        kl_term = kl_cost * masked_mean(kl, w_mask)
+        total = total + kl_term
+        metrics["kl_ref"] = masked_mean(kl, mask)
+    metrics["total_loss"] = total
+    metrics = {
+        k: v if k == "total_loss" else jax.lax.stop_gradient(v)
+        for k, v in metrics.items()
+    }
+    return total, metrics
+
+
+def make_token_ppo_learn_fn(
+    model: TransformerPolicy, optimizer: optax.GradientTransformation, args
+) -> Callable:
+    """Build the pure ``(state, batch) -> (state, metrics)`` update,
+    wrapped in the all-finite guard like every other learn-fn factory."""
+
+    def learn(state: TokenPPOTrainState, batch: Dict[str, jnp.ndarray]):
+        (loss, metrics), grads = jax.value_and_grad(
+            token_ppo_loss, has_aux=True
+        )(
+            state.params,
+            state.ref_params,
+            model,
+            batch,
+            clip_range=args.clip_range,
+            value_cost=args.value_cost,
+            entropy_cost=args.entropy_cost,
+            kl_cost=args.kl_cost,
+            adv_norm=args.adv_norm,
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        new_state = TokenPPOTrainState(
+            params=params,
+            ref_params=state.ref_params,
+            opt_state=opt_state,
+            step=state.step + 1,
+            tokens_seen=state.tokens_seen
+            + jnp.sum(batch["mask"]).astype(state.tokens_seen.dtype),
+        )
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return new_state, metrics
+
+    from scalerl_tpu.parallel.train_step import maybe_guard_nonfinite
+
+    return maybe_guard_nonfinite(learn, args)
+
+
+class TokenPPOAgent:
+    """Host-facing token-PPO agent: jitted learn + weight pub + mesh hookup.
+
+    Not a :class:`PolicyValueAgent` — the acting path is the generation
+    engine, not the recurrent per-step signature — but it speaks the same
+    learner dialect: ``learn_device`` leaves metrics on device,
+    ``learn`` reads them back with ONE batched transfer, ``enable_mesh``
+    re-jits through ``make_parallel_learn_fn`` with the logical mp layout
+    (heads/mlp/vocab over ``mp``) when the mesh has model parallelism.
+    """
+
+    def __init__(
+        self,
+        args,
+        model: TransformerPolicy,
+        key: Optional[jax.Array] = None,
+    ) -> None:
+        if model.vocab_size is None:
+            raise ValueError(
+                "TokenPPOAgent needs a token-mode TransformerPolicy "
+                "(vocab_size set)"
+            )
+        self.args = args
+        self.model = model
+        key = key if key is not None else jax.random.PRNGKey(args.seed)
+        dummy = jnp.zeros((1, min(2, model.max_len)), jnp.int32)
+        params = model.init(key, dummy)
+        self.optimizer = self._make_optimizer(args)
+        from scalerl_tpu.runtime.param_server import _tree_map, jnp_copy
+
+        self.state = TokenPPOTrainState(
+            params=params,
+            ref_params=_tree_map(jnp_copy, params),
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+            tokens_seen=jnp.zeros((), jnp.int32),
+        )
+        self._learn_fn = make_token_ppo_learn_fn(model, self.optimizer, args)
+        self._learn = jax.jit(self._learn_fn)
+        self._shard_batch = None
+        self.mesh = None
+
+    @staticmethod
+    def _make_optimizer(args) -> optax.GradientTransformation:
+        tx = optax.chain(
+            optax.clip_by_global_norm(args.max_grad_norm),
+            optax.adam(args.learning_rate),
+        )
+        if getattr(args, "bf16_params", False):
+            from scalerl_tpu.parallel.train_step import fp32_optimizer_state
+
+            tx = fp32_optimizer_state(tx)
+        return tx
+
+    def make_learn_fn(self) -> Callable:
+        """Learn fn from this agent's model/optimizer/args (the
+        ``enable_mesh`` rebuild contract, ``agents/impala.py``)."""
+        return make_token_ppo_learn_fn(self.model, self.optimizer, self.args)
+
+    def enable_mesh(self, mesh_or_spec, batch_example=None) -> None:
+        """Shard the learn step over a device mesh; with ``mp > 1`` the
+        transformer's heads/mlp/vocab dims lay out per the logical rule
+        table and inter-layer activations pin batch-over-dp."""
+        from scalerl_tpu.parallel import (
+            activation_constraint,
+            has_mp_params,
+            make_parallel_learn_fn,
+            mp_param_sharding,
+            resolve_mesh,
+        )
+
+        mesh = resolve_mesh(mesh_or_spec)
+        param_specs = None
+        if mesh.shape.get("mp", 1) > 1:
+            if not has_mp_params(self.state.params):
+                raise ValueError(
+                    "mesh has mp > 1 but the model carries no "
+                    "model-parallel shardable params"
+                )
+            if self.model.constrain is None:
+                self.model = self.model.clone(
+                    constrain=activation_constraint(mesh)
+                )
+                self._learn_fn = self.make_learn_fn()
+            param_specs = mp_param_sharding(self.state, mesh)
+        plearn = make_parallel_learn_fn(
+            self._learn_fn, mesh, self.state,
+            batch_example=batch_example,
+            batch_time_major=False,  # packed batches are [B, ...]
+            param_specs=param_specs,
+        )
+        self.mesh = mesh
+        self.state = plearn.shard_state(self.state)
+        self._learn = plearn
+        self._shard_batch = plearn.shard_batch
+
+    def learn_device(self, batch) -> Dict[str, Any]:
+        """One train step, metrics left as device arrays (the hot-loop
+        half of the one-batched-transfer discipline)."""
+        if self._shard_batch is not None:
+            batch = self._shard_batch(batch)
+        self.state, metrics = self._learn(self.state, batch)  # graftlint: disable=JG002 (single-threaded learner loop; genrl has no actor threads)
+        return metrics
+
+    def learn(self, batch) -> Dict[str, float]:
+        from scalerl_tpu.runtime.dispatch import get_metrics
+
+        return get_metrics(self.learn_device(batch))  # one batched transfer
+
+    def get_weights(self):
+        return self.state.params
+
+    def set_weights(self, weights) -> None:
+        self.state = self.state.replace(params=weights)
+
+    def save_checkpoint(self, path: str) -> str:
+        return save_checkpoint(path, self.state)
+
+    def load_checkpoint(self, path: str) -> None:
+        restored = load_checkpoint(path, self.state)
+        if self._shard_batch is not None and hasattr(self._learn, "shard_state"):
+            restored = self._learn.shard_state(restored)
+        self.state = restored

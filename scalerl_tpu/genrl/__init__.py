@@ -1,0 +1,21 @@
+"""Token-level sequence-RL plane: generate -> score -> learn.
+
+The scenario-diversity tier ROADMAP names after MindSpeed RL's distributed
+dataflow (arxiv 2507.19017): autoregressive generation from the transformer
+policy (KV-cached, bucketed static shapes, one jitted decode loop),
+sequence packing into the prioritized sequence replay, and a token-level
+PPO learner with per-token importance ratios against the stored behavior
+logprobs.  ``genrl`` is a graftlint HOT package: the decode loop performs
+exactly ONE batched host read per generation round.
+"""
+
+from scalerl_tpu.genrl.engine import (  # noqa: F401
+    GenerationConfig,
+    GenerationEngine,
+    GenerationResult,
+)
+from scalerl_tpu.genrl.rollout import (  # noqa: F401
+    pack_sequences,
+    sequence_field_shapes,
+)
+from scalerl_tpu.genrl.task import TokenRecallTask  # noqa: F401

@@ -1,0 +1,83 @@
+"""Pack generation rounds into prioritized sequence-replay chunks.
+
+The bridge between the generation engine (host numpy
+:class:`~scalerl_tpu.genrl.engine.GenerationResult`) and
+``data/sequence_replay.py``'s static-shape HBM buffer: every completed
+sequence becomes one replay unit carrying everything the token-PPO learner
+needs to recompute its loss off-policy —
+
+- ``tokens`` ``[S]``: the full left-padded sequence (prompt + response),
+  so the learner's forward recomputes logits over exactly the context the
+  engine decoded against;
+- ``behavior_logp`` / ``value`` / ``mask`` ``[R]``: the sampling-time
+  logprobs (importance-ratio denominators), baselines, and real-token
+  mask over the padded response bucket;
+- ``reward`` / ``prompt_len`` / ``generation`` scalars: the sequence-level
+  score, the left-pad offset, and the param generation that produced the
+  sequence (the staleness tag the learner reports).
+
+Priorities default to 1 (uniform proportional sampling); callers may pass
+explicit per-sequence priorities (e.g. |reward - mean value|) to focus
+replay on surprising sequences, the PER idea at sequence granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from scalerl_tpu.genrl.engine import GenerationResult
+
+
+def sequence_field_shapes(
+    prompt_pad: int, response_pad: int
+) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+    """``seq_init`` field table for one (prompt, response) bucket pair."""
+    import jax.numpy as jnp
+
+    S = prompt_pad + response_pad
+    R = response_pad
+    return {
+        "tokens": ((S,), jnp.int32),
+        "behavior_logp": ((R,), jnp.float32),
+        "value": ((R,), jnp.float32),
+        "mask": ((R,), jnp.float32),
+        "reward": ((), jnp.float32),
+        "prompt_len": ((), jnp.int32),
+        "generation": ((), jnp.int32),
+    }
+
+
+def pack_sequences(
+    result: GenerationResult,
+    rewards: np.ndarray,
+    priorities: Optional[np.ndarray] = None,
+) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+    """``(fields [B, ...], priorities [B])`` ready for ``seq_add``.
+
+    Host-side numpy only — the single host->device hop happens when
+    ``seq_add``'s jit consumes the batch, alongside the learner dispatch.
+    """
+    B = result.sequences.shape[0]
+    rewards = np.asarray(rewards, np.float32)
+    if rewards.shape != (B,):
+        raise ValueError(
+            f"rewards must be [B={B}], got shape {rewards.shape}"
+        )
+    fields = {
+        "tokens": result.sequences.astype(np.int32),
+        "behavior_logp": result.behavior_logp.astype(np.float32),
+        "value": result.values.astype(np.float32),
+        "mask": result.mask.astype(np.float32),
+        "reward": rewards,
+        "prompt_len": result.prompt_len.astype(np.int32),
+        "generation": np.full(B, result.generation, np.int32),
+    }
+    if priorities is None:
+        priorities = np.ones(B, np.float32)
+    else:
+        priorities = np.maximum(
+            np.asarray(priorities, np.float32), 1e-6
+        )
+    return fields, priorities

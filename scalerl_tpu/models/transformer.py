@@ -15,10 +15,12 @@ axis is sharded across the ``sp`` mesh axis — callers pass ``positions``
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from scalerl_tpu.ops.pallas_attention import flash_attention
 from scalerl_tpu.ops.ring_attention import full_attention
@@ -32,6 +34,119 @@ class TransformerOutput(NamedTuple):
     baseline: jnp.ndarray  # [B, T]
 
 
+class KVCache(NamedTuple):
+    """Static-shape per-layer key/value cache for incremental decoding.
+
+    ``k``/``v``: one ``[B, S, H, D]`` array per transformer block, where
+    ``S`` is the *total* (prompt bucket + response bucket) sequence length.
+    The cache is allocated once per bucket shape (:func:`init_kv_cache`),
+    written with ``lax.dynamic_update_slice`` at a scalar write cursor, and
+    carried through the jitted decode loop — so XLA compiles one program
+    per bucket and never retraces on ragged prompt lengths (the
+    ``serving/batcher.py`` bucket-ladder idea applied to the time axis).
+    """
+
+    k: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+def init_kv_cache(
+    batch: int,
+    total_len: int,
+    num_layers: int,
+    num_heads: int,
+    head_dim: int,
+    dtype=jnp.float32,
+) -> KVCache:
+    """Zeroed cache sized for ``total_len`` (prompt + response buckets)."""
+    shape = (batch, total_len, num_heads, head_dim)
+    return KVCache(
+        k=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+        v=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+    )
+
+
+def prefill_attention_mask(
+    lengths: jnp.ndarray, prompt_pad: int, total_len: int
+) -> jnp.ndarray:
+    """``[B, P, S]`` bool mask for the prefill pass over LEFT-padded prompts.
+
+    Prompts are right-aligned inside their ``prompt_pad`` bucket (lane
+    ``b``'s real tokens occupy columns ``[prompt_pad - lengths[b],
+    prompt_pad)``), so every lane's *last* prompt token sits at the same
+    static index and the decode steps share one scalar write cursor.  Row
+    ``r`` attends causally within the prompt, never into the pad prefix and
+    never into the (still empty) response region.  Fully-masked pad rows
+    are harmless: softmax degrades to uniform and their outputs are unused.
+    """
+    cols = jnp.arange(total_len)[None, None, :]
+    rows = jnp.arange(prompt_pad)[None, :, None]
+    pad = (prompt_pad - lengths)[:, None, None]
+    return (cols >= pad) & (cols <= rows)
+
+
+def decode_attention_mask(
+    lengths: jnp.ndarray, prompt_pad: int, step, total_len: int
+) -> jnp.ndarray:
+    """``[B, 1, S]`` mask for decode step ``step`` (0-indexed): attend to
+    the real prompt plus every response token written so far, including the
+    one just written at ``prompt_pad + step``."""
+    cols = jnp.arange(total_len)[None, None, :]
+    pad = (prompt_pad - lengths)[:, None, None]
+    return (cols >= pad) & (cols <= prompt_pad + step)
+
+
+def sequence_attention_mask(
+    lengths: jnp.ndarray, prompt_pad: int, total_len: int
+) -> jnp.ndarray:
+    """``[B, S, S]`` causal mask over a full left-padded sequence — the
+    learner-side twin of the prefill/decode masks, so the training forward
+    recomputes exactly the distribution the generation engine sampled
+    from (pad-prefix columns excluded)."""
+    cols = jnp.arange(total_len)[None, None, :]
+    rows = jnp.arange(total_len)[None, :, None]
+    pad = (prompt_pad - lengths)[:, None, None]
+    return (cols >= pad) & (cols <= rows)
+
+
+def sequence_positions(
+    lengths: jnp.ndarray, prompt_pad: int, total_len: int
+) -> jnp.ndarray:
+    """``[B, S]`` position ids for left-padded sequences: the first real
+    token of every lane gets position 0 (pad positions clamp to 0 — they
+    are masked out of attention and their outputs unused)."""
+    pad = (prompt_pad - lengths)[:, None]
+    return jnp.clip(jnp.arange(total_len)[None, :] - pad, 0, total_len - 1)
+
+
+def _masked_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+    out_dtype,
+) -> jnp.ndarray:
+    """Explicit masked attention: q ``[B, T, H, D]`` against k/v
+    ``[B, S, H, D]`` with a ``[B, T, S]`` validity mask (True = attend).
+
+    Scores/softmax run in float32 regardless of the compute dtype — the
+    decode path feeds sampling logits, where bf16 softmax drift would show
+    up directly in the behavior logprobs the learner's importance ratios
+    divide by.  Fully-masked rows degrade to a uniform distribution (finite
+    by construction) instead of NaN.
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(head_dim))
+    scores = (
+        jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(out_dtype)
+
+
 class _Block(nn.Module):
     d_model: int
     num_heads: int
@@ -41,7 +156,23 @@ class _Block(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        layer_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        cache_index=None,
+        attn_mask: Optional[jnp.ndarray] = None,
+    ):
+        """Full forward (``layer_cache=None``) or KV-cached incremental step.
+
+        With ``layer_cache=(k, v)`` the block writes this call's keys/values
+        at ``cache_index`` (a scalar — prompts are left-padded so every lane
+        shares one cursor) and attends ``x``'s ``T`` positions against the
+        whole cache under ``attn_mask`` ``[B, T, S]``; returns
+        ``(out, (new_k, new_v))``.  With a mask but no cache it runs
+        explicit masked attention against its own k/v (the learner-side
+        forward over left-padded sequences).  Same params on every path.
+        """
         B, T, _ = x.shape
         head_dim = self.d_model // self.num_heads
         dt = dict(dtype=self.dtype, param_dtype=self.param_dtype)
@@ -49,7 +180,24 @@ class _Block(nn.Module):
         qkv = nn.Dense(3 * self.d_model, use_bias=False, name="qkv", **dt)(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, T, self.num_heads, head_dim)
-        out = self.attn_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        new_cache = None
+        if layer_cache is not None:
+            ck, cv = layer_cache
+            idx = jnp.asarray(cache_index, jnp.int32)
+            zero = jnp.zeros((), jnp.int32)
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (zero, idx, zero, zero)
+            )
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (zero, idx, zero, zero)
+            )
+            out = _masked_attention(q, ck, cv, attn_mask, self.dtype)
+            new_cache = (ck, cv)
+        elif attn_mask is not None:
+            out = _masked_attention(q, k, v, attn_mask, self.dtype)
+        else:
+            out = self.attn_fn(q, k, v)
         out = nn.Dense(self.d_model, use_bias=False, name="proj", **dt)(
             out.reshape(B, T, self.d_model)
         )
@@ -58,7 +206,10 @@ class _Block(nn.Module):
         h = nn.Dense(self.mlp_ratio * self.d_model, name="mlp_in", **dt)(h)
         h = nn.gelu(h)
         h = nn.Dense(self.d_model, name="mlp_out", **dt)(h)
-        return x + h
+        x = x + h
+        if layer_cache is not None:
+            return x, new_cache
+        return x
 
 
 class TransformerPolicy(nn.Module):
@@ -84,6 +235,11 @@ class TransformerPolicy(nn.Module):
     max_len: int = 4096
     attn_fn: Optional[AttentionFn] = None
     use_flash: bool = False
+    # Token mode (the genrl sequence-RL plane): when set, ``obs`` is an
+    # int32 ``[B, T]`` token-id array embedded through a learned table
+    # instead of the Dense feature embed.  ``num_actions`` is then the
+    # vocabulary the policy head scores (typically == vocab_size).
+    vocab_size: Optional[int] = None
     # Mixed precision: blocks compute in ``dtype`` with params stored in
     # ``param_dtype`` (bf16/bf16 on the sharded learner plane); the heads
     # always emit float32 so the loss/V-trace math stays full precision.
@@ -98,8 +254,27 @@ class TransformerPolicy(nn.Module):
 
     @nn.compact
     def __call__(
-        self, obs: jnp.ndarray, positions: Optional[jnp.ndarray] = None
-    ) -> TransformerOutput:
+        self,
+        obs: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        kv_cache: Optional[KVCache] = None,
+        cache_index=None,
+        attn_mask: Optional[jnp.ndarray] = None,
+    ):
+        """Full forward, masked full forward, or KV-cached incremental step.
+
+        - ``kv_cache=None, attn_mask=None``: the original whole-trajectory
+          forward (causal ``attn_fn``) returning :class:`TransformerOutput`.
+        - ``kv_cache=None, attn_mask=[B, T, T]``: full forward under an
+          explicit mask (:func:`sequence_attention_mask`) — the learner
+          pass over left-padded generated sequences.
+        - ``kv_cache=KVCache, cache_index=i, attn_mask=[B, T, S]``: write
+          this call's k/v at ``i`` and attend against the cache — prefill
+          (``T = prompt bucket``, ``i = 0``) and single-token decode
+          (``T = 1``, ``i = prompt_pad + step``) both go through here,
+          sharing every parameter with the training forward.  Returns
+          ``(TransformerOutput, new_cache)``.
+        """
         B, T = obs.shape[:2]
         if T > self.max_len:
             # out-of-range gathers clamp silently under jit, which would
@@ -114,10 +289,16 @@ class TransformerPolicy(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         c = self.constrain if self.constrain is not None else (lambda x: x)
-        x = nn.Dense(
-            self.d_model, name="obs_embed",
-            dtype=self.dtype, param_dtype=self.param_dtype,
-        )(obs.reshape(B, T, -1).astype(self.dtype))
+        if self.vocab_size is not None:
+            x = nn.Embed(
+                self.vocab_size, self.d_model, name="token_embed",
+                dtype=self.dtype, param_dtype=self.param_dtype,
+            )(obs.astype(jnp.int32))
+        else:
+            x = nn.Dense(
+                self.d_model, name="obs_embed",
+                dtype=self.dtype, param_dtype=self.param_dtype,
+            )(obs.reshape(B, T, -1).astype(self.dtype))
         pos_tab = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
@@ -125,21 +306,36 @@ class TransformerPolicy(nn.Module):
             self.param_dtype,
         )
         x = c(x + pos_tab[positions].astype(self.dtype))
+        new_k = []
+        new_v = []
         for i in range(self.num_layers):
-            x = c(
-                _Block(
-                    self.d_model,
-                    self.num_heads,
-                    self.mlp_ratio,
-                    attn,
-                    dtype=self.dtype,
-                    param_dtype=self.param_dtype,
-                    name=f"block_{i}",
-                )(x)
+            block = _Block(
+                self.d_model,
+                self.num_heads,
+                self.mlp_ratio,
+                attn,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name=f"block_{i}",
             )
+            if kv_cache is not None:
+                x, (bk, bv) = block(
+                    x,
+                    layer_cache=(kv_cache.k[i], kv_cache.v[i]),
+                    cache_index=cache_index,
+                    attn_mask=attn_mask,
+                )
+                new_k.append(bk)
+                new_v.append(bv)
+            else:
+                x = block(x, attn_mask=attn_mask)
+            x = c(x)
         x = nn.LayerNorm(use_bias=False, name="final_norm", dtype=jnp.float32)(
             x.astype(jnp.float32)
         )
         policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
         baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
-        return TransformerOutput(policy_logits, baseline)
+        out = TransformerOutput(policy_logits, baseline)
+        if kv_cache is not None:
+            return out, KVCache(k=tuple(new_k), v=tuple(new_v))
+        return out

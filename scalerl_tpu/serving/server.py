@@ -106,6 +106,26 @@ def _make_serve_fn(model) -> Callable:
     return serve
 
 
+def _live_param_shardings(agent) -> Any:
+    """The learner's per-leaf param ``NamedSharding`` pytree, when the
+    agent trains on a mesh with model parallelism (``mp > 1``).
+
+    ``PolicyValueAgent.enable_mesh`` hangs the full train-state sharding
+    off the parallel learn fn (``make_parallel_learn_fn``'s
+    ``.state_sharding``); the params subtree of that layout is exactly how
+    the serve fn should consume pushed snapshots.  Pure-dp meshes return
+    None — batch sharding doesn't apply to inference-side params, and the
+    unsharded serve path stays byte-identical to the pre-mesh behavior.
+    """
+    mesh = getattr(agent, "mesh", None)
+    if mesh is None or mesh.shape.get("mp", 1) <= 1:
+        return None
+    state_sharding = getattr(
+        getattr(agent, "_learn", None), "state_sharding", None
+    )
+    return getattr(state_sharding, "params", None)
+
+
 def _pad_lanes(arr: np.ndarray, bucket: int) -> np.ndarray:
     """Zero-pad a [B, ...] host array up to [bucket, ...]."""
     n = arr.shape[0]
@@ -132,13 +152,26 @@ class InferenceServer:
         config: Optional[ServingConfig] = None,
         dispatch_guard: Optional[Callable[[], Any]] = None,
         hub_maxsize: int = 1024,
+        param_shardings: Any = None,
     ) -> None:
         self.config = config or ServingConfig()
         self._model = agent.model
         self._serve = jax.jit(_make_serve_fn(agent.model))
         self._dispatch_guard = dispatch_guard or nullcontext
         self._param_lock = threading.Lock()
-        self._params = _tree_map(jnp_copy, agent.get_weights())
+        # mp-sharded learners serve from their LIVE mesh layout: every
+        # pushed snapshot is re-placed into the learner's per-leaf
+        # NamedShardings, so the jitted serve fn compiles ONE sharded
+        # program (GSPMD splits the heads/mlp/vocab matmuls over mp)
+        # instead of gathering the policy onto one chip.  mp=1 keeps the
+        # unsharded path: param_shardings stays None and snapshots serve
+        # wherever the copy landed (ROADMAP serving-headroom item).
+        self._param_shardings = (
+            param_shardings
+            if param_shardings is not None
+            else _live_param_shardings(agent)
+        )
+        self._params = self._place(_tree_map(jnp_copy, agent.get_weights()))
         self.generation = 0
         # generation -> learner step at push time (bounded map so a long
         # run never grows it; staleness older than the window reports the
@@ -176,14 +209,25 @@ class InferenceServer:
         self._threads: List[threading.Thread] = []
         self._listen_sock = None
 
+    def _place(self, snapshot):
+        """Re-place a snapshot into the learner's live NamedShardings (a
+        device->device reshard at worst, never a host transfer); identity
+        on the mp=1 unsharded path."""
+        if self._param_shardings is None:
+            return snapshot
+        return jax.device_put(snapshot, self._param_shardings)
+
     # -- parameter plane ------------------------------------------------
     def push_params(self, weights, learner_step: Optional[int] = None) -> int:
         """Publish fresh params: device-side snapshot copy + monotonic
         generation bump (no host transfer — the copy detaches the snapshot
-        from the learner's donated buffers, ``param_server.jnp_copy``).
+        from the learner's donated buffers, ``param_server.jnp_copy``),
+        re-placed into the learner's live mesh layout when one exists (so
+        the serve fn never recompiles against a stray placement and never
+        serves an unsharded gather of an mp-sharded policy).
         Callers with a live mesh wrap this in their dispatch guard.
         Returns the new generation."""
-        snapshot = _tree_map(jnp_copy, weights)
+        snapshot = self._place(_tree_map(jnp_copy, weights))
         with self._param_lock:
             self.generation += 1
             gen = self.generation

@@ -8,3 +8,4 @@ from scalerl_tpu.trainer.process_actor_learner import (  # noqa: F401
 )
 from scalerl_tpu.trainer.r2d2 import R2D2Trainer  # noqa: F401
 from scalerl_tpu.trainer.r2d2_device import DeviceR2D2Trainer  # noqa: F401
+from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer  # noqa: F401

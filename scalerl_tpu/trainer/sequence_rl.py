@@ -1,0 +1,209 @@
+"""Sequence-RL trainer: the generate -> score -> learn round loop.
+
+The orchestration glue of the ``genrl/`` plane (MindSpeed RL's dataflow at
+single-host scale, Podracer's fused-program discipline inside each stage):
+
+1. **generate** — the KV-cached engine runs one jitted round (prefill +
+   whole decode loop) and returns host numpy with ONE batched read, under
+   the steady-state transfer guard once the bucket pair is warm;
+2. **score** — the task's rule-based reward runs on host numpy (the
+   verifier stays off-device by design);
+3. **pack + replay** — sequences become prioritized sequence-replay
+   chunks (``genrl/rollout.py`` -> ``data/sequence_replay.py``), inserted
+   and sampled on device with the ``seq_*`` jitted entry points;
+4. **learn** — one token-PPO step (``agents/token_ppo.py``), metrics read
+   back with ONE batched transfer; the learner then publishes a fresh
+   generation to the engine (device-side copy, no host sync) and reports
+   generation staleness off the metrics that already crossed the host
+   boundary — no extra transfers anywhere in the round.
+
+dp×mp sharding rides ``maybe_enable_mesh_from_args`` exactly like the
+other trainer families.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from scalerl_tpu.agents.token_ppo import TokenPPOAgent
+from scalerl_tpu.config import GenRLArguments
+from scalerl_tpu.data.sequence_replay import seq_add, seq_init, seq_sample
+from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
+from scalerl_tpu.genrl.rollout import pack_sequences, sequence_field_shapes
+from scalerl_tpu.genrl.task import TokenRecallTask
+from scalerl_tpu.models.transformer import TransformerPolicy
+from scalerl_tpu.ops.pallas_per import resolve_sample_method
+from scalerl_tpu.parallel.train_step import maybe_enable_mesh_from_args
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.serving.batcher import bucket_for, default_buckets
+from scalerl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_genrl_model(args: GenRLArguments) -> TransformerPolicy:
+    """Token-mode transformer sized off the shared policy fields, with
+    ``max_len`` covering the largest (prompt, response) bucket pair."""
+    max_p = bucket_for(args.prompt_len, default_buckets(args.prompt_len))
+    max_r = bucket_for(
+        args.max_new_tokens, default_buckets(args.max_new_tokens)
+    )
+    bf16 = bool(getattr(args, "bf16_params", False))
+    import jax.numpy as jnp
+
+    return TransformerPolicy(
+        num_actions=args.vocab_size,
+        vocab_size=args.vocab_size,
+        d_model=args.d_model,
+        num_heads=args.n_heads,
+        num_layers=args.n_layers,
+        max_len=max_p + max_r,
+        dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        param_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+    )
+
+
+class SequenceRLTrainer:
+    """Single-learner sequence-RL loop over a synthetic (or injected) task.
+
+    ``task``: anything with ``sample_prompts(batch, rng) -> (prompts,
+    lengths)`` and ``score(prompts, lengths, response, response_len) ->
+    rewards`` — defaults to the hermetic :class:`TokenRecallTask`.
+    """
+
+    def __init__(
+        self,
+        args: GenRLArguments,
+        task: Optional[Any] = None,
+        agent: Optional[TokenPPOAgent] = None,
+    ) -> None:
+        args.validate()
+        self.args = args
+        self.task = task or TokenRecallTask(
+            vocab_size=args.vocab_size,
+            prompt_len=args.prompt_len,
+            response_len=args.max_new_tokens,
+        )
+        self.agent = agent or TokenPPOAgent(args, build_genrl_model(args))
+        maybe_enable_mesh_from_args(self.agent, args)
+        self.engine = GenerationEngine(
+            self.agent.model,
+            self.agent.get_weights(),
+            GenerationConfig(
+                vocab_size=args.vocab_size,
+                max_prompt_len=max(
+                    getattr(self.task, "max_prompt_len", args.prompt_len),
+                    args.prompt_len,
+                ),
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                eos_token=args.eos_token,
+                seed=args.seed,
+            ),
+            iter_mode=args.genrl_iter_mode,
+        )
+        # replay geometry is pinned to the engine's LARGEST bucket pair so
+        # one buffer covers every round (smaller rounds still land in the
+        # max buckets: generate() buckets by the batch's true max length,
+        # and the fixed task geometry keeps that constant per run)
+        self._prompt_pad = bucket_for(
+            self.engine.config.max_prompt_len,
+            self.engine.config.resolved_prompt_buckets(),
+        )
+        self._response_pad = bucket_for(
+            args.max_new_tokens,
+            self.engine.config.resolved_response_buckets(),
+        )
+        self.replay = seq_init(
+            sequence_field_shapes(self._prompt_pad, self._response_pad),
+            (),  # no recurrent core: attention over the cache is the memory
+            args.genrl_buffer_sequences,
+        )
+        self._seq_method = resolve_sample_method("auto")
+        self._rng = np.random.default_rng(args.seed)
+        self._sample_key = jax.random.PRNGKey(args.seed + 1)
+        self.learn_steps = 0
+        reg = telemetry.get_registry()
+        self._learn_meter = reg.meter("genrl.learn_steps_per_s")
+        self._reward_gauge = reg.gauge("genrl.mean_reward")
+        self._stale_gauge = reg.gauge("genrl.staleness")
+        self._kl_gauge = reg.gauge("genrl.kl_ref")
+        self.reward_history: List[float] = []
+
+    def _generate_round(self):
+        prompts, lengths = self.task.sample_prompts(
+            self.args.genrl_batch, self._rng
+        )
+        result = self.engine.generate(prompts, lengths)
+        rewards = self.task.score(
+            prompts, lengths, result.response_tokens, result.response_len
+        )
+        return result, rewards
+
+    def train_round(self) -> Dict[str, float]:
+        """One generate -> score -> insert -> sample -> learn round."""
+        result, rewards = self._generate_round()
+        if result.prompt_pad != self._prompt_pad or (
+            result.response_pad != self._response_pad
+        ):
+            raise ValueError(
+                "generation round landed outside the replay bucket pair "
+                f"({result.prompt_pad}x{result.response_pad} vs "
+                f"{self._prompt_pad}x{self._response_pad})"
+            )
+        fields, priorities = pack_sequences(result, rewards)
+        self.replay = seq_add(self.replay, fields, (), priorities)
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        batch, _core, _idx, weights = seq_sample(
+            self.replay,
+            sub,
+            self.args.genrl_sample_batch,
+            method=self._seq_method,
+        )
+        batch = dict(batch)
+        batch["is_weight"] = weights
+        metrics = self.agent.learn(batch)  # ONE batched transfer
+        self.learn_steps += 1
+        self._learn_meter.mark()
+        if self.learn_steps % self.args.genrl_push_every == 0:
+            self.engine.push_params(self.agent.get_weights())
+        # staleness in generations, off the metric that already crossed
+        # the host boundary inside the batched read — no extra transfer
+        staleness = max(
+            float(self.engine.generation) - metrics["mean_generation"], 0.0
+        )
+        self._stale_gauge.set(staleness)
+        mean_reward = float(np.mean(rewards))
+        self._reward_gauge.set(mean_reward)
+        if "kl_ref" in metrics:
+            self._kl_gauge.set(metrics["kl_ref"])
+        metrics["round_reward"] = mean_reward
+        metrics["staleness"] = staleness
+        metrics["decode_tokens"] = float(result.decode_tokens)
+        self.reward_history.append(mean_reward)
+        return metrics
+
+    def train(self, rounds: Optional[int] = None) -> Dict[str, float]:
+        rounds = rounds if rounds is not None else self.args.genrl_rounds
+        metrics: Dict[str, float] = {}
+        log_every = max(getattr(self.args, "logger_frequency", 50) or 50, 1)
+        for i in range(rounds):
+            metrics = self.train_round()
+            if (i + 1) % log_every == 0 or i + 1 == rounds:
+                logger.info(
+                    "genrl round %d/%d reward=%.3f loss=%.4f staleness=%.1f",
+                    i + 1,
+                    rounds,
+                    metrics.get("round_reward", 0.0),
+                    metrics.get("total_loss", 0.0),
+                    metrics.get("staleness", 0.0),
+                )
+        summary = dict(metrics)
+        tail = self.reward_history[-10:]
+        summary["final_reward_mean"] = float(np.mean(tail)) if tail else 0.0
+        summary["rounds"] = float(len(self.reward_history))
+        return summary

@@ -785,3 +785,89 @@ def test_fleet_impala_example_end_to_end():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "done: " in proc.stdout and "learn steps" in proc.stdout
+
+
+def test_discounted_returns_vectorized_matches_loop_reference():
+    """ISSUE 10 satellite: the blocked vectorized reverse cumsum must be
+    numerically indistinguishable from the old per-step Python loop across
+    gammas, lengths, and block boundaries."""
+
+    def loop_ref(rewards, gamma):
+        out = np.zeros_like(rewards, dtype=np.float32)
+        acc = 0.0
+        for t in range(len(rewards) - 1, -1, -1):
+            acc = rewards[t] + gamma * acc
+            out[t] = acc
+        return out
+
+    rng = np.random.default_rng(0)
+    for gamma in (0.0, 0.01, 0.5, 0.9, 0.99, 1.0):
+        for T in (0, 1, 63, 64, 65, 257):
+            r = rng.normal(size=T).astype(np.float32)
+            got = discounted_returns(r, gamma)
+            ref = loop_ref(r, gamma)
+            assert got.shape == ref.shape and got.dtype == np.float32
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    # explicit small blocks exercise the carry across block seams
+    r = rng.normal(size=100).astype(np.float32)
+    np.testing.assert_allclose(
+        discounted_returns(r, 0.9, block=7), loop_ref(r, 0.9),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_masked_softmax_direct_units():
+    """ISSUE 10 satellite: direct masked_softmax coverage — exact zeros on
+    illegal actions, stability under huge logits, single-legal-action
+    degeneracy."""
+    # stability: max-subtraction happens over the LEGAL subset only
+    probs = masked_softmax(
+        np.array([1e4, 1e4 - 1.0, -1e4], np.float32), legal=[0, 1]
+    )
+    assert np.isfinite(probs).all()
+    assert probs[2] == 0.0
+    assert probs[0] == pytest.approx(np.exp(1) / (np.exp(1) + 1), rel=1e-5)
+    # single legal action takes all the mass regardless of its logit
+    probs = masked_softmax(np.array([-50.0, 3.0, 7.0], np.float32), legal=[0])
+    np.testing.assert_allclose(probs, [1.0, 0.0, 0.0])
+    # full support == plain softmax
+    logits = np.array([0.5, -1.0, 2.0], np.float32)
+    probs = masked_softmax(logits, legal=[0, 1, 2])
+    e = np.exp(logits - logits.max())
+    np.testing.assert_allclose(probs, e / e.sum(), rtol=1e-6)
+
+
+def test_episode_generator_fixed_shape_chunk_packing():
+    """ISSUE 10 satellite: direct packing coverage — every chunk is the
+    full fixed shape with zero padding past `length`, starts stride by
+    chunk_len, and the concatenated prefix reconstructs the episode."""
+    gen = EpisodeGenerator(
+        _TicTacToeLite(), lambda w, o, p: np.zeros(3, np.float32),
+        num_actions=3, chunk_len=2,
+    )
+    episode = {
+        "obs": np.arange(15, dtype=np.float32).reshape(5, 3),
+        "action": np.array([0, 1, 2, 1, 0], np.int32),
+        "probs": np.full((5, 3), 1 / 3, np.float32),
+        "player": np.zeros(5, np.int32),
+        "returns": np.linspace(1.0, 0.2, 5).astype(np.float32),
+        "length": 5,
+    }
+    chunks = gen._chunk(episode)
+    assert [c["start"] for c in chunks] == [0, 2, 4]
+    assert [c["length"] for c in chunks] == [2, 2, 1]
+    for c in chunks:
+        # fixed shapes regardless of the real length
+        assert c["obs"].shape == (2, 3)
+        assert c["action"].shape == (2,)
+        assert c["probs"].shape == (2, 3)
+        # padded region is exactly zero
+        np.testing.assert_array_equal(c["obs"][c["length"]:], 0.0)
+        np.testing.assert_array_equal(c["action"][c["length"]:], 0)
+    rebuilt = np.concatenate([c["obs"][: c["length"]] for c in chunks])
+    np.testing.assert_array_equal(rebuilt, episode["obs"])
+    # an empty episode still yields one (all-padding) chunk
+    empty = {k: v[:0] for k, v in episode.items() if k != "length"}
+    empty["length"] = 0
+    chunks = gen._chunk(empty)
+    assert len(chunks) == 1 and chunks[0]["length"] == 0

@@ -725,3 +725,60 @@ def test_jg002_serving_unguarded_flush_dispatch_flags():
     findings = lint(BAD_SERVING_UNGUARDED_DISPATCH, relpath=SERVING)
     assert rules_of(findings) == ["JG002"]
     assert "_dispatch_guard" in findings[0].hint
+
+
+# ---------------------------------------------------------------------------
+# genrl plane fixtures (ISSUE 10): scalerl_tpu/genrl is a HOT package — the
+# generation engine's decode loop is ONE jitted program dispatched once per
+# round with ONE batched read of the round's outputs; sampling token-by-token
+# through per-step host reads is the transfer storm the KV-cached fused loop
+# exists to prevent
+
+GENRL = "scalerl_tpu/genrl/fixture.py"
+
+GOOD_GENRL_ONE_READ_PER_ROUND = """
+    import jax
+
+    def generation_round(program, params, tokens, lengths, key):
+        # ONE dispatch covers prefill + the whole (scan/unrolled) decode
+        # loop; the per-step sampling happens INSIDE the jitted program
+        out = program(params, tokens, lengths, key)
+        # ... and ONE explicit batched read materializes the round
+        return jax.device_get(out)
+"""
+
+BAD_GENRL_PER_TOKEN_READ = """
+    import jax
+
+    def generation_round(prefill, decode, params, tokens, lengths, key):
+        logits, cache = prefill(params, tokens, lengths)
+        sequence = []
+        for t in range(8):
+            key, sub = jax.random.split(key)
+            token = jax.random.categorical(sub, logits)
+            # per-token host sync: the host re-enters the decode loop
+            # every step and the device idles between dispatches
+            sequence.append(jax.device_get(token))
+            logits, cache = decode(params, token, cache, t)
+        return sequence
+"""
+
+
+def test_genrl_is_a_hot_package():
+    from tools.graftlint.rules import HOT_DIRS
+
+    assert "genrl" in HOT_DIRS
+
+
+def test_jg001_genrl_one_read_per_round_is_clean():
+    """The engine's sanctioned round shape — one fused dispatch, one
+    batched read — lints clean in the genrl package."""
+    assert lint(GOOD_GENRL_ONE_READ_PER_ROUND, relpath=GENRL) == []
+
+
+def test_jg001_genrl_per_token_device_get_flags():
+    """A host-side sample loop doing a device_get per decoded token is the
+    decode-discipline violation JG001 pins for the genrl package."""
+    findings = lint(BAD_GENRL_PER_TOKEN_READ, relpath=GENRL)
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message

@@ -189,3 +189,37 @@ def test_serving_bench_artifact_schema():
     assert result["p99_ms"] >= result["p95_ms"] >= result["p50_ms"] > 0
     assert 0.0 < result["batch_occupancy"] <= 1.0
     assert result["flushes"] > 0
+
+
+def test_genrl_bench_artifact_schema(capsys):
+    """bench --mode genrl artifacts carry the three headline numbers
+    (prefill/decode tokens/s + learn steps/s) and the like-for-like gate
+    keys (metric + mode) so genrl history only gates genrl runs.  Runs the
+    measurement in-process (CPU shapes are tiny) — no subprocess jax
+    import on the tier-1 clock."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_genrl_mod", REPO / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_genrl_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ]
+    result = json.loads(lines[-1])
+    assert result["metric"] == "genrl_decode_tokens_per_sec_per_chip"
+    assert result["mode"] == "genrl"
+    assert result["value"] > 0
+    assert result["value"] == result["decode_tokens_per_sec"]
+    assert result["prefill_tokens_per_sec"] > 0
+    assert result["learn_steps_per_sec"] > 0
+    assert result["prompt_bucket"] > 0 and result["response_bucket"] > 0
+    assert result["iter_mode"] in ("scan", "unroll")
+    # the gate filter treats mode rows like the other modes
+    from tools.tpu_watch import perf_gate_verdict
+
+    ok, median = perf_gate_verdict(result["value"], [result["value"]])
+    assert ok and median == result["value"]

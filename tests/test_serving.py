@@ -499,3 +499,48 @@ def test_serving_config_validation():
     cfg = ServingConfig.from_args(_args(serve_max_batch=16, serve_max_wait_ms=3.0))
     assert cfg.max_batch == 16
     assert cfg.max_wait_s == pytest.approx(0.003)
+
+
+def test_push_params_reuses_learner_mp_shardings():
+    """ISSUE 10 satellite (ROADMAP serving headroom): with an mp-sharded
+    learner, the server derives the learner's live NamedShardings at
+    construction and every pushed snapshot is re-placed into that layout —
+    the serve fn consumes the mp-sharded policy in place instead of an
+    unsharded gather.  mp=1 agents keep the unsharded path."""
+    args = _args(
+        policy_arch="transformer", d_model=32, n_heads=2, n_layers=2,
+        telemetry_interval_s=0.0,
+    )
+    agent = ImpalaAgent(
+        args, obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32,
+    )
+    agent.enable_mesh("dp=4,mp=2")
+    server = InferenceServer(agent, ServingConfig(max_batch=4))
+    assert server._param_shardings is not None
+
+    def mp_leaves(tree):
+        return sum(
+            1
+            for leaf in jax.tree_util.tree_leaves(tree)
+            if hasattr(leaf, "sharding")
+            and any(
+                s == "mp"
+                for s in getattr(leaf.sharding, "spec", ())
+                if s is not None
+            )
+        )
+
+    # the constructor snapshot already lives in the learner's layout
+    assert mp_leaves(server._params) >= 4
+    # a push from HOST numpy weights (e.g. a restored checkpoint) is
+    # re-placed into the same mp layout — no unsharded program ever serves
+    host_weights = jax.tree_util.tree_map(np.asarray, agent.get_weights())
+    gen = server.push_params(host_weights)
+    assert gen == 1
+    assert mp_leaves(server._params) >= 4
+    # mp=1: unsharded path preserved (no shardings derived)
+    plain = ImpalaAgent(
+        _args(), obs_shape=(4,), num_actions=2, obs_dtype=jnp.float32,
+    )
+    plain_server = InferenceServer(plain, ServingConfig(max_batch=4))
+    assert plain_server._param_shardings is None

@@ -43,7 +43,7 @@ from tools.graftlint.engine import (
 )
 
 # packages whose loops are device hot paths (relative path segments)
-HOT_DIRS = {"runtime", "trainer", "agents", "serving"}
+HOT_DIRS = {"runtime", "trainer", "agents", "serving", "genrl"}
 
 # jax module aliases whose call results live on device
 JAX_ROOTS = {"jax", "jnp"}

@@ -248,6 +248,7 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
             "impala_atari_env_frames_per_sec_per_chip",
             "sharded_train_step_frames_per_sec",
             "serving_requests_per_sec",
+            "genrl_decode_tokens_per_sec_per_chip",
         }
         result = None
         for line in segment.splitlines():
@@ -343,6 +344,16 @@ def run_payload(n_devices: int = 1) -> None:
         # fleet mark the outcome !elastic(...)
         ("elastic-soak", [sys.executable, "tools/elastic_soak.py"],
          600, dict(env, JAX_PLATFORMS="cpu")),
+        # genrl soak fourth: the hermetic token-PPO e2e (generate -> score
+        # -> learn on the synthetic recall task, scan/unroll decode parity,
+        # reward-improvement threshold).  CPU-pinned and ~1 min (measured
+        # well under the step budget — the ISSUE 10 admission condition),
+        # so like the other soaks it records sequence-RL regressions even
+        # tunnel-down and does not count toward the witness quorum
+        ("genrl-soak",
+         [sys.executable, "-m", "pytest", "tests/test_genrl.py", "-q",
+          "-k", "e2e"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
         # --fast first: banks a BENCH_TPU.md artifact within ~60 s of
         # contact, before the long steps gamble on the tunnel staying up
         ("bench-fast", [sys.executable, "bench.py", "--fast"], 450, fast_env),
@@ -370,6 +381,12 @@ def run_payload(n_devices: int = 1) -> None:
         # (p50/p95/p99) and batch occupancy; perf-gated like-for-like
         # against serving-mode history exactly like the other bench steps
         ("bench-serving", [sys.executable, "bench.py", "--mode", "serving"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # token-level sequence-RL plane: prefill/decode tokens/s/chip
+        # through the KV-cached generation engine + token-PPO learn
+        # steps/s; perf-gated like-for-like against genrl-mode history and
+        # counted toward the witness quorum like the other bench steps
+        ("bench-genrl", [sys.executable, "bench.py", "--mode", "genrl"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
@@ -426,11 +443,11 @@ def run_payload(n_devices: int = 1) -> None:
     if not any(
         status.startswith("ok")
         for name, status in outcomes
-        if name not in ("lint", "chaos-soak", "elastic-soak")
+        if name not in ("lint", "chaos-soak", "elastic-soak", "genrl-soak")
     ):
-        # nothing TPU-witnessed succeeded (lint, the chaos soak, and the
-        # elastic soak are CPU-only and pass tunnel-down, so they do not
-        # count): there is no artifact to
+        # nothing TPU-witnessed succeeded (lint, the chaos soak, the
+        # elastic soak, and the genrl soak are CPU-only and pass
+        # tunnel-down, so they do not count): there is no artifact to
         # record — a commit here would just stamp noise over the probe log
         log_probe("[watcher] no payload step succeeded; skipping witness commit")
         return
