@@ -541,6 +541,181 @@ def _run_serving_measurement() -> None:
     print(json.dumps(result))
 
 
+def _run_genrl_continuous_measurement() -> None:
+    """``--mode genrl --continuous``: the continuous-batching decode plane
+    vs the fixed-cohort engine, like-for-like (same model, same params,
+    same mixed-length prompt distribution, same EOS geometry), in ONE
+    artifact — the ISSUE 11 acceptance comparison.
+
+    Workload shape: mixed-length prompts and an EOS token the policy
+    actually samples, so response lengths vary — the regime continuous
+    batching exists for.  The cohort engine pays the full response bucket
+    for every lane regardless (its decode loop is one fused program);
+    the continuous engine backfills freed lanes from a Poisson arrival
+    queue, so its decode steps stay near-full occupancy of LIVE lanes.
+    Decode tokens/s counts REAL (mask=1) tokens for both engines over
+    whole-phase wall clock — an honest end-to-end rate, not a
+    padding-subtracted estimate.
+    """
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.genrl.continuous import (
+        ContinuousConfig,
+        ContinuousEngine,
+    )
+    from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
+    from scalerl_tpu.models.transformer import TransformerPolicy
+    from scalerl_tpu.runtime import telemetry
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+
+    # the regime continuous batching exists for: a LONG response budget
+    # with a real EOS rate (small vocab => the random-init policy actually
+    # samples EOS), so response lengths land well short of the budget —
+    # the cohort engine still pays every budget step, the continuous
+    # engine backfills the freed lanes
+    if on_accel:
+        V, d_model, n_layers, n_heads = 32, 256, 4, 8
+        P_max, R, lanes = 128, 256, 256
+        page_size, macro_steps, min_free = 16, 16, 32
+        target_s = 10.0
+    else:
+        V, d_model, n_layers, n_heads = 8, 64, 1, 4
+        P_max, R, lanes = 16, 64, 64
+        page_size, macro_steps, min_free = 8, 4, 8
+        # schema tests shrink the window (and optionally the lane pool) to
+        # stay cheap on the tier-1 clock; the real CPU shape is the default
+        target_s = float(os.environ.get("BENCH_GENRL_TARGET_S", "3.0"))
+        lanes = int(os.environ.get("BENCH_GENRL_LANES", lanes))
+        R = int(os.environ.get("BENCH_GENRL_RESPONSE", R))
+
+    base = dict(
+        vocab_size=V, max_prompt_len=P_max, max_new_tokens=R,
+        temperature=1.0, eos_token=1, seed=0,
+    )
+    model = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=d_model, num_heads=n_heads,
+        num_layers=n_layers, max_len=2 * (P_max + R),
+    )
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jax.numpy.zeros((1, 2), jax.numpy.int32),
+    )
+    rng = np.random.default_rng(0)
+
+    def sample_prompts(n):
+        lengths = rng.integers(2, P_max + 1, size=n).astype(np.int32)
+        prompts = rng.integers(2, V, size=(n, P_max)).astype(np.int32)
+        return prompts, lengths
+
+    # phase 1: fixed-cohort rounds at the same lane count
+    cohort = GenerationEngine(model, params, GenerationConfig(**base))
+    prompts, lengths = sample_prompts(lanes)
+    cohort.generate(prompts, lengths)  # warm/compile
+    t0 = time.perf_counter()
+    cohort_tokens = 0
+    cohort_rounds = 0
+    while time.perf_counter() - t0 < target_s or cohort_rounds < 2:
+        prompts, lengths = sample_prompts(lanes)
+        result = cohort.generate(prompts, lengths)
+        cohort_tokens += result.decode_tokens
+        cohort_rounds += 1
+    cohort_elapsed = time.perf_counter() - t0
+    cohort_tps = cohort_tokens / cohort_elapsed
+    cohort_seq_per_s = cohort_rounds * lanes / cohort_elapsed
+
+    # phase 2: the continuous engine under Poisson prompt arrivals at
+    # ~2x the cohort completion rate (saturating: the queue stays fed,
+    # admission latency is the congestion signal in the artifact)
+    engine = ContinuousEngine(
+        model, params,
+        ContinuousConfig(
+            lanes=lanes, page_size=page_size, steps_per_macro=macro_steps,
+            min_free_lanes=min_free,
+            # ONE admission prompt bucket: a prefill dispatch per group
+            # per bucket is the dominant overhead at CPU shapes, and the
+            # pad waste of the collapsed ladder is far cheaper (measured)
+            prompt_buckets=(P_max,),
+            **base,
+        ),
+    )
+    rate = 2.0 * cohort_seq_per_s
+    # warm: churn several lane-fills through so the decode program AND the
+    # admission (prompt, admit) bucket programs all compile off the clock
+    prompts, lengths = sample_prompts(6 * lanes)
+    for i in range(6 * lanes):
+        engine.submit(prompts[i], lengths[i])
+    while engine.live_lanes or engine.pending:
+        engine.step()
+    t0 = time.perf_counter()
+    next_arrival = rng.exponential(1.0 / rate)
+    cont_tokens = 0
+    completed = 0
+    occ0, macro0 = engine._occupancy_sum, engine.macro_steps
+    while time.perf_counter() - t0 < target_s or completed < 2:
+        now = time.perf_counter() - t0
+        n_new = 0
+        while next_arrival <= now:
+            n_new += 1
+            next_arrival += rng.exponential(1.0 / rate)
+        if n_new:
+            prompts, lengths = sample_prompts(n_new)
+            for i in range(n_new):
+                engine.submit(prompts[i], lengths[i])
+        if engine.live_lanes == 0 and engine.pending == 0:
+            continue  # idle until the next arrival lands
+        done = engine.step()
+        completed += len(done)
+        cont_tokens += sum(len(c.response_tokens) for c in done)
+    cont_elapsed = time.perf_counter() - t0
+    cont_tps = cont_tokens / cont_elapsed
+    admit_hist = telemetry.get_registry().histogram(
+        "genrl.admission_latency_s"
+    )
+
+    result_obj = {
+        "metric": "genrl_decode_tokens_per_sec_per_chip",
+        "mode": "genrl-continuous",
+        "value": round(cont_tps, 1),
+        "unit": f"decode tokens/sec/chip ({platform}, continuous)",
+        "decode_tokens_per_sec": round(cont_tps, 1),
+        "cohort_decode_tokens_per_sec": round(cohort_tps, 1),
+        "speedup_vs_cohort": round(cont_tps / max(cohort_tps, 1e-9), 3),
+        "lane_occupancy_mean": round(
+            (engine._occupancy_sum - occ0)
+            / max(engine.macro_steps - macro0, 1),
+            4,
+        ),
+        "admission_latency_p50_ms": round(
+            admit_hist.quantile(0.50) * 1e3, 3
+        ),
+        "admission_latency_p95_ms": round(
+            admit_hist.quantile(0.95) * 1e3, 3
+        ),
+        "completed_sequences": completed,
+        "arrival_rate_per_s": round(rate, 2),
+        "shed_total": engine._batcher.shed_total,
+        "lanes": lanes,
+        "page_size": page_size,
+        "macro_steps": macro_steps,
+        "pages_capacity": engine.allocator.capacity,
+        "vocab": V,
+        "d_model": d_model,
+        "num_layers": n_layers,
+        "prompt_max": P_max,
+        "response_budget": R,
+        "iter_mode": engine.iter_mode,
+        "device_kind": device_kind,
+        "measured_s": round(cohort_elapsed + cont_elapsed, 1),
+    }
+    print(json.dumps(result_obj))
+
+
 def _run_genrl_measurement() -> None:
     """``--mode genrl``: the token-level sequence-RL plane's headline
     numbers — prefill tokens/s/chip and decode tokens/s/chip through the
@@ -723,6 +898,11 @@ def _run_measurement(
         # the token-level sequence-RL plane: prefill/decode tokens/s +
         # token-PPO learn steps/s through the KV-cached engine
         _run_genrl_measurement()
+        return
+    if mode == "genrl-continuous":
+        # the continuous-batching decode plane: paged-KV lane pool under
+        # Poisson arrivals, like-for-like vs the fixed-cohort engine
+        _run_genrl_continuous_measurement()
         return
 
     # backend already pinned by __main__ when --cpu; "auto" here just turns
@@ -1133,7 +1313,8 @@ def main(
         "impala_learn_step_frames_per_sec" if learn
         else "sharded_train_step_frames_per_sec" if mode == "sharded"
         else "serving_requests_per_sec" if mode == "serving"
-        else "genrl_decode_tokens_per_sec_per_chip" if mode == "genrl"
+        else "genrl_decode_tokens_per_sec_per_chip"
+        if mode in ("genrl", "genrl-continuous")
         else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
         else "impala_atari_env_frames_per_sec_per_chip"
     )
@@ -1364,6 +1545,11 @@ if __name__ == "__main__":
                     f"unknown --mode {_mode!r}; supported: anakin, sharded, "
                     "serving, genrl"
                 )
+            if _mode == "genrl" and "--continuous" in sys.argv[1:]:
+                # --mode genrl --continuous: the continuous-batching decode
+                # variant (its own like-for-like history under mode
+                # "genrl-continuous", same headline metric)
+                _mode = "genrl-continuous"
         try:
             main(
                 _argv_mesh(),
@@ -1383,7 +1569,7 @@ if __name__ == "__main__":
                             else "serving_requests_per_sec"
                             if _mode == "serving"
                             else "genrl_decode_tokens_per_sec_per_chip"
-                            if _mode == "genrl"
+                            if _mode in ("genrl", "genrl-continuous")
                             else "impala_atari_env_frames_per_sec_aggregate"
                             if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"

@@ -17,6 +17,12 @@ Sharded learner (8 virtual devices, dp=4 × mp=2)::
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/train_sequence_rl.py --dp-size 4 --mp-size 2 \
         --d-model 256 --n-layers 4 --genrl-rounds 200
+
+Continuous-batching generation (paged KV lane pool; ISSUE 11,
+docs/SEQUENCE_RL.md "Continuous batching")::
+
+    python examples/train_sequence_rl.py --genrl-engine continuous \
+        --genrl-lanes 32 --genrl-page-size 8 --genrl-macro-steps 4
 """
 
 import os

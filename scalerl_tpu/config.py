@@ -748,6 +748,22 @@ class GenRLArguments(RLArguments):
     # iter_mode verdict — unroll on XLA:CPU, scan on TPU/GPU).
     genrl_iter_mode: str = "auto"
 
+    # Engine selection (ISSUE 11): "cohort" = the fixed-cohort bucket-pair
+    # engine (one jitted round, every lane runs the full response bucket);
+    # "continuous" = the persistent continuous-batching engine (paged KV,
+    # macro-steps, admission into freed lanes).  The trainer rides either.
+    genrl_engine: str = "cohort"
+    genrl_lanes: int = 0  # continuous decode lanes; 0 -> genrl_batch
+    genrl_page_size: int = 8  # KV pool page size (tokens per page)
+    genrl_num_pages: int = 0  # KV pool pages; 0 -> all-lane worst case
+    genrl_macro_steps: int = 4  # decode substeps fused per macro-step
+    # Admission flush deadline (ms): the oldest queued prompt waits at most
+    # this long before a flush fires even with lanes to spare (the serving
+    # batcher's max_wait_s on the admission queue); 0 = admit immediately.
+    genrl_admit_wait_ms: float = 0.0
+    genrl_max_pending: int = 0  # admission queue bound (0 = unbounded)
+    genrl_paged_attn: str = "auto"  # pallas | xla | auto (backend)
+
     def validate(self) -> None:
         super().validate()
         if self.vocab_size < 4:
@@ -757,9 +773,10 @@ class GenRLArguments(RLArguments):
                 "prompt_len and max_new_tokens must be >= 1, got "
                 f"{self.prompt_len}/{self.max_new_tokens}"
             )
-        if self.temperature <= 0:
+        if self.temperature < 0:
             raise ValueError(
-                f"temperature must be positive, got {self.temperature}"
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature}"
             )
         if not 0.0 < self.clip_range < 1.0:
             raise ValueError(
@@ -788,6 +805,25 @@ class GenRLArguments(RLArguments):
             raise ValueError(
                 "genrl_iter_mode must be auto | scan | unroll, got "
                 f"{self.genrl_iter_mode!r}"
+            )
+        if self.genrl_engine not in ("cohort", "continuous"):
+            raise ValueError(
+                "genrl_engine must be cohort | continuous, got "
+                f"{self.genrl_engine!r}"
+            )
+        if self.genrl_lanes < 0 or self.genrl_page_size < 1:
+            raise ValueError(
+                "genrl_lanes must be >= 0 and genrl_page_size >= 1, got "
+                f"{self.genrl_lanes}/{self.genrl_page_size}"
+            )
+        if self.genrl_macro_steps < 1:
+            raise ValueError(
+                f"genrl_macro_steps must be >= 1, got {self.genrl_macro_steps}"
+            )
+        if self.genrl_paged_attn not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                "genrl_paged_attn must be auto | pallas | xla, got "
+                f"{self.genrl_paged_attn!r}"
             )
 
 

@@ -9,12 +9,19 @@ logprobs.  ``genrl`` is a graftlint HOT package: the decode loop performs
 exactly ONE batched host read per generation round.
 """
 
+from scalerl_tpu.genrl.continuous import (  # noqa: F401
+    CompletedSequence,
+    ContinuousConfig,
+    ContinuousEngine,
+)
 from scalerl_tpu.genrl.engine import (  # noqa: F401
     GenerationConfig,
     GenerationEngine,
     GenerationResult,
 )
+from scalerl_tpu.genrl.paging import PageAllocator  # noqa: F401
 from scalerl_tpu.genrl.rollout import (  # noqa: F401
+    pack_completions,
     pack_sequences,
     sequence_field_shapes,
 )

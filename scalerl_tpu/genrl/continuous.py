@@ -1,0 +1,689 @@
+"""Continuous-batching decode: a persistent lane pool over a paged KV cache.
+
+The vLLM shape of the generation plane (ISSUE 11; MindSpeed RL argues the
+generation tier is where sequence-RL throughput is won, arxiv 2507.19017):
+instead of fixed cohorts where every lane waits for the slowest sequence,
+:class:`ContinuousEngine` runs a FIXED number of decode lanes forever and
+the host swaps *sequences* through them —
+
+- **macro-steps** — ONE jitted program (compiled once; lane count, page
+  geometry and ``steps_per_macro`` are all static) advances every lane
+  ``steps_per_macro`` tokens: sample from the carried last-logits, latch
+  EOS / response-budget, scatter the new K/V into pool pages, attend
+  through the page table (``ops/pallas_paged_attention.py`` behind the
+  ``paged_attn_fn`` seam), carry the fresh logits.  The host dispatches
+  once and reads back once — PR 10's one-batched-read round discipline at
+  macro-step granularity, under ``steady_state_guard()`` once warm;
+- **continuous admission** — between macro-steps the host harvests lanes
+  that latched done (frees their pages immediately — KV memory tracks
+  LIVE tokens), then admits queued prompts into the freed lanes through
+  the serving batcher's flush-on-size-or-deadline predicate
+  (:meth:`DynamicBatcher.poll_batch`) and the shared pow2 bucket ladder:
+  one jitted *prefill* program per (prompt bucket, admit bucket) writes
+  the prompt K/V straight into newly-allocated pages and scatters the
+  lane state (last logits/value, cursor, flags) device-side — no host
+  read anywhere in admission;
+- **paged KV** — ``models/transformer.py``'s ``PagedKVCache`` pools plus
+  the jax-free :class:`~scalerl_tpu.genrl.paging.PageAllocator`:
+  admission reserves a sequence's worst-case pages (exhaustion
+  backpressures, never corrupts) while physical pages are drawn lazily as
+  contexts grow.
+
+Sampling math is shared with the fixed-cohort engine (``engine.py``'s
+``adjust_logits``/``sample_tokens``), so at temperature 0 the two engines
+are token-identical on the same params — the parity the acceptance tests
+pin.  A sequence is tagged with the param generation that admitted it; a
+``push_params`` mid-flight rotates the policy under lanes already decoding
+(inherent to continuous batching; the token-PPO ratios absorb it exactly
+like actor lag).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalerl_tpu.genrl.engine import (
+    GenerationConfig,
+    ParamSnapshotPlane,
+    adjust_logits,
+    sample_tokens,
+)
+from scalerl_tpu.genrl.paging import PageAllocator
+from scalerl_tpu.models.transformer import (
+    TransformerPolicy,
+    init_paged_kv_cache,
+    prompt_attention_mask,
+)
+from scalerl_tpu.ops.pallas_paged_attention import make_paged_attn_fn
+from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime.device_loop import resolve_iter_mode
+from scalerl_tpu.runtime.dispatch import steady_state_guard
+from scalerl_tpu.serving.batcher import (
+    DynamicBatcher,
+    ServingConfig,
+    ServingRequest,
+)
+from scalerl_tpu.utils.buckets import bucket_for, default_buckets
+
+# module seams: tests monkeypatch these to count host transfers and assert
+# the one-upload-one-read-per-macro-step invariant
+_device_put = jax.device_put
+_device_get = jax.device_get
+
+
+@dataclass
+class ContinuousConfig(GenerationConfig):
+    """Fixed-cohort knobs plus the continuous-batching geometry.
+
+    ``num_pages = 0`` sizes the pool for every lane's worst case (null
+    page included) — no admission backpressure by default; smaller pools
+    trade admission latency for KV memory and are exercised by the
+    exhaustion tests.  ``admit_max_wait_s`` is the deadline half of the
+    admission flush predicate (0 = admit the moment lanes are free).
+    """
+
+    lanes: int = 64
+    page_size: int = 16
+    num_pages: int = 0
+    steps_per_macro: int = 8
+    admit_max_wait_s: float = 0.0
+    max_pending: int = 0  # bounded admission queue; 0 = unbounded
+    paged_attn: str = "auto"  # pallas | xla | auto (backend-resolved)
+    # Admission batching: hold admission until at least this many lanes are
+    # free (unless the pool is fully idle), so prefill dispatches amortize
+    # over bigger batches instead of firing per macro-step for a lane or
+    # two.  1 = admit the moment anything frees (lowest latency); ~lanes/8
+    # trades a little occupancy for much cheaper admission (the measured
+    # CPU sweet spot; see docs/SEQUENCE_RL.md "Continuous batching").
+    min_free_lanes: int = 1
+
+    def validate(self) -> None:
+        super().validate()
+        if self.lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {self.lanes}")
+        if self.min_free_lanes < 1 or self.min_free_lanes > self.lanes:
+            raise ValueError(
+                f"min_free_lanes must be in [1, lanes], got "
+                f"{self.min_free_lanes}"
+            )
+        if self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}"
+            )
+        if self.steps_per_macro < 1:
+            raise ValueError(
+                f"steps_per_macro must be >= 1, got {self.steps_per_macro}"
+            )
+        if self.num_pages < 0:
+            raise ValueError(
+                f"num_pages must be >= 0 (0 = auto), got {self.num_pages}"
+            )
+
+
+class CompletedSequence(NamedTuple):
+    """One finished lane occupancy, assembled host-side across the
+    macro-steps it spanned."""
+
+    prompt: np.ndarray  # [n] int32 true prompt tokens
+    prompt_len: int
+    response_tokens: np.ndarray  # [r] int32 real tokens only
+    behavior_logp: np.ndarray  # [r] f32
+    values: np.ndarray  # [r] f32
+    generation: int  # param generation at admission
+    submit_time: float
+    admit_time: float
+    finish_time: float
+
+
+@dataclass
+class _Lane:
+    """Host-side record of one lane's current occupancy."""
+
+    busy: bool = False
+    prompt: Optional[np.ndarray] = None
+    prompt_len: int = 0
+    context_len: int = 0
+    pages: List[int] = field(default_factory=list)
+    reserved: int = 0
+    tokens: List[np.ndarray] = field(default_factory=list)
+    logps: List[np.ndarray] = field(default_factory=list)
+    values: List[np.ndarray] = field(default_factory=list)
+    generation: int = 0
+    submit_time: float = 0.0
+    admit_time: float = 0.0
+
+
+class ContinuousEngine(ParamSnapshotPlane):
+    """Persistent continuous-batching decode loop over a paged KV cache.
+
+    ``model``: a token-mode :class:`TransformerPolicy` whose ``max_len``
+    covers ``prompt_bucket_max + response_bucket``.  The engine compiles
+    exactly ONE decode macro-step program (lane count static) plus one
+    prefill program per (prompt bucket, admit bucket) pair — the
+    ``_decode_traces`` / ``_prefill_traces`` counters let tests pin zero
+    retraces after warmup.
+    """
+
+    def __init__(
+        self,
+        model: TransformerPolicy,
+        params: Any,
+        config: ContinuousConfig,
+        iter_mode: str = "auto",
+        dispatch_guard: Optional[Callable[[], Any]] = None,
+    ) -> None:
+        config.validate()
+        if model.vocab_size is None:
+            raise ValueError(
+                "ContinuousEngine needs a token-mode TransformerPolicy "
+                "(vocab_size set); got a feature-embedding model"
+            )
+        self.config = config
+        self.model = model
+        self.iter_mode = resolve_iter_mode(iter_mode)
+        self._dispatch_guard = dispatch_guard or nullcontext
+        self._paged_attn = make_paged_attn_fn(config.paged_attn)
+        if model.paged_attn_fn is None:
+            # route the model's paged decode reads through the resolved impl
+            # (clone shares the param structure: same names, same shapes)
+            self.model = model.clone(paged_attn_fn=self._paged_attn)
+        self._init_param_plane(params)
+        L = config.lanes
+        ps = config.page_size
+        self._max_prompt_bucket = bucket_for(
+            config.max_prompt_len, config.resolved_prompt_buckets()
+        )
+        # the response budget is the response BUCKET, mirroring the fixed
+        # cohort engine (its scan runs bucket_for(max_new_tokens) steps)
+        self._response_budget = bucket_for(
+            config.max_new_tokens, config.resolved_response_buckets()
+        )
+        max_context = self._max_prompt_bucket + self._response_budget
+        if model.max_len < max_context:
+            raise ValueError(
+                f"model.max_len ({model.max_len}) must cover prompt bucket "
+                f"+ response budget ({max_context})"
+            )
+        self._pages_per_lane = -(-max_context // ps)  # table width (static)
+        num_pages = config.num_pages or (L * self._pages_per_lane + 1)
+        self.allocator = PageAllocator(num_pages, ps)
+        self._worst_pages = self.allocator.pages_for_tokens(max_context)
+        # admission queue: the serving batcher reused verbatim — flush on
+        # size (free lanes) OR deadline, bounded by max_pending with sheds
+        self._batcher = DynamicBatcher(
+            ServingConfig(
+                max_batch=L,
+                max_wait_s=config.admit_max_wait_s,
+                max_pending=config.max_pending,
+            )
+        )
+        self._admit_buckets = default_buckets(L)
+        head_dim = model.d_model // model.num_heads
+        # device state: pools + per-lane decode carry (donated through
+        # every program; the host rebinds after each dispatch)
+        self._pools = init_paged_kv_cache(
+            num_pages, ps, model.num_layers, model.num_heads, head_dim
+        )
+        self._logits_st = jnp.zeros((L, config.vocab_size), jnp.float32)
+        self._value_st = jnp.zeros((L,), jnp.float32)
+        self._cl = jnp.zeros((L,), jnp.int32)
+        self._done = jnp.ones((L,), jnp.bool_)  # inert until admitted
+        self._resp = jnp.zeros((L,), jnp.int32)
+        # host mirrors / bookkeeping
+        self._lanes = [_Lane() for _ in range(L)]
+        self._table = np.zeros((L, self._pages_per_lane), np.int32)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._decode_fn = self._build_decode()
+        self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
+        self._decode_traces = 0
+        self._prefill_traces = 0
+        self._warm = False
+        self.macro_steps = 0
+        self.completed_total = 0
+        self._occupancy_sum = 0.0
+        reg = telemetry.get_registry()
+        self._decode_meter = reg.meter("genrl.decode_tokens_per_s")
+        self._prompt_meter = reg.meter("genrl.prompt_tokens_per_s")
+        self._occupancy_gauge = reg.gauge("genrl.lane_occupancy")
+        self._admitted_counter = reg.counter("genrl.admitted")
+        self._completed_counter = reg.counter("genrl.completed")
+        self._admit_hist = reg.histogram("genrl.admission_latency_s")
+        reg.bind("genrl.pages", self.allocator.stats)
+        reg.bind(
+            "genrl.continuous",
+            lambda: {
+                "generation": self.generation,
+                "macro_steps": self.macro_steps,
+                "completed": self.completed_total,
+                "live_lanes": sum(l.busy for l in self._lanes),
+                "pending": self._batcher.stats()["pending_requests"],
+                "shed_total": self._batcher.shed_total,
+                "iter_mode": self.iter_mode,
+            },
+        )
+
+    # -- admission ------------------------------------------------------
+    def submit(self, prompt: np.ndarray, prompt_length: Optional[int] = None) -> bool:
+        """Queue one prompt for admission; False = shed (queue at
+        ``max_pending``).  ``prompt``: 1-D int32 (or the right-padded
+        ``[L0]`` row with an explicit true length)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(prompt_length) if prompt_length is not None else len(prompt)
+        if n < 1 or n > self.config.max_prompt_len:
+            raise ValueError(
+                f"prompt length {n} outside [1, {self.config.max_prompt_len}]"
+            )
+        return self._batcher.submit(
+            ServingRequest(
+                conn=None,
+                req_id=None,
+                lanes=1,
+                payload={"prompt": prompt[:n].copy(), "len": n},
+            )
+        )
+
+    @property
+    def pending(self) -> int:
+        return self._batcher.stats()["pending_requests"]
+
+    @property
+    def live_lanes(self) -> int:
+        return sum(l.busy for l in self._lanes)
+
+    def _admit(self) -> None:
+        """Admit queued prompts into free lanes via the batcher's
+        flush-on-size-or-deadline predicate, grouped per prompt bucket so
+        each prefill dispatch reuses a compiled (P, A) program."""
+        free_ids = [i for i, l in enumerate(self._lanes) if not l.busy]
+        if not free_ids:
+            return
+        if len(free_ids) < self.config.min_free_lanes and len(
+            free_ids
+        ) < self.config.lanes:
+            # admission batching: wait for more lanes to free so the
+            # prefill dispatch amortizes (a fully idle pool always admits)
+            return
+        # admission never over-commits the page pool: cap the flush at the
+        # number of worst-case sequences the allocator can still reserve
+        affordable = (
+            self.allocator.capacity - self.allocator.reserved
+        ) // self._worst_pages
+        limit = min(len(free_ids), affordable)
+        batch = self._batcher.poll_batch(max_lanes=limit)
+        if not batch:
+            return
+        now = time.monotonic()
+        groups: Dict[int, List[Tuple[int, ServingRequest]]] = {}
+        for req in batch:
+            lane_id = free_ids.pop(0)
+            n = req.payload["len"]
+            P = bucket_for(n, self.config.resolved_prompt_buckets())
+            groups.setdefault(P, []).append((lane_id, req))
+        params, gen = self._snapshot_params()
+        for P, members in groups.items():
+            self._prefill_group(P, members, params, gen, now)
+
+    def _prefill_group(
+        self,
+        P: int,
+        members: List[Tuple[int, ServingRequest]],
+        params: Any,
+        gen: int,
+        now: float,
+    ) -> None:
+        ps = self.config.page_size
+        A = bucket_for(len(members), self._admit_buckets)
+        L = self.config.lanes
+        tokens = np.full((A, P), self.config.pad_token, np.int32)
+        lengths = np.ones((A,), np.int32)
+        lane_ids = np.full((A,), L, np.int32)  # pad rows scatter-drop
+        page_ids = np.zeros((A, P), np.int32)  # pad writes -> null page
+        offsets = np.zeros((A, P), np.int32)
+        for row, (lane_id, req) in enumerate(members):
+            prompt = req.payload["prompt"]
+            n = req.payload["len"]
+            lane = self._lanes[lane_id]
+            reserved = self.allocator.pages_for_tokens(
+                n + self._response_budget
+            )
+            ok = self.allocator.try_reserve(reserved)
+            assert ok, "admission cap should have prevented over-reserve"
+            pages = self.allocator.alloc(
+                self.allocator.pages_for_tokens(n)
+            )
+            lane.busy = True
+            lane.prompt = prompt
+            lane.prompt_len = n
+            lane.context_len = n
+            lane.pages = pages
+            lane.reserved = reserved
+            lane.tokens, lane.logps, lane.values = [], [], []
+            lane.generation = gen
+            lane.submit_time = req.t_enqueue
+            lane.admit_time = now
+            self._table[lane_id] = 0
+            self._table[lane_id, : len(pages)] = pages
+            tokens[row, :n] = prompt
+            lengths[row] = n
+            lane_ids[row] = lane_id
+            pos = np.arange(n)
+            page_ids[row, :n] = np.asarray(lane.pages, np.int32)[pos // ps]
+            offsets[row, :n] = pos % ps
+            self._admit_hist.observe(now - req.t_enqueue)
+            self._prompt_meter.mark(n)
+        self._admitted_counter.inc(len(members))
+        fn = self._prefill_fn(P, A)
+        with self._dispatch_guard():
+            # ONE explicit batched host->device upload per prefill dispatch
+            up = _device_put((tokens, lengths, lane_ids, page_ids, offsets))
+            (
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+            ) = fn(
+                params,
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+                *up,
+            )
+
+    # -- program construction -------------------------------------------
+    def _prefill_fn(self, P: int, A: int) -> Callable:
+        fn = self._prefill_fns.get((P, A))
+        if fn is None:
+            fn = self._build_prefill(P, A)
+            self._prefill_fns[(P, A)] = fn
+        return fn
+
+    def _build_prefill(self, P: int, A: int) -> Callable:
+        """Prefill ``A`` admitted prompts at bucket ``P``: causal forward
+        over the compact (right-padded) prompts, K/V written straight into
+        the newly-allocated pages, last-position logits/value + cursor +
+        flags scattered into the lane state — all device-side, no read."""
+        model = self.model
+
+        def prefill(
+            params, pools, logits_st, value_st, cl, done, resp,
+            tokens, lengths, lane_ids, page_ids, page_offsets,
+        ):
+            self._prefill_traces += 1
+            positions = jnp.broadcast_to(jnp.arange(P), (A, P))
+            mask = prompt_attention_mask(lengths, P)
+            out, pools = model.apply(
+                params,
+                tokens,
+                positions=positions,
+                attn_mask=mask,
+                paged_cache=pools,
+                page_ids=page_ids,
+                page_offsets=page_offsets,
+            )
+            rows = jnp.arange(A)
+            last = lengths - 1
+            logits_last = out.policy_logits[rows, last]
+            value_last = out.baseline[rows, last]
+            # pad rows carry lane_id == lanes: out-of-bounds scatters drop
+            logits_st = logits_st.at[lane_ids].set(logits_last, mode="drop")
+            value_st = value_st.at[lane_ids].set(value_last, mode="drop")
+            cl = cl.at[lane_ids].set(lengths, mode="drop")
+            done = done.at[lane_ids].set(False, mode="drop")
+            resp = resp.at[lane_ids].set(0, mode="drop")
+            return pools, logits_st, value_st, cl, done, resp
+
+        return jax.jit(prefill, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+    def _build_decode(self) -> Callable:
+        """The ONE macro-step program: ``steps_per_macro`` fused substeps
+        of sample -> latch -> paged write -> paged attention -> carry."""
+        model = self.model
+        cfg = self.config
+        ps = cfg.page_size
+        steps = cfg.steps_per_macro
+        budget = self._response_budget
+        use_scan = self.iter_mode == "scan"
+
+        def substep(params, table, carry, _t):
+            pools, logits, value, cl, done, resp, key = carry
+            key, sub = jax.random.split(key)
+            adj = adjust_logits(
+                logits, cfg.temperature, cfg.top_k, cfg.vocab_size
+            )
+            token = sample_tokens(sub, adj, cfg.temperature)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(adj, axis=-1), token[:, None], axis=-1
+            )[:, 0]
+            alive = jnp.logical_not(done)
+            resp2 = resp + alive.astype(jnp.int32)
+            finished = resp2 >= budget
+            if cfg.eos_token >= 0:
+                finished = jnp.logical_or(finished, token == cfg.eos_token)
+            done2 = jnp.logical_or(done, finished)
+            emit = jnp.where(
+                alive, token, jnp.int32(max(cfg.eos_token, cfg.pad_token))
+            ).astype(jnp.int32)
+            out_t = (emit, logp, value, alive.astype(jnp.float32))
+            # feed the sampled token back through the paged model: write
+            # K/V at flat position cl (dead lanes route to the null page)
+            page_idx = jnp.take_along_axis(
+                table, (cl // ps)[:, None], axis=1
+            )[:, 0]
+            page_idx = jnp.where(alive, page_idx, 0)
+            offs = jnp.where(alive, cl % ps, 0)
+            att_len = jnp.where(alive, cl + 1, 1)
+            out, pools = model.apply(
+                params,
+                token[:, None].astype(jnp.int32),
+                positions=cl[:, None],
+                paged_cache=pools,
+                page_ids=page_idx[:, None],
+                page_offsets=offs[:, None],
+                page_table=table,
+                attn_lengths=att_len,
+            )
+            cl2 = cl + alive.astype(jnp.int32)
+            new_carry = (
+                pools,
+                out.policy_logits[:, 0],
+                out.baseline[:, 0],
+                cl2,
+                done2,
+                resp2,
+                key,
+            )
+            return new_carry, out_t
+
+        def decode(params, pools, logits_st, value_st, cl, done, resp,
+                   table, key):
+            self._decode_traces += 1
+            carry = (pools, logits_st, value_st, cl, done, resp, key)
+            if use_scan:
+                carry, outs = jax.lax.scan(
+                    lambda c, t: substep(params, table, c, t),
+                    carry,
+                    jnp.arange(steps),
+                )
+                toks, logps, values, alive = (
+                    jnp.swapaxes(o, 0, 1) for o in outs
+                )
+            else:
+                cols = []
+                for t in range(steps):
+                    carry, out_t = substep(params, table, carry, t)
+                    cols.append(out_t)
+                toks = jnp.stack([c[0] for c in cols], axis=1)
+                logps = jnp.stack([c[1] for c in cols], axis=1)
+                values = jnp.stack([c[2] for c in cols], axis=1)
+                alive = jnp.stack([c[3] for c in cols], axis=1)
+            pools, logits_st, value_st, cl, done, resp, _key = carry
+            outputs = {
+                "tokens": toks.astype(jnp.int32),
+                "logp": logps.astype(jnp.float32),
+                "value": values.astype(jnp.float32),
+                "mask": alive,
+                "cl": cl,
+                "done": done,
+                "resp": resp,
+            }
+            return pools, logits_st, value_st, cl, done, resp, outputs
+
+        return jax.jit(decode, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+    # -- the macro-step --------------------------------------------------
+    def _ensure_pages(self) -> None:
+        """Pre-extend each live lane's page list to cover the next macro's
+        worst case (all allocation stays within the lane's admission-time
+        reservation, so it can never fail mid-flight)."""
+        ps = self.config.page_size
+        steps = self.config.steps_per_macro
+        for lane_id, lane in enumerate(self._lanes):
+            if not lane.busy:
+                continue
+            horizon = min(
+                lane.context_len + steps,
+                lane.prompt_len + self._response_budget,
+            )
+            need = min(
+                self.allocator.pages_for_tokens(horizon), lane.reserved
+            )
+            delta = need - len(lane.pages)
+            if delta > 0:
+                new_pages = self.allocator.alloc(delta)
+                start = len(lane.pages)
+                lane.pages.extend(new_pages)
+                self._table[
+                    lane_id, start : start + len(new_pages)
+                ] = new_pages
+
+    def step(self) -> List[CompletedSequence]:
+        """One engine cycle: admit -> decode macro-step (ONE dispatch, ONE
+        batched read) -> harvest.  Returns the sequences that completed."""
+        self._admit()
+        if self.live_lanes == 0:
+            return []
+        self._ensure_pages()
+        params, _gen = self._snapshot_params()
+        occ = self.live_lanes / self.config.lanes
+        self._occupancy_gauge.set(occ)
+        self._occupancy_sum += occ
+        guard = steady_state_guard() if self._warm else nullcontext()
+        with guard:
+            with self._dispatch_guard():
+                self._key, sub = jax.random.split(self._key)
+                # ONE explicit batched host->device upload per macro-step
+                table_dev = _device_put(self._table)
+                (
+                    self._pools,
+                    self._logits_st,
+                    self._value_st,
+                    self._cl,
+                    self._done,
+                    self._resp,
+                    outputs,
+                ) = self._decode_fn(
+                    params,
+                    self._pools,
+                    self._logits_st,
+                    self._value_st,
+                    self._cl,
+                    self._done,
+                    self._resp,
+                    table_dev,
+                    sub,
+                )
+                # ... and ONE explicit batched device->host read
+                host = _device_get(outputs)
+        self._warm = True
+        self.macro_steps += 1
+        return self._harvest(host)
+
+    def _harvest(self, host: Dict[str, np.ndarray]) -> List[CompletedSequence]:
+        mask = np.asarray(host["mask"], np.float32)
+        tokens = np.asarray(host["tokens"], np.int32)
+        logp = np.asarray(host["logp"], np.float32)
+        value = np.asarray(host["value"], np.float32)
+        done = np.asarray(host["done"], bool)
+        cl = np.asarray(host["cl"], np.int32)
+        finish = time.monotonic()
+        completions: List[CompletedSequence] = []
+        decode_tokens = 0
+        for lane_id, lane in enumerate(self._lanes):
+            if not lane.busy:
+                continue
+            count = int(mask[lane_id].sum())
+            decode_tokens += count
+            if count > 0:
+                lane.tokens.append(tokens[lane_id, :count])
+                lane.logps.append(logp[lane_id, :count])
+                lane.values.append(value[lane_id, :count])
+            lane.context_len = int(cl[lane_id])
+            if done[lane_id]:
+                completions.append(
+                    CompletedSequence(
+                        prompt=lane.prompt,
+                        prompt_len=lane.prompt_len,
+                        response_tokens=np.concatenate(lane.tokens)
+                        if lane.tokens
+                        else np.zeros((0,), np.int32),
+                        behavior_logp=np.concatenate(lane.logps)
+                        if lane.logps
+                        else np.zeros((0,), np.float32),
+                        values=np.concatenate(lane.values)
+                        if lane.values
+                        else np.zeros((0,), np.float32),
+                        generation=lane.generation,
+                        submit_time=lane.submit_time,
+                        admit_time=lane.admit_time,
+                        finish_time=finish,
+                    )
+                )
+                # release the lane: pages + reservation return to the pool
+                # immediately (the memory-scales-with-live-tokens half)
+                self.allocator.free(lane.pages)
+                self.allocator.release(lane.reserved)
+                self._table[lane_id] = 0
+                self._lanes[lane_id] = _Lane()
+        self._decode_meter.mark(decode_tokens)
+        self.completed_total += len(completions)
+        if completions:
+            self._completed_counter.inc(len(completions))
+        return completions
+
+    @property
+    def mean_occupancy(self) -> float:
+        """Mean live-lane fraction over all dispatched macro-steps
+        (sampled post-admission, the occupancy the decode program saw)."""
+        return self._occupancy_sum / max(self.macro_steps, 1)
+
+    def run_until(
+        self, n_completions: int, max_macro_steps: int = 10_000
+    ) -> List[CompletedSequence]:
+        """Drive macro-steps until ``n_completions`` sequences finished
+        (requires enough prompts submitted/submittable to get there)."""
+        out: List[CompletedSequence] = []
+        for _ in range(max_macro_steps):
+            if len(out) >= n_completions:
+                return out
+            if self.live_lanes == 0 and self.pending == 0:
+                raise RuntimeError(
+                    f"engine drained at {len(out)}/{n_completions} "
+                    "completions (no live lanes, empty queue)"
+                )
+            out.extend(self.step())
+        raise RuntimeError(
+            f"run_until({n_completions}) exceeded {max_macro_steps} "
+            "macro-steps"
+        )

@@ -46,12 +46,37 @@ from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.device_loop import resolve_iter_mode
 from scalerl_tpu.runtime.dispatch import steady_state_guard
 from scalerl_tpu.runtime.param_server import _tree_map, jnp_copy
-from scalerl_tpu.serving.batcher import bucket_for, default_buckets
+from scalerl_tpu.runtime.quantize import dequantize_tree, quantize_tree
+from scalerl_tpu.utils.buckets import bucket_for, default_buckets
 
 # module seams: tests monkeypatch these to count host transfers and assert
 # the one-upload-one-read-per-round invariant
 _device_put = jax.device_put
 _device_get = jax.device_get
+
+
+def adjust_logits(
+    logits: jnp.ndarray, temperature: float, top_k: int, vocab_size: int
+) -> jnp.ndarray:
+    """Sampling adjustments (top-k mask then temperature) — the behavior
+    logprob is computed from THESE logits, so the stored logp is the true
+    log-density of the sampling distribution.  ``temperature == 0`` (greedy)
+    skips the scale: sampling argmaxes and the logp reads the unscaled
+    log-softmax (both engines share this helper, so temperature-0 parity
+    across them is exact by construction)."""
+    if top_k > 0 and top_k < vocab_size:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits >= kth, logits, jnp.float32(-1e30))
+    if temperature > 0:
+        logits = logits / jnp.float32(temperature)
+    return logits
+
+
+def sample_tokens(key, adj_logits: jnp.ndarray, temperature: float):
+    """Categorical sample from adjusted logits; argmax at temperature 0."""
+    if temperature == 0:
+        return jnp.argmax(adj_logits, axis=-1)
+    return jax.random.categorical(key, adj_logits, axis=-1)
 
 
 @dataclass
@@ -61,6 +86,8 @@ class GenerationConfig:
     ``eos_token < 0`` disables early stopping (fixed-length responses, the
     synthetic-task default); with an EOS id, lanes latch done on sampling
     it and their remaining steps emit EOS with a zeroed alive mask.
+    ``temperature == 0`` selects greedy (argmax) decoding — the setting the
+    fixed-vs-continuous engine parity tests pin token-identical outputs at.
     """
 
     vocab_size: int
@@ -88,9 +115,10 @@ class GenerationConfig:
                 "max_prompt_len and max_new_tokens must be >= 1, got "
                 f"{self.max_prompt_len}/{self.max_new_tokens}"
             )
-        if self.temperature <= 0:
+        if self.temperature < 0:
             raise ValueError(
-                f"temperature must be positive, got {self.temperature}"
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature}"
             )
         if self.top_k < 0 or self.top_k > self.vocab_size:
             raise ValueError(
@@ -100,6 +128,52 @@ class GenerationConfig:
             raise ValueError(
                 f"eos_token {self.eos_token} outside vocab {self.vocab_size}"
             )
+
+
+class ParamSnapshotPlane:
+    """Generation-tagged parameter snapshots, optionally quantized.
+
+    The shared parameter half of both generation engines (fixed-cohort and
+    continuous): :meth:`push_params` publishes a device-side snapshot copy
+    with a monotonic generation bump (the ``InferenceServer`` idiom — the
+    copy detaches the snapshot from the learner's donated buffers), and
+    ``_snapshot_params`` hands programs the serve-ready tree.
+
+    ``quantize="int8" | "bf16"`` stores the ROADMAP's compressed broadcast
+    format instead (``runtime/quantize.py``: per-leaf symmetric int8 with
+    f32 scales, or a bf16 cast; 1-D f32-sensitive leaves pass through) and
+    dequantizes ON READ, cached per generation — so a non-learner replica
+    holds the small format at rest and pays one fused dequant per publish.
+    """
+
+    def _init_param_plane(self, params: Any) -> None:
+        self._param_lock = threading.Lock()
+        self._params = _tree_map(jnp_copy, params)
+        self._quantized = None
+        self.generation = 0
+
+    def push_params(self, params: Any, quantize: Optional[str] = None) -> int:
+        """Publish fresh params (device-side copy or quantized snapshot +
+        monotonic generation bump; no host transfer).  Returns the new
+        generation."""
+        if quantize is None:
+            snapshot, qsnap = _tree_map(jnp_copy, params), None
+        else:
+            # round/clip/cast produce fresh buffers, so the quantized tree
+            # is already detached from the learner's donated params
+            snapshot, qsnap = None, quantize_tree(params, quantize)
+        with self._param_lock:
+            self.generation += 1
+            self._params = snapshot
+            self._quantized = qsnap
+            return self.generation
+
+    def _snapshot_params(self) -> Tuple[Any, int]:
+        with self._param_lock:
+            if self._params is None:
+                # dequant-on-read, cached until the next push
+                self._params = dequantize_tree(self._quantized)
+            return self._params, self.generation
 
 
 class GenerationResult(NamedTuple):
@@ -125,7 +199,7 @@ class GenerationResult(NamedTuple):
         return int(self.prompt_len.sum())
 
 
-class GenerationEngine:
+class GenerationEngine(ParamSnapshotPlane):
     """Owns generation-tagged param snapshots + one jitted decode program
     per (prompt, response) bucket pair.
 
@@ -166,9 +240,7 @@ class GenerationEngine:
         self.config = config
         self.iter_mode = resolve_iter_mode(iter_mode)
         self._dispatch_guard = dispatch_guard or nullcontext
-        self._param_lock = threading.Lock()
-        self._params = _tree_map(jnp_copy, params)
-        self.generation = 0
+        self._init_param_plane(params)
         self._key = jax.random.PRNGKey(config.seed)
         self._programs: Dict[Tuple[int, int], Callable] = {}
         self._warm: set = set()
@@ -185,31 +257,12 @@ class GenerationEngine:
             },
         )
 
-    # -- parameter plane ------------------------------------------------
-    def push_params(self, params: Any) -> int:
-        """Publish fresh params: device-side snapshot copy + monotonic
-        generation bump (no host transfer; the copy detaches the snapshot
-        from the learner's donated buffers).  Returns the new generation."""
-        snapshot = _tree_map(jnp_copy, params)
-        with self._param_lock:
-            self.generation += 1
-            self._params = snapshot
-            return self.generation
-
-    def _snapshot_params(self) -> Tuple[Any, int]:
-        with self._param_lock:
-            return self._params, self.generation
-
     # -- program construction ------------------------------------------
     def _adjust_logits(self, logits: jnp.ndarray) -> jnp.ndarray:
-        """Sampling adjustments (top-k mask then temperature) — the
-        behavior logprob is computed from THESE logits, so the stored
-        logp is the true log-density of the sampling distribution."""
-        cfg = self.config
-        if cfg.top_k > 0 and cfg.top_k < cfg.vocab_size:
-            kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
-            logits = jnp.where(logits >= kth, logits, jnp.float32(-1e30))
-        return logits / jnp.float32(cfg.temperature)
+        return adjust_logits(
+            logits, self.config.temperature, self.config.top_k,
+            self.config.vocab_size,
+        )
 
     def _build_program(self, P: int, R: int) -> Callable:
         """Build + jit the whole-round program at one bucket pair.
@@ -229,7 +282,7 @@ class GenerationEngine:
             cache, logits, value, done, key = carry
             key, sub = jax.random.split(key)
             adj = self._adjust_logits(logits)
-            token = jax.random.categorical(sub, adj, axis=-1)
+            token = sample_tokens(sub, adj, cfg.temperature)
             logp = jnp.take_along_axis(
                 jax.nn.log_softmax(adj, axis=-1), token[:, None], axis=-1
             )[:, 0]

@@ -1,0 +1,120 @@
+"""Host-side page allocator for the block-paged KV cache.
+
+jax-free by design (the ``serving/batcher.py`` discipline): the allocator
+is pure Python bookkeeping over page *ids* — the device only ever sees the
+resulting int32 page tables, uploaded inside the continuous engine's one
+batched transfer per macro-step.  Two-level accounting:
+
+- **reservations** bound admission: admitting a prompt reserves its
+  worst-case page count (``ceil((prompt_len + response_budget) /
+  page_size)``) so a live lane can NEVER hit mid-flight exhaustion — when
+  the pool can't cover a new sequence's worst case, admission backpressures
+  (the prompt stays queued / is shed at the queue bound), it never
+  corrupts;
+- **allocations** track live tokens: physical pages are drawn lazily as a
+  lane's context actually grows, so the allocated-page gauge — the memory
+  the continuous plane really uses — scales with live tokens, not with
+  ``max_bucket x lanes`` (early-EOS lanes return their pages immediately).
+
+Page 0 is the **null page**: never handed out, the routing target for
+dead-lane and pad writes, never read (reads are masked by true lengths).
+Double-free and double-alloc are hard errors — the no-aliasing invariant
+the randomized admit/finish test hammers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+
+class PageAllocator:
+    """Free-list page allocator with admission reservations.
+
+    ``num_pages`` includes the null page, so ``capacity = num_pages - 1``
+    pages are actually allocatable.  All methods are O(1)/O(k) list ops;
+    not thread-safe (the continuous engine drives it from its one host
+    loop, like every other host-side queue in the codebase).
+    """
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the null page), got "
+                f"{num_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # LIFO free list: recently-freed pages are reused first, so a long
+        # churny run naturally fragments lane->page maps — which is why
+        # fragmentation-independence is a tested property, not an accident
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._live: Set[int] = set()
+        self.reserved = 0
+
+    # -- capacity ------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return len(self._live)
+
+    def pages_for_tokens(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)  # ceil div
+
+    # -- reservations (admission control) ------------------------------
+    def try_reserve(self, n_pages: int) -> bool:
+        """Reserve worst-case capacity for a new sequence; False =
+        backpressure (the pool cannot guarantee the sequence finishes)."""
+        if self.reserved + n_pages > self.capacity:
+            return False
+        self.reserved += n_pages
+        return True
+
+    def release(self, n_pages: int) -> None:
+        """Return a reservation (the lane finished or was never admitted)."""
+        if n_pages > self.reserved:
+            raise RuntimeError(
+                f"release({n_pages}) exceeds outstanding reservation "
+                f"{self.reserved}"
+            )
+        self.reserved -= n_pages
+
+    # -- physical pages ------------------------------------------------
+    def alloc(self, n_pages: int) -> List[int]:
+        """Draw ``n_pages`` physical pages.  Callers alloc only within
+        their reservation, so an empty free list here is a bookkeeping bug
+        (aliasing hazard) and raises instead of corrupting."""
+        if n_pages > len(self._free):
+            raise RuntimeError(
+                f"alloc({n_pages}) with only {len(self._free)} free pages "
+                f"(reserved={self.reserved}) — reservation accounting broken"
+            )
+        pages = [self._free.pop() for _ in range(n_pages)]
+        self._live.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        """Return physical pages.  Double-free (or freeing the null page)
+        raises — the invariant that no page is ever owned by two lanes."""
+        for p in pages:
+            if p == 0 or p not in self._live:
+                raise RuntimeError(f"free of page {p} not currently live")
+            self._live.remove(p)
+            self._free.append(p)
+
+    # -- telemetry -----------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity": self.capacity,
+            "free": self.free_pages,
+            "allocated": self.allocated_pages,
+            "reserved": self.reserved,
+        }

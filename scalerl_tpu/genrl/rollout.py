@@ -23,7 +23,7 @@ replay on surprising sequences, the PER idea at sequence granularity.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -81,3 +81,111 @@ def pack_sequences(
             np.asarray(priorities, np.float32), 1e-6
         )
     return fields, priorities
+
+
+class PackedCompletions(NamedTuple):
+    """A variable-completion round re-batched into one bucket pair.
+
+    The continuous engine finishes sequences one at a time (that is the
+    point); the learner still wants rectangular batches.  This is the
+    bridge: ``B`` completed sequences padded into the trainer's fixed
+    (prompt_pad, response_pad) geometry — prompts LEFT-padded inside
+    ``sequences`` (the learner-side layout every mask helper expects),
+    RIGHT-padded in ``prompts`` (the task-scoring layout), responses
+    zero-padded past each true length with a zeroed mask.  ``generations``
+    is per-sequence: a continuous round can straddle a ``push_params``.
+    """
+
+    prompts: np.ndarray  # [B, prompt_pad] int32 right-padded (task layout)
+    prompt_len: np.ndarray  # [B] int32
+    sequences: np.ndarray  # [B, S] int32 left-padded prompt + response
+    response_tokens: np.ndarray  # [B, response_pad] int32
+    response_len: np.ndarray  # [B] int32
+    behavior_logp: np.ndarray  # [B, response_pad] f32
+    values: np.ndarray  # [B, response_pad] f32
+    mask: np.ndarray  # [B, response_pad] f32
+    generations: np.ndarray  # [B] int32 per-sequence admission generation
+
+    @property
+    def decode_tokens(self) -> int:
+        return int(self.mask.sum())
+
+    def fields(
+        self, rewards: np.ndarray, priorities: Optional[np.ndarray] = None
+    ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """``seq_add``-ready fields — same schema as :func:`pack_sequences`
+        (one replay, either engine)."""
+        B = self.sequences.shape[0]
+        rewards = np.asarray(rewards, np.float32)
+        if rewards.shape != (B,):
+            raise ValueError(
+                f"rewards must be [B={B}], got shape {rewards.shape}"
+            )
+        fields = {
+            "tokens": self.sequences,
+            "behavior_logp": self.behavior_logp,
+            "value": self.values,
+            "mask": self.mask,
+            "reward": rewards,
+            "prompt_len": self.prompt_len,
+            "generation": self.generations,
+        }
+        if priorities is None:
+            priorities = np.ones(B, np.float32)
+        else:
+            priorities = np.maximum(
+                np.asarray(priorities, np.float32), 1e-6
+            )
+        return fields, priorities
+
+
+def pack_completions(
+    completions: List[Any],
+    prompt_pad: int,
+    response_pad: int,
+    pad_token: int = 0,
+) -> PackedCompletions:
+    """Pack ``CompletedSequence``s (variable prompt/response lengths) into
+    the fixed bucket-pair geometry the replay and learner compile against."""
+    B = len(completions)
+    if B == 0:
+        raise ValueError("pack_completions needs at least one completion")
+    S = prompt_pad + response_pad
+    prompts = np.full((B, prompt_pad), pad_token, np.int32)
+    sequences = np.full((B, S), pad_token, np.int32)
+    response = np.full((B, response_pad), pad_token, np.int32)
+    logp = np.zeros((B, response_pad), np.float32)
+    values = np.zeros((B, response_pad), np.float32)
+    mask = np.zeros((B, response_pad), np.float32)
+    plen = np.zeros((B,), np.int32)
+    rlen = np.zeros((B,), np.int32)
+    gens = np.zeros((B,), np.int32)
+    for i, c in enumerate(completions):
+        n = int(c.prompt_len)
+        r = int(len(c.response_tokens))
+        if n > prompt_pad or r > response_pad:
+            raise ValueError(
+                f"completion {i} ({n} prompt / {r} response tokens) "
+                f"exceeds the ({prompt_pad}, {response_pad}) bucket pair"
+            )
+        prompts[i, :n] = c.prompt[:n]
+        sequences[i, prompt_pad - n : prompt_pad] = c.prompt[:n]
+        sequences[i, prompt_pad : prompt_pad + r] = c.response_tokens
+        response[i, :r] = c.response_tokens
+        logp[i, :r] = c.behavior_logp
+        values[i, :r] = c.values
+        mask[i, :r] = 1.0
+        plen[i] = n
+        rlen[i] = r
+        gens[i] = int(c.generation)
+    return PackedCompletions(
+        prompts=prompts,
+        prompt_len=plen,
+        sequences=sequences,
+        response_tokens=response,
+        response_len=rlen,
+        behavior_logp=logp,
+        values=values,
+        mask=mask,
+        generations=gens,
+    )

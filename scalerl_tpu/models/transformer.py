@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from scalerl_tpu.ops.pallas_attention import flash_attention
+from scalerl_tpu.ops.pallas_paged_attention import paged_attention_reference
 from scalerl_tpu.ops.ring_attention import full_attention
 
 # (q, k, v) -> attention output, all [B, T, H, D]
@@ -64,6 +65,51 @@ def init_kv_cache(
         k=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
         v=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
     )
+
+
+class PagedKVCache(NamedTuple):
+    """Block-paged key/value cache: a fixed pool shared by every lane.
+
+    ``k``/``v``: one ``[num_pages, page_size, H, D]`` pool per transformer
+    block.  Lanes own *pages*, not contiguous rows: a host-side allocator
+    (``genrl/paging.py``) hands each lane an ordered page list, and the
+    decode path writes token ``p`` of a lane into page
+    ``table[p // page_size]`` at slot ``p % page_size`` — so KV memory
+    scales with LIVE tokens across all lanes instead of
+    ``max_bucket x lanes`` (the vLLM shape).  Page 0 is the allocator's
+    null page: dead-lane and pad writes are routed there and it is never
+    read (every read is masked by a lane's true length).
+    """
+
+    k: Tuple[jnp.ndarray, ...]
+    v: Tuple[jnp.ndarray, ...]
+
+
+def init_paged_kv_cache(
+    num_pages: int,
+    page_size: int,
+    num_layers: int,
+    num_heads: int,
+    head_dim: int,
+    dtype=jnp.float32,
+) -> PagedKVCache:
+    """Zeroed page pools (page 0 = the never-read null page)."""
+    shape = (num_pages, page_size, num_heads, head_dim)
+    return PagedKVCache(
+        k=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+        v=tuple(jnp.zeros(shape, dtype) for _ in range(num_layers)),
+    )
+
+
+def prompt_attention_mask(lengths: jnp.ndarray, total_len: int) -> jnp.ndarray:
+    """``[B, T, T]`` causal mask over RIGHT-padded (compact) prompts — the
+    paged-prefill twin of :func:`prefill_attention_mask`: lane ``b``'s real
+    tokens occupy columns ``[0, lengths[b])``, so position ``i`` attends
+    causally within the real prefix and pad-tail rows degrade to uniform
+    (finite, outputs unused)."""
+    cols = jnp.arange(total_len)[None, None, :]
+    rows = jnp.arange(total_len)[None, :, None]
+    return (cols <= rows) & (cols < lengths[:, None, None])
 
 
 def prefill_attention_mask(
@@ -154,6 +200,7 @@ class _Block(nn.Module):
     attn_fn: AttentionFn
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    paged_attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -162,6 +209,11 @@ class _Block(nn.Module):
         layer_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
         cache_index=None,
         attn_mask: Optional[jnp.ndarray] = None,
+        paged_cache: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        page_ids: Optional[jnp.ndarray] = None,
+        page_offsets: Optional[jnp.ndarray] = None,
+        page_table: Optional[jnp.ndarray] = None,
+        attn_lengths: Optional[jnp.ndarray] = None,
     ):
         """Full forward (``layer_cache=None``) or KV-cached incremental step.
 
@@ -171,7 +223,17 @@ class _Block(nn.Module):
         whole cache under ``attn_mask`` ``[B, T, S]``; returns
         ``(out, (new_k, new_v))``.  With a mask but no cache it runs
         explicit masked attention against its own k/v (the learner-side
-        forward over left-padded sequences).  Same params on every path.
+        forward over left-padded sequences).
+
+        With ``paged_cache=(k_pages, v_pages)`` the block scatters this
+        call's keys/values into pool pages — lane ``b``'s token ``t`` lands
+        in ``(page_ids[b, t], page_offsets[b, t])``; dead-lane/pad writes
+        are routed to the null page by the caller — then attends either
+        *locally* against its own k/v under ``attn_mask`` (paged prefill: a
+        fresh prompt's whole context is in-program, no pool read needed) or
+        *through the pool* via ``paged_attn_fn(q, k_pages, v_pages,
+        page_table, attn_lengths)`` (paged single-token decode); returns
+        ``(out, (k_pages, v_pages))``.  Same params on every path.
         """
         B, T, _ = x.shape
         head_dim = self.d_model // self.num_heads
@@ -182,7 +244,33 @@ class _Block(nn.Module):
         shape = (B, T, self.num_heads, head_dim)
         q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
         new_cache = None
-        if layer_cache is not None:
+        if paged_cache is not None:
+            kp, vp = paged_cache
+            # flat single-axis scatter (page_id * page_size + offset): the
+            # reshape is a bitcast and XLA:CPU lowers 1-level row scatters
+            # measurably faster than the 2-level fancy-index form
+            N, ps = kp.shape[0], kp.shape[1]
+            flat_idx = (page_ids * ps + page_offsets).reshape(B * T)
+            kp = (
+                kp.reshape(N * ps, *kp.shape[2:])
+                .at[flat_idx]
+                .set(k.astype(kp.dtype).reshape(B * T, *k.shape[2:]))
+                .reshape(kp.shape)
+            )
+            vp = (
+                vp.reshape(N * ps, *vp.shape[2:])
+                .at[flat_idx]
+                .set(v.astype(vp.dtype).reshape(B * T, *v.shape[2:]))
+                .reshape(vp.shape)
+            )
+            if page_table is not None:
+                paged_attn = self.paged_attn_fn or paged_attention_reference
+                out = paged_attn(q, kp, vp, page_table, attn_lengths)
+                out = out.astype(self.dtype)
+            else:
+                out = _masked_attention(q, k, v, attn_mask, self.dtype)
+            new_cache = (kp, vp)
+        elif layer_cache is not None:
             ck, cv = layer_cache
             idx = jnp.asarray(cache_index, jnp.int32)
             zero = jnp.zeros((), jnp.int32)
@@ -207,7 +295,7 @@ class _Block(nn.Module):
         h = nn.gelu(h)
         h = nn.Dense(self.d_model, name="mlp_out", **dt)(h)
         x = x + h
-        if layer_cache is not None:
+        if new_cache is not None:
             return x, new_cache
         return x
 
@@ -251,6 +339,12 @@ class TransformerPolicy(nn.Module):
     # batch-over-dp / replicated-over-mp so GSPMD derives the per-block
     # head/mlp reshard from the weight shardings alone.
     constrain: Optional[Callable] = None
+    # Paged-attention seam (the continuous-batching decode plane): the
+    # gather-through-page-table attention used when ``paged_cache`` is
+    # passed with a ``page_table`` — ``ops.pallas_paged_attention
+    # .make_paged_attn_fn`` resolves Pallas-on-TPU / XLA-gather-elsewhere;
+    # None defaults to the XLA reference.
+    paged_attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -260,6 +354,11 @@ class TransformerPolicy(nn.Module):
         kv_cache: Optional[KVCache] = None,
         cache_index=None,
         attn_mask: Optional[jnp.ndarray] = None,
+        paged_cache: Optional[PagedKVCache] = None,
+        page_ids: Optional[jnp.ndarray] = None,
+        page_offsets: Optional[jnp.ndarray] = None,
+        page_table: Optional[jnp.ndarray] = None,
+        attn_lengths: Optional[jnp.ndarray] = None,
     ):
         """Full forward, masked full forward, or KV-cached incremental step.
 
@@ -274,6 +373,16 @@ class TransformerPolicy(nn.Module):
           (``T = 1``, ``i = prompt_pad + step``) both go through here,
           sharing every parameter with the training forward.  Returns
           ``(TransformerOutput, new_cache)``.
+        - ``paged_cache=PagedKVCache`` (the continuous-batching plane):
+          scatter this call's k/v into pool pages at ``(page_ids[b, t],
+          page_offsets[b, t])``.  With ``attn_mask=[B, T, T]`` and no
+          ``page_table`` this is paged *prefill* over RIGHT-padded compact
+          prompts (:func:`prompt_attention_mask` — attention is local, the
+          pool is write-only); with ``page_table=[B, M]`` +
+          ``attn_lengths=[B]`` and ``T = 1`` it is paged *decode*
+          (attention gathers through the table).  Returns
+          ``(TransformerOutput, new_paged_cache)``.  Same params as every
+          other path.
         """
         B, T = obs.shape[:2]
         if T > self.max_len:
@@ -316,9 +425,22 @@ class TransformerPolicy(nn.Module):
                 attn,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                paged_attn_fn=self.paged_attn_fn,
                 name=f"block_{i}",
             )
-            if kv_cache is not None:
+            if paged_cache is not None:
+                x, (bk, bv) = block(
+                    x,
+                    attn_mask=attn_mask,
+                    paged_cache=(paged_cache.k[i], paged_cache.v[i]),
+                    page_ids=page_ids,
+                    page_offsets=page_offsets,
+                    page_table=page_table,
+                    attn_lengths=attn_lengths,
+                )
+                new_k.append(bk)
+                new_v.append(bv)
+            elif kv_cache is not None:
                 x, (bk, bv) = block(
                     x,
                     layer_cache=(kv_cache.k[i], kv_cache.v[i]),
@@ -336,6 +458,8 @@ class TransformerPolicy(nn.Module):
         policy_logits = nn.Dense(self.num_actions, name="policy_head")(x)
         baseline = nn.Dense(1, name="value_head")(x).squeeze(-1)
         out = TransformerOutput(policy_logits, baseline)
+        if paged_cache is not None:
+            return out, PagedKVCache(k=tuple(new_k), v=tuple(new_v))
         if kv_cache is not None:
             return out, KVCache(k=tuple(new_k), v=tuple(new_v))
         return out

@@ -10,6 +10,12 @@ from scalerl_tpu.ops.losses import (  # noqa: F401
     policy_gradient_loss,
 )
 from scalerl_tpu.ops.pallas_attention import flash_attention  # noqa: F401
+from scalerl_tpu.ops.pallas_paged_attention import (  # noqa: F401
+    make_paged_attn_fn,
+    paged_attention_reference,
+    paged_decode_attention,
+    resolve_paged_attn,
+)
 from scalerl_tpu.ops.ring_attention import (  # noqa: F401
     full_attention,
     make_ring_attention_fn,

@@ -1,0 +1,107 @@
+"""Quantized parameter snapshots for non-learner replicas.
+
+The ROADMAP's quantized-broadcast seed: at N generation/serving replicas x
+B parameters, snapshot distribution bandwidth is the scaling wall, and the
+non-learner copies never take gradients — so they can hold (and ship) a
+lossy-compressed snapshot while the learner keeps full precision.  Two wire
+formats:
+
+- ``"int8"`` — per-leaf symmetric quantization: ``q = round(x / s)`` in
+  int8 with ONE float32 scale ``s = max|x| / 127`` per leaf (4x smaller
+  than f32, 2x smaller than bf16).  *f32-sensitive* leaves — anything with
+  ``ndim <= 1`` (biases, LayerNorm scales, the value head's bias), where a
+  per-leaf scale would smear across heterogeneous magnitudes and the
+  payload is tiny anyway — pass through untouched.
+- ``"bf16"`` — per-leaf cast; the cheap half-size format for snapshots
+  that must stay within ~1e-2 of f32 logits.
+
+Quantization runs device-side at push time (no host transfer); consumers
+dequantize ON READ (:func:`dequantize_tree`) and cache the result per
+generation, so the steady-state cost is one fused dequant per publish —
+never per round.  Everything here is pure jnp: it composes with jit,
+donation, and the transfer guard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+QUANT_MODES = ("int8", "bf16")
+
+
+class QuantizedLeaf(NamedTuple):
+    """One compressed array: payload + the metadata to reconstruct it.
+
+    ``scale`` is a float32 scalar for int8 (symmetric, zero-point-free);
+    ``None`` for the bf16 cast.  ``dtype`` is the original dtype's name so
+    dequantization restores the exact leaf dtype the model was built with.
+    """
+
+    q: jnp.ndarray
+    scale: Optional[jnp.ndarray]
+    dtype: str
+
+
+def _is_qleaf(x: Any) -> bool:
+    return isinstance(x, QuantizedLeaf)
+
+
+def _quantize_leaf(x: Any, mode: str) -> Any:
+    if not isinstance(x, (jnp.ndarray, jax.Array)) or not jnp.issubdtype(
+        x.dtype, jnp.floating
+    ):
+        return x
+    if x.ndim <= 1:
+        # f32-sensitive: norms/biases stay exact (and are tiny on the wire)
+        return x
+    if mode == "bf16":
+        return QuantizedLeaf(
+            q=x.astype(jnp.bfloat16), scale=None, dtype=x.dtype.name
+        )
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.maximum(amax / 127.0, jnp.float32(1e-12))
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedLeaf(q=q, scale=scale, dtype=x.dtype.name)
+
+
+def _dequantize_leaf(x: Any) -> Any:
+    if not _is_qleaf(x):
+        return x
+    if x.scale is None:
+        return x.q.astype(jnp.dtype(x.dtype))
+    return (x.q.astype(jnp.float32) * x.scale).astype(jnp.dtype(x.dtype))
+
+
+def quantize_tree(tree: Any, mode: str) -> Any:
+    """Compress every float leaf with ``ndim >= 2``; device-side ops only."""
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"quantize mode must be one of {QUANT_MODES}, got {mode!r}"
+        )
+    return jax.tree_util.tree_map(lambda x: _quantize_leaf(x, mode), tree)
+
+
+def dequantize_tree(tree: Any) -> Any:
+    """Reconstruct a :func:`quantize_tree` snapshot (original dtypes)."""
+    return jax.tree_util.tree_map(
+        _dequantize_leaf, tree, is_leaf=_is_qleaf
+    )
+
+
+def tree_wire_bytes(tree: Any) -> int:
+    """Snapshot payload size in bytes — the broadcast-bandwidth number the
+    int8/bf16 formats exist to shrink (QuantizedLeaf counts q + scale)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree, is_leaf=_is_qleaf):
+        if _is_qleaf(leaf):
+            total += leaf.q.size * leaf.q.dtype.itemsize
+            if leaf.scale is not None:
+                total += 4
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -33,28 +33,10 @@ from collections import deque
 
 from scalerl_tpu.runtime import telemetry
 
-
-def default_buckets(max_batch: int) -> Tuple[int, ...]:
-    """Power-of-two ladder up to (and always including) ``max_batch``."""
-    buckets: List[int] = []
-    b = 1
-    while b < max_batch:
-        buckets.append(b)
-        b *= 2
-    buckets.append(max_batch)
-    return tuple(buckets)
-
-
-def bucket_for(lanes: int, buckets: Tuple[int, ...]) -> int:
-    """Smallest bucket >= lanes; oversize requests get their own
-    next-power-of-two bucket (a rare extra trace, never an error)."""
-    for b in buckets:
-        if lanes <= b:
-            return b
-    b = buckets[-1] if buckets else 1
-    while b < lanes:
-        b *= 2
-    return b
+# The pow2 ladder lives in utils/buckets.py (ISSUE 11: one definition for
+# the serving lanes axis AND the genrl time axis); re-exported here so the
+# serving plane's public names keep working.
+from scalerl_tpu.utils.buckets import bucket_for, default_buckets  # noqa: F401
 
 
 @dataclass
@@ -159,12 +141,47 @@ class DynamicBatcher:
                 else:
                     self._cond.wait(timeout=poll_s)
 
-    def _take_locked(self) -> List[ServingRequest]:
+    def poll_batch(
+        self, max_lanes: Optional[int] = None
+    ) -> Optional[List[ServingRequest]]:
+        """Non-blocking flush: the continuous-batching admission pump.
+
+        Returns a FIFO request batch the moment a flush is *due* — pending
+        lanes can fill ``max_lanes`` (capacity-triggered, the size half of
+        the flush predicate) or the oldest pending request has waited
+        ``max_wait_s`` (the deadline half) — else ``None`` immediately.
+        ``max_lanes`` caps the batch (defaults to ``max_batch``); the
+        caller passes its free-lane count so admission never over-commits.
+        Same whole-request / never-split contract as :meth:`next_batch`.
+        """
+        with self._cond:
+            if not self._pending:
+                return None
+            limit = self.config.max_batch if max_lanes is None else max_lanes
+            if limit <= 0:
+                return None
+            due = self._pending_lanes >= limit or (
+                time.monotonic()
+                >= self._pending[0].t_enqueue + self.config.max_wait_s
+            )
+            if not due:
+                return None
+            if self._pending[0].lanes > limit:
+                # the head request alone overflows the caller's free lanes:
+                # not admissible yet (unlike the serving flush, admission
+                # has a hard lane budget — no oversize bucket to grow into)
+                return None
+            return self._take_locked(limit)
+
+    def _take_locked(
+        self, max_lanes: Optional[int] = None
+    ) -> List[ServingRequest]:
+        limit = self.config.max_batch if max_lanes is None else max_lanes
         batch: List[ServingRequest] = []
         lanes = 0
         while self._pending:
             nxt = self._pending[0]
-            if batch and lanes + nxt.lanes > self.config.max_batch:
+            if batch and lanes + nxt.lanes > limit:
                 break
             batch.append(self._pending.popleft())
             lanes += nxt.lanes

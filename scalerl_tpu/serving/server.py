@@ -172,6 +172,7 @@ class InferenceServer:
             else _live_param_shardings(agent)
         )
         self._params = self._place(_tree_map(jnp_copy, agent.get_weights()))
+        self._quantized = None
         self.generation = 0
         # generation -> learner step at push time (bounded map so a long
         # run never grows it; staleness older than the window reports the
@@ -218,7 +219,12 @@ class InferenceServer:
         return jax.device_put(snapshot, self._param_shardings)
 
     # -- parameter plane ------------------------------------------------
-    def push_params(self, weights, learner_step: Optional[int] = None) -> int:
+    def push_params(
+        self,
+        weights,
+        learner_step: Optional[int] = None,
+        quantize: Optional[str] = None,
+    ) -> int:
         """Publish fresh params: device-side snapshot copy + monotonic
         generation bump (no host transfer — the copy detaches the snapshot
         from the learner's donated buffers, ``param_server.jnp_copy``),
@@ -226,12 +232,23 @@ class InferenceServer:
         the serve fn never recompiles against a stray placement and never
         serves an unsharded gather of an mp-sharded policy).
         Callers with a live mesh wrap this in their dispatch guard.
-        Returns the new generation."""
-        snapshot = self._place(_tree_map(jnp_copy, weights))
+
+        ``quantize="int8" | "bf16"`` stores the compressed snapshot format
+        instead (``runtime/quantize.py`` — the non-learner replica path):
+        the serve-ready tree is dequantized lazily on the first flush after
+        the push and cached until the next one.  Returns the new
+        generation."""
+        if quantize is None:
+            snapshot, qsnap = self._place(_tree_map(jnp_copy, weights)), None
+        else:
+            from scalerl_tpu.runtime.quantize import quantize_tree
+
+            snapshot, qsnap = None, quantize_tree(weights, quantize)
         with self._param_lock:
             self.generation += 1
             gen = self.generation
             self._params = snapshot
+            self._quantized = qsnap
             self._latest_learner_step = (
                 int(learner_step) if learner_step is not None else gen
             )
@@ -242,6 +259,12 @@ class InferenceServer:
 
     def _snapshot_params(self) -> Tuple[Any, int]:
         with self._param_lock:
+            if self._params is None:
+                # dequant-on-read (quantized push): one fused dequant per
+                # publish, re-placed into the live mesh layout, then cached
+                from scalerl_tpu.runtime.quantize import dequantize_tree
+
+                self._params = self._place(dequantize_tree(self._quantized))
             return self._params, self.generation
 
     def observe_staleness(self, served_generation: int) -> float:

@@ -31,14 +31,19 @@ import numpy as np
 from scalerl_tpu.agents.token_ppo import TokenPPOAgent
 from scalerl_tpu.config import GenRLArguments
 from scalerl_tpu.data.sequence_replay import seq_add, seq_init, seq_sample
+from scalerl_tpu.genrl.continuous import ContinuousConfig, ContinuousEngine
 from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
-from scalerl_tpu.genrl.rollout import pack_sequences, sequence_field_shapes
+from scalerl_tpu.genrl.rollout import (
+    pack_completions,
+    pack_sequences,
+    sequence_field_shapes,
+)
 from scalerl_tpu.genrl.task import TokenRecallTask
 from scalerl_tpu.models.transformer import TransformerPolicy
 from scalerl_tpu.ops.pallas_per import resolve_sample_method
 from scalerl_tpu.parallel.train_step import maybe_enable_mesh_from_args
 from scalerl_tpu.runtime import telemetry
-from scalerl_tpu.serving.batcher import bucket_for, default_buckets
+from scalerl_tpu.utils.buckets import bucket_for, default_buckets
 from scalerl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -89,23 +94,46 @@ class SequenceRLTrainer:
         )
         self.agent = agent or TokenPPOAgent(args, build_genrl_model(args))
         maybe_enable_mesh_from_args(self.agent, args)
-        self.engine = GenerationEngine(
-            self.agent.model,
-            self.agent.get_weights(),
-            GenerationConfig(
-                vocab_size=args.vocab_size,
-                max_prompt_len=max(
-                    getattr(self.task, "max_prompt_len", args.prompt_len),
-                    args.prompt_len,
-                ),
-                max_new_tokens=args.max_new_tokens,
-                temperature=args.temperature,
-                top_k=args.top_k,
-                eos_token=args.eos_token,
-                seed=args.seed,
+        base_cfg = dict(
+            vocab_size=args.vocab_size,
+            max_prompt_len=max(
+                getattr(self.task, "max_prompt_len", args.prompt_len),
+                args.prompt_len,
             ),
-            iter_mode=args.genrl_iter_mode,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            eos_token=args.eos_token,
+            seed=args.seed,
         )
+        self.continuous = args.genrl_engine == "continuous"
+        if self.continuous:
+            self.engine = ContinuousEngine(
+                self.agent.model,
+                self.agent.get_weights(),
+                ContinuousConfig(
+                    lanes=args.genrl_lanes or args.genrl_batch,
+                    page_size=args.genrl_page_size,
+                    num_pages=args.genrl_num_pages,
+                    steps_per_macro=args.genrl_macro_steps,
+                    admit_max_wait_s=args.genrl_admit_wait_ms / 1e3,
+                    max_pending=args.genrl_max_pending,
+                    paged_attn=args.genrl_paged_attn,
+                    **base_cfg,
+                ),
+                iter_mode=args.genrl_iter_mode,
+            )
+            # a macro-step can finish more lanes than one learn batch
+            # consumes; extras carry into the next round so insert batches
+            # stay shape-stable (seq_add compiles once per batch size)
+            self._completion_backlog = []
+        else:
+            self.engine = GenerationEngine(
+                self.agent.model,
+                self.agent.get_weights(),
+                GenerationConfig(**base_cfg),
+                iter_mode=args.genrl_iter_mode,
+            )
         # replay geometry is pinned to the engine's LARGEST bucket pair so
         # one buffer covers every round (smaller rounds still land in the
         # max buckets: generate() buckets by the batch's true max length,
@@ -144,8 +172,7 @@ class SequenceRLTrainer:
         )
         return result, rewards
 
-    def train_round(self) -> Dict[str, float]:
-        """One generate -> score -> insert -> sample -> learn round."""
+    def _round_cohort(self):
         result, rewards = self._generate_round()
         if result.prompt_pad != self._prompt_pad or (
             result.response_pad != self._response_pad
@@ -156,6 +183,48 @@ class SequenceRLTrainer:
                 f"{self._prompt_pad}x{self._response_pad})"
             )
         fields, priorities = pack_sequences(result, rewards)
+        return fields, priorities, rewards, result.decode_tokens
+
+    def _round_continuous(self):
+        """One continuous round: keep the lane pool fed, then pack exactly
+        ``genrl_batch`` finished sequences (macro-steps that overshoot bank
+        their extras in the backlog — insert batches stay shape-stable)."""
+        B = self.args.genrl_batch
+        while len(self._completion_backlog) < B:
+            deficit = (
+                B
+                - len(self._completion_backlog)
+                - self.engine.live_lanes
+                - self.engine.pending
+            )
+            if deficit > 0:
+                prompts, lengths = self.task.sample_prompts(
+                    deficit, self._rng
+                )
+                for i in range(deficit):
+                    self.engine.submit(prompts[i], lengths[i])
+            self._completion_backlog.extend(self.engine.step())
+        batch = self._completion_backlog[:B]
+        self._completion_backlog = self._completion_backlog[B:]
+        packed = pack_completions(
+            batch, self._prompt_pad, self._response_pad
+        )
+        rewards = self.task.score(
+            packed.prompts,
+            packed.prompt_len,
+            packed.response_tokens,
+            packed.response_len,
+        )
+        fields, priorities = packed.fields(rewards)
+        return fields, priorities, rewards, packed.decode_tokens
+
+    def train_round(self) -> Dict[str, float]:
+        """One generate -> score -> insert -> sample -> learn round."""
+        fields, priorities, rewards, decode_tokens = (
+            self._round_continuous()
+            if self.continuous
+            else self._round_cohort()
+        )
         self.replay = seq_add(self.replay, fields, (), priorities)
         self._sample_key, sub = jax.random.split(self._sample_key)
         batch, _core, _idx, weights = seq_sample(
@@ -183,7 +252,7 @@ class SequenceRLTrainer:
             self._kl_gauge.set(metrics["kl_ref"])
         metrics["round_reward"] = mean_reward
         metrics["staleness"] = staleness
-        metrics["decode_tokens"] = float(result.decode_tokens)
+        metrics["decode_tokens"] = float(decode_tokens)
         self.reward_history.append(mean_reward)
         return metrics
 

@@ -1,0 +1,86 @@
+"""Shared pow2 bucket ladder (ISSUE 11 satellite): one definition in
+utils/buckets.py, direct unit tests, and the serving batcher's new
+non-blocking ``poll_batch`` admission pump built on the same predicate.
+"""
+
+import time
+
+from scalerl_tpu.serving.batcher import (
+    DynamicBatcher,
+    ServingConfig,
+    ServingRequest,
+)
+from scalerl_tpu.utils.buckets import bucket_for, default_buckets
+
+
+def test_default_buckets_pow2_ladder():
+    assert default_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+    # non-pow2 max is always included as the top rung
+    assert default_buckets(6) == (1, 2, 4, 6)
+    assert default_buckets(1) == (1,)
+
+
+def test_bucket_for_smallest_cover():
+    buckets = (1, 2, 4, 8)
+    assert bucket_for(1, buckets) == 1
+    assert bucket_for(3, buckets) == 4
+    assert bucket_for(8, buckets) == 8
+
+
+def test_bucket_for_oversize_grows_pow2():
+    assert bucket_for(9, (1, 2, 4, 8)) == 16
+    assert bucket_for(33, (1, 2, 4, 8)) == 64
+    assert bucket_for(5, ()) == 8  # empty ladder degrades to pure pow2
+
+
+def test_serving_reexports_are_the_shared_util():
+    import scalerl_tpu.serving.batcher as batcher_mod
+    import scalerl_tpu.utils.buckets as buckets_mod
+
+    assert batcher_mod.bucket_for is buckets_mod.bucket_for
+    assert batcher_mod.default_buckets is buckets_mod.default_buckets
+
+
+def _req(lanes=1):
+    return ServingRequest(conn=None, req_id=None, lanes=lanes, payload={})
+
+
+def test_poll_batch_not_due_before_deadline():
+    b = DynamicBatcher(ServingConfig(max_batch=8, max_wait_s=60.0))
+    b.submit(_req())
+    assert b.poll_batch(max_lanes=8) is None  # 1 lane < 8, deadline far
+
+
+def test_poll_batch_due_by_size_and_capped():
+    b = DynamicBatcher(ServingConfig(max_batch=8, max_wait_s=60.0))
+    for _ in range(5):
+        b.submit(_req())
+    batch = b.poll_batch(max_lanes=3)  # 5 pending >= 3 free lanes: due
+    assert len(batch) == 3  # ... and capped at the caller's free lanes
+    assert b.stats()["pending_requests"] == 2
+
+
+def test_poll_batch_due_by_deadline():
+    b = DynamicBatcher(ServingConfig(max_batch=8, max_wait_s=0.005))
+    b.submit(_req())
+    time.sleep(0.01)
+    batch = b.poll_batch(max_lanes=8)
+    assert batch is not None and len(batch) == 1
+
+
+def test_poll_batch_head_overflow_returns_none():
+    """Unlike the serving flush (oversize requests get their own bucket),
+    admission has a hard lane budget: a head request bigger than the free
+    lanes is not admissible and poll returns None without popping."""
+    b = DynamicBatcher(ServingConfig(max_batch=8, max_wait_s=0.0))
+    b.submit(_req(lanes=4))
+    assert b.poll_batch(max_lanes=2) is None
+    assert b.stats()["pending_requests"] == 1
+    assert len(b.poll_batch(max_lanes=4)) == 1
+
+
+def test_poll_batch_zero_lanes_and_empty_queue():
+    b = DynamicBatcher(ServingConfig(max_batch=8, max_wait_s=0.0))
+    assert b.poll_batch(max_lanes=4) is None  # empty queue
+    b.submit(_req())
+    assert b.poll_batch(max_lanes=0) is None  # no free lanes

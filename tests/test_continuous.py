@@ -1,0 +1,343 @@
+"""Continuous-batching decode plane (ISSUE 11): fixed-cohort parity at
+temperature 0, the one-batched-transfer-per-macro-step discipline, zero
+retraces after warmup, EOS/variable-length harvesting, page exhaustion
+backpressure, fragmentation independence, quantized snapshot pushes, and
+the trainer riding either engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalerl_tpu.config import GenRLArguments
+from scalerl_tpu.genrl.continuous import (
+    CompletedSequence,
+    ContinuousConfig,
+    ContinuousEngine,
+)
+from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
+from scalerl_tpu.genrl.rollout import pack_completions, sequence_field_shapes
+from scalerl_tpu.models.transformer import TransformerPolicy
+from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer
+
+V = 11
+P_MAX, R_MAX = 6, 4
+
+
+def _model():
+    return TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=32, num_heads=2,
+        num_layers=1, max_len=16,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """One model + one fixed engine + one continuous engine, both greedy
+    (temperature 0), plus the fixed engine's reference round — shared by
+    the parity / transfer / retrace / fragmentation tests to keep compiles
+    off the tier-1 clock."""
+    m = _model()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    rng = np.random.default_rng(1)
+    prompts = rng.integers(2, V, size=(5, P_MAX)).astype(np.int32)
+    lengths = np.array([6, 4, 3, 2, 1], np.int32)
+    fixed = GenerationEngine(
+        m, params,
+        GenerationConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7,
+        ),
+    )
+    ref = fixed.generate(prompts, lengths)
+    cont = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7, lanes=4, page_size=4,
+            steps_per_macro=3,
+        ),
+    )
+    return dict(
+        model=m, params=params, prompts=prompts, lengths=lengths,
+        fixed=fixed, ref=ref, cont=cont,
+    )
+
+
+def _by_prompt(completions):
+    return {tuple(c.prompt.tolist()): c for c in completions}
+
+
+def test_greedy_parity_fixed_vs_continuous(setup):
+    """The acceptance pin: at temperature 0 the continuous engine's
+    token-level outputs for any single sequence are IDENTICAL to the
+    fixed-cohort path (exact tokens, 1e-5 behavior logprobs) — through a
+    completely different cache layout (paged vs dense, right- vs
+    left-padded prompts)."""
+    cont, ref = setup["cont"], setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    for i in range(5):
+        cont.submit(prompts[i], lengths[i])
+    done = _by_prompt(cont.run_until(5, max_macro_steps=60))
+    for i in range(5):
+        c = done[tuple(prompts[i][: lengths[i]].tolist())]
+        n = int(ref.response_len[i])
+        np.testing.assert_array_equal(
+            c.response_tokens, ref.response_tokens[i, :n]
+        )
+        np.testing.assert_allclose(
+            c.behavior_logp, ref.behavior_logp[i, :n], atol=1e-5
+        )
+        np.testing.assert_allclose(c.values, ref.values[i, :n], atol=1e-5)
+        assert c.generation == 0
+    # every page and reservation came back when the lanes drained
+    assert cont.allocator.allocated_pages == 0
+    assert cont.allocator.reserved == 0
+
+
+def test_one_batched_transfer_per_macro_step(setup, monkeypatch):
+    """The macro-step discipline, counted at the module seams: a step
+    with admission = one prefill upload + one table upload + ONE batched
+    read; a steady step (no admission) = one upload + ONE read — all
+    under the armed ``steady_state_guard`` (the engine is warm)."""
+    import scalerl_tpu.genrl.continuous as cont_mod
+
+    cont = setup["cont"]
+    assert cont._warm  # the parity round armed the guard
+    puts, gets = [], []
+    real_put, real_get = cont_mod._device_put, cont_mod._device_get
+    monkeypatch.setattr(
+        cont_mod, "_device_put", lambda x: (puts.append(1), real_put(x))[1]
+    )
+    monkeypatch.setattr(
+        cont_mod, "_device_get", lambda x: (gets.append(1), real_get(x))[1]
+    )
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    cont.submit(prompts[0], lengths[0])
+    cont.submit(prompts[1], lengths[1])
+    cont.step()  # admits both (one bucket group each) + decodes
+    n_prefill_puts = len(puts) - 1  # the last put is the decode table
+    assert len(gets) == 1
+    assert n_prefill_puts in (1, 2)  # one per (prompt-bucket) group
+    while cont.live_lanes or cont.pending:
+        puts.clear()
+        gets.clear()
+        cont.step()  # steady: no admission pending
+        assert (len(puts), len(gets)) == (1, 1)
+
+
+def test_zero_retraces_after_warmup(setup):
+    """The decode macro-step program traced exactly ONCE across every
+    round so far (fixed lane count + static paged shapes), and re-running
+    warm bucket admissions adds no prefill traces either."""
+    cont = setup["cont"]
+    assert cont._decode_traces == 1
+    prefill_programs = len(cont._prefill_fns)
+    assert cont._prefill_traces == prefill_programs
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    for i in range(5):
+        cont.submit(prompts[i], lengths[i])
+    cont.run_until(5, max_macro_steps=60)
+    assert cont._decode_traces == 1
+    assert cont._prefill_traces == len(cont._prefill_fns)
+
+
+def test_fragmentation_independence_of_results(setup):
+    """After admit/finish churn has fragmented the page pool, the same
+    prompt still decodes to the same greedy tokens as the fixed-cohort
+    reference — results never depend on the physical page layout."""
+    cont, ref = setup["cont"], setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    rng = np.random.default_rng(9)
+    # churn: interleaved ragged admissions fragment the LIFO free list
+    for i in range(7):
+        n = int(rng.integers(1, P_MAX + 1))
+        cont.submit(rng.integers(2, V, size=n).astype(np.int32), n)
+    cont.run_until(7, max_macro_steps=80)
+    cont.submit(prompts[0], lengths[0])
+    done = cont.run_until(1, max_macro_steps=40)
+    n = int(ref.response_len[0])
+    np.testing.assert_array_equal(
+        done[0].response_tokens, ref.response_tokens[0, :n]
+    )
+
+
+def test_quantized_push_params_logits_parity(setup):
+    """push_params(quantize="int8") stores the compressed snapshot and
+    dequantizes on read: greedy decode tokens are unchanged and behavior
+    logprobs stay within the int8 tolerance; the dequant is cached per
+    generation."""
+    m, params = setup["model"], setup["params"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    ref = setup["ref"]
+    eng = setup["fixed"]
+    gen = eng.push_params(params, quantize="int8")
+    assert gen == 1
+    snap1, _ = eng._snapshot_params()
+    snap2, _ = eng._snapshot_params()
+    assert snap1 is snap2  # dequant-on-read cached until the next push
+    r = eng.generate(prompts, lengths)
+    assert r.generation == 1
+    np.testing.assert_array_equal(r.response_tokens, ref.response_tokens)
+    np.testing.assert_allclose(
+        r.behavior_logp, ref.behavior_logp, atol=5e-2
+    )
+    # bf16 mode is tighter
+    eng.push_params(params, quantize="bf16")
+    r = eng.generate(prompts, lengths)
+    np.testing.assert_allclose(
+        r.behavior_logp, ref.behavior_logp, atol=5e-2
+    )
+    # the serving plane exposes the same knob (non-learner replicas)
+    import inspect
+
+    from scalerl_tpu.serving.server import InferenceServer
+
+    assert "quantize" in inspect.signature(
+        InferenceServer.push_params
+    ).parameters
+
+
+def test_eos_latch_variable_lengths_and_page_return():
+    """With an EOS id and temperature 1, lanes finish at ragged lengths;
+    harvested sequences end in EOS (when short of budget), pages return
+    immediately, and more sequences than lanes flow through."""
+    m = _model()
+    params = m.init(jax.random.PRNGKey(1), jnp.zeros((1, 2), jnp.int32))
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=1.0, eos_token=1, seed=3, lanes=3, page_size=2,
+            steps_per_macro=2,
+        ),
+    )
+    rng = np.random.default_rng(5)
+    for _ in range(8):
+        n = int(rng.integers(1, P_MAX + 1))
+        eng.submit(rng.integers(2, V, size=n).astype(np.int32), n)
+    done = eng.run_until(8, max_macro_steps=200)
+    assert len(done) == 8
+    for c in done:
+        r = len(c.response_tokens)
+        assert 1 <= r <= R_MAX
+        assert len(c.behavior_logp) == r and len(c.values) == r
+        if r < R_MAX:
+            assert c.response_tokens[-1] == 1  # latched on sampling EOS
+        assert c.finish_time >= c.admit_time >= c.submit_time
+    assert eng.allocator.allocated_pages == 0
+    assert eng.allocator.reserved == 0
+    assert eng.completed_total == 8
+    assert 0.0 < eng.mean_occupancy <= 1.0
+
+
+def test_page_exhaustion_backpressure_and_shedding():
+    """A pool that fits ONE worst-case sequence serializes admission
+    (backpressure through the queue, lanes idle), the queue bound sheds,
+    and everything still completes without corruption."""
+    m = _model()
+    params = m.init(jax.random.PRNGKey(2), jnp.zeros((1, 2), jnp.int32))
+    # worst case = ceil((6 + 4) / 4) = 3 pages; capacity 3 -> 1 sequence
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=0, lanes=2, page_size=4, num_pages=4,
+            steps_per_macro=2, max_pending=2,
+        ),
+    )
+    rng = np.random.default_rng(6)
+    p = rng.integers(2, V, size=(3, P_MAX)).astype(np.int32)
+    assert eng.submit(p[0], P_MAX)
+    assert eng.submit(p[1], P_MAX)
+    assert not eng.submit(p[2], P_MAX)  # queue at max_pending: shed
+    assert eng._batcher.shed_total == 1
+    done = eng.run_until(2, max_macro_steps=100)
+    assert len(done) == 2
+    # the pool never over-committed: one sequence's pages at a time
+    assert eng.allocator.capacity == 3
+    assert eng.allocator.allocated_pages == 0 and eng.allocator.reserved == 0
+
+
+def test_pack_completions_layout_and_fields():
+    c0 = CompletedSequence(
+        prompt=np.array([5, 6, 7], np.int32), prompt_len=3,
+        response_tokens=np.array([8, 9], np.int32),
+        behavior_logp=np.array([-0.5, -0.7], np.float32),
+        values=np.array([0.1, 0.2], np.float32),
+        generation=2, submit_time=0.0, admit_time=1.0, finish_time=2.0,
+    )
+    c1 = CompletedSequence(
+        prompt=np.array([4], np.int32), prompt_len=1,
+        response_tokens=np.array([3, 3, 3, 3], np.int32),
+        behavior_logp=np.full(4, -1.0, np.float32),
+        values=np.zeros(4, np.float32),
+        generation=5, submit_time=0.0, admit_time=0.0, finish_time=0.0,
+    )
+    packed = pack_completions([c0, c1], prompt_pad=4, response_pad=4)
+    # task layout: right-padded prompts; learner layout: left-padded seqs
+    np.testing.assert_array_equal(packed.prompts[0], [5, 6, 7, 0])
+    np.testing.assert_array_equal(packed.sequences[0], [0, 5, 6, 7, 8, 9, 0, 0])
+    np.testing.assert_array_equal(packed.mask[0], [1, 1, 0, 0])
+    np.testing.assert_array_equal(packed.response_len, [2, 4])
+    np.testing.assert_array_equal(packed.generations, [2, 5])
+    assert packed.decode_tokens == 6
+    fields, prios = packed.fields(np.array([0.5, 1.0], np.float32))
+    assert set(fields) == set(sequence_field_shapes(4, 4))
+    np.testing.assert_array_equal(fields["generation"], [2, 5])
+    np.testing.assert_array_equal(prios, [1.0, 1.0])
+    with pytest.raises(ValueError):
+        pack_completions([c0], prompt_pad=2, response_pad=4)  # overflow
+    with pytest.raises(ValueError):
+        packed.fields(np.zeros(3, np.float32))  # wrong reward batch
+
+
+def test_trainer_rides_continuous_engine():
+    """genrl_engine="continuous" swaps the engine under the SAME trainer
+    loop: rounds train, insert batches stay shape-stable via the
+    completion backlog, and staleness/decode metrics flow."""
+    args = GenRLArguments(
+        seed=3, vocab_size=8, prompt_len=4, max_new_tokens=4,
+        d_model=32, n_layers=1, n_heads=2,
+        genrl_batch=8, genrl_sample_batch=8, genrl_buffer_sequences=16,
+        telemetry_interval_s=0.0, logger_backend="none",
+        genrl_engine="continuous", genrl_lanes=4, genrl_page_size=4,
+        genrl_macro_steps=2,
+    )
+    trainer = SequenceRLTrainer(args)
+    m1 = trainer.train_round()
+    m2 = trainer.train_round()
+    assert np.isfinite(m1["total_loss"]) and np.isfinite(m2["total_loss"])
+    assert m2["decode_tokens"] > 0
+    assert m2["staleness"] >= 0.0
+    assert trainer.engine._decode_traces == 1  # one macro program, ever
+
+
+def test_continuous_config_and_args_validation():
+    base = dict(vocab_size=8, max_prompt_len=4, max_new_tokens=4)
+    with pytest.raises(ValueError):
+        ContinuousConfig(lanes=0, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(page_size=0, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(steps_per_macro=0, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(min_free_lanes=0, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(temperature=-0.1, **base).validate()
+    ContinuousConfig(temperature=0.0, **base).validate()  # greedy is legal
+    argbase = dict(
+        vocab_size=8, prompt_len=4, max_new_tokens=4,
+        telemetry_interval_s=0.0, logger_backend="none",
+    )
+    with pytest.raises(ValueError):
+        GenRLArguments(genrl_engine="paged", **argbase).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(genrl_page_size=0, **argbase).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(genrl_macro_steps=0, **argbase).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(genrl_paged_attn="cuda", **argbase).validate()
+    GenRLArguments(genrl_engine="continuous", **argbase).validate()

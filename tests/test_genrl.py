@@ -265,7 +265,8 @@ def test_generation_config_validation():
     with pytest.raises(ValueError):
         GenerationConfig(vocab_size=1).validate()
     with pytest.raises(ValueError):
-        GenerationConfig(vocab_size=8, temperature=0.0).validate()
+        GenerationConfig(vocab_size=8, temperature=-0.5).validate()
+    GenerationConfig(vocab_size=8, temperature=0.0).validate()  # greedy
     with pytest.raises(ValueError):
         GenerationConfig(vocab_size=8, top_k=9).validate()
     with pytest.raises(ValueError):

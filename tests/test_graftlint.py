@@ -782,3 +782,63 @@ def test_jg001_genrl_per_token_device_get_flags():
     findings = lint(BAD_GENRL_PER_TOKEN_READ, relpath=GENRL)
     assert rules_of(findings) == ["JG001"]
     assert "device_get" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching fixtures (ISSUE 11): the persistent decode loop's
+# admission cycle must read lane state with ONE batched transfer per
+# macro-step — polling per-lane EOS flags from the host between macro-steps
+# is the transfer storm the fixed-cohort engine already designed out, now
+# at lane granularity instead of token granularity
+
+GOOD_CONT_ONE_READ_PER_MACRO_STEP = """
+    import jax
+
+    from scalerl_tpu.runtime.dispatch import get_metrics
+
+    def admission_loop(decode_macro, prefill, state, batcher):
+        while True:
+            state, outputs = decode_macro(state)
+            # ONE sanctioned batched read: tokens, masks AND the EOS/lane
+            # flags all come down together ...
+            host = get_metrics(outputs)
+            free_lanes = [b for b in range(64) if host["done"][b]]
+            # ... and admission decisions are host-side numpy from there
+            batch = batcher.poll_batch(max_lanes=len(free_lanes))
+            if batch:
+                state = prefill(state, batch)
+"""
+
+BAD_CONT_PER_LANE_EOS_READ = """
+    import jax
+
+    def admission_loop(decode_macro, prefill, state, batcher):
+        while True:
+            state, outputs = decode_macro(state)
+            free_lanes = []
+            for lane in range(64):
+                # per-lane host sync of the EOS latch inside the admission
+                # loop: 64 round trips per macro-step where one batched
+                # read carries the whole flag vector
+                if jax.device_get(outputs["done"][lane]):
+                    free_lanes.append(lane)
+            batch = batcher.poll_batch(max_lanes=len(free_lanes))
+            if batch:
+                state = prefill(state, batch)
+"""
+
+
+def test_jg001_continuous_one_batched_read_per_macro_step_is_clean():
+    """The continuous engine's sanctioned macro-step shape — one fused
+    decode dispatch, one batched read, host-side admission — lints clean
+    in the genrl package."""
+    assert lint(GOOD_CONT_ONE_READ_PER_MACRO_STEP, relpath=GENRL) == []
+
+
+def test_jg001_continuous_per_lane_eos_read_flags():
+    """Per-lane device_get of EOS flags inside the admission loop is the
+    continuous-batching JG001 violation: JG001 flags the read at its
+    line."""
+    findings = lint(BAD_CONT_PER_LANE_EOS_READ, relpath=GENRL)
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message

@@ -223,3 +223,44 @@ def test_genrl_bench_artifact_schema(capsys):
 
     ok, median = perf_gate_verdict(result["value"], [result["value"]])
     assert ok and median == result["value"]
+
+
+def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
+    """bench --mode genrl --continuous artifacts carry the like-for-like
+    acceptance comparison (cohort rate + speedup in the SAME artifact) and
+    the continuous-plane observables (lane occupancy, admission latency,
+    page geometry), under their own gate mode ("genrl-continuous") so
+    continuous history never gates fixed-cohort runs.  Runs in-process at
+    a shrunken window/lane count — the full CPU shape is the tpu_watch
+    ``bench-genrl-cont`` step."""
+    import importlib.util
+
+    monkeypatch.setenv("BENCH_GENRL_TARGET_S", "0.4")
+    monkeypatch.setenv("BENCH_GENRL_LANES", "16")
+    monkeypatch.setenv("BENCH_GENRL_RESPONSE", "16")
+    spec = importlib.util.spec_from_file_location(
+        "bench_genrl_cont_mod", REPO / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_genrl_continuous_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ]
+    result = json.loads(lines[-1])
+    assert result["metric"] == "genrl_decode_tokens_per_sec_per_chip"
+    assert result["mode"] == "genrl-continuous"
+    assert result["value"] > 0
+    assert result["value"] == result["decode_tokens_per_sec"]
+    assert result["cohort_decode_tokens_per_sec"] > 0
+    assert result["speedup_vs_cohort"] >= 0
+    assert 0.0 <= result["lane_occupancy_mean"] <= 1.0
+    assert result["admission_latency_p50_ms"] >= 0
+    assert result["admission_latency_p95_ms"] >= (
+        result["admission_latency_p50_ms"]
+    )
+    assert result["lanes"] > 0 and result["page_size"] > 0
+    assert result["pages_capacity"] > 0
+    assert result["completed_sequences"] >= 2
+    assert result["iter_mode"] in ("scan", "unroll")

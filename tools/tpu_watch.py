@@ -388,6 +388,14 @@ def run_payload(n_devices: int = 1) -> None:
         # counted toward the witness quorum like the other bench steps
         ("bench-genrl", [sys.executable, "bench.py", "--mode", "genrl"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # continuous-batching decode plane: the paged-KV lane pool under
+        # Poisson arrivals vs the fixed-cohort engine, like-for-like in
+        # one artifact (mode "genrl-continuous" keeps its own perf-gate
+        # history; the speedup_vs_cohort field is the ISSUE 11 acceptance
+        # comparison, measured fresh every round)
+        ("bench-genrl-cont",
+         [sys.executable, "bench.py", "--mode", "genrl", "--continuous"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
         ("bench-learn", [sys.executable, "bench.py", "--learn"], 1500, env),
