@@ -716,6 +716,162 @@ def _run_genrl_continuous_measurement() -> None:
     print(json.dumps(result_obj))
 
 
+def _run_disagg_measurement() -> None:
+    """``--mode disagg``: the disaggregated dataflow's headline numbers —
+    end-to-end sequences/s through the full wire path (generation hosts
+    behind jax-free shells -> codec-v2 pipe frames -> lease/ack/dedup ->
+    the learner's accepted-sequence queue) and snapshot-push latency
+    (``SequenceLearner.publish`` of an int8-quantized wire snapshot ->
+    first accepted sequence decoded under the new generation).
+
+    Hosts run as in-process threads with REAL fixed-cohort engines: the
+    wire, lease accounting, and quantized snapshot adoption all flow
+    exactly as in the process topology, without charging the bench two
+    jax process spin-ups.
+    """
+    import threading as _threading
+
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.config import GenRLArguments
+    from scalerl_tpu.genrl.disagg import (
+        DisaggConfig,
+        LocalGenerationFleet,
+        SequenceLearner,
+    )
+    from scalerl_tpu.genrl.task import TokenRecallTask
+    from scalerl_tpu.trainer.sequence_rl import (
+        _CohortShellFactory,
+        build_genrl_model,
+    )
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+
+    if on_accel:
+        V, d_model, n_layers, n_heads = 1024, 256, 4, 8
+        P, R, lanes = 128, 128, 32
+        target_s = 10.0
+    else:
+        V, d_model, n_layers, n_heads = 32, 32, 1, 4
+        P, R, lanes = 8, 4, 4
+        target_s = float(os.environ.get("BENCH_DISAGG_TARGET_S", "3.0"))
+
+    args = GenRLArguments(
+        vocab_size=V, prompt_len=P, max_new_tokens=R,
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+        telemetry_interval_s=0.0, logger_backend="none",
+    )
+    task = TokenRecallTask(vocab_size=V, prompt_len=P, response_len=R)
+    model = build_genrl_model(args)
+    params = model.init(
+        jax.random.PRNGKey(0), jax.numpy.zeros((1, 2), jax.numpy.int32)
+    )
+    host_weights = jax.device_get(params)
+
+    rng = np.random.default_rng(0)
+    lease_lock = _threading.Lock()
+    lease_seq = {"i": 0}
+
+    def source():
+        with lease_lock:
+            lease_seq["i"] += 1
+            prompts, lengths = task.sample_prompts(1, rng)
+        n = int(lengths[0])
+        return {
+            "seed": lease_seq["i"],
+            "prompt": prompts[0, :n].astype(np.int32),
+            "length": n,
+        }
+
+    cfg = DisaggConfig(
+        num_hosts=2, lanes_per_host=lanes, upload_batch=lanes,
+        snapshot_quantize="int8", seq_maxsize=16 * lanes,
+    )
+    learner = SequenceLearner(cfg, source)
+    learner.start()
+    t_pub0 = time.perf_counter()
+    learner.publish(host_weights, learner_step=0)
+    quantize_ms = (time.perf_counter() - t_pub0) * 1e3
+    fleet = LocalGenerationFleet(
+        learner, cfg, _CohortShellFactory(args, lanes), use_threads=True
+    )
+    fleet.start()
+
+    def drain_one(timeout=0.2):
+        return learner.get_sequence(timeout=timeout)
+
+    # warmup: both hosts compile their round program off the clock
+    warm = 0
+    warm_deadline = time.monotonic() + 300
+    while warm < 4 * lanes and time.monotonic() < warm_deadline:
+        if drain_one() is not None:
+            warm += 1
+
+    # measured window: accepted sequences over wall clock, with snapshot
+    # pushes fired at quarter-window marks to measure publish->adoption
+    t0 = time.perf_counter()
+    accepted = 0
+    push_lat_ms = []
+    next_push = t0 + target_s / 4
+    pending_push = None  # (generation, t_pub)
+    step_count = 0
+    while time.perf_counter() - t0 < target_s or accepted < 2:
+        s = drain_one()
+        now = time.perf_counter()
+        if s is not None:
+            accepted += 1
+            if pending_push is not None and s["generation"] >= pending_push[0]:
+                push_lat_ms.append((now - pending_push[1]) * 1e3)
+                pending_push = None
+        if pending_push is None and now >= next_push:
+            step_count += 1
+            gen = learner.publish(host_weights, learner_step=step_count)
+            pending_push = (gen, time.perf_counter())
+            next_push = now + target_s / 4
+    elapsed = time.perf_counter() - t0
+    learner.stop()
+    fleet.join()
+
+    result_obj = {
+        "metric": "disagg_sequences_per_sec",
+        "mode": "disagg",
+        "value": round(accepted / elapsed, 2),
+        "unit": f"end-to-end sequences/sec ({platform}, 2 hosts over the "
+        "pipe wire)",
+        "sequences_per_sec": round(accepted / elapsed, 2),
+        "snapshot_push_latency_ms_p50": round(
+            float(np.median(push_lat_ms)), 2
+        )
+        if push_lat_ms
+        else None,
+        "snapshot_push_latency_ms_max": round(max(push_lat_ms), 2)
+        if push_lat_ms
+        else None,
+        "snapshot_quantize_ms": round(quantize_ms, 2),
+        "snapshot_wire_bytes": learner.snapshot_wire_bytes,
+        "snapshot_pushes": step_count,
+        "accepted_sequences": accepted,
+        "duplicates_absorbed": learner.duplicate_sequences
+        + learner.duplicate_leases,
+        "dropped_stale": learner.dropped_sequences,
+        "hosts": cfg.num_hosts,
+        "lanes_per_host": lanes,
+        "vocab": V,
+        "d_model": d_model,
+        "num_layers": n_layers,
+        "prompt_bucket": P,
+        "response_bucket": R,
+        "device_kind": device_kind,
+        "measured_s": round(elapsed, 1),
+    }
+    print(json.dumps(result_obj))
+
+
 def _run_genrl_measurement() -> None:
     """``--mode genrl``: the token-level sequence-RL plane's headline
     numbers — prefill tokens/s/chip and decode tokens/s/chip through the
@@ -903,6 +1059,10 @@ def _run_measurement(
         # the continuous-batching decode plane: paged-KV lane pool under
         # Poisson arrivals, like-for-like vs the fixed-cohort engine
         _run_genrl_continuous_measurement()
+        return
+    if mode == "disagg":
+        # the disaggregated dataflow: generation hosts -> wire -> learner
+        _run_disagg_measurement()
         return
 
     # backend already pinned by __main__ when --cpu; "auto" here just turns
@@ -1315,6 +1475,7 @@ def main(
         else "serving_requests_per_sec" if mode == "serving"
         else "genrl_decode_tokens_per_sec_per_chip"
         if mode in ("genrl", "genrl-continuous")
+        else "disagg_sequences_per_sec" if mode == "disagg"
         else "impala_atari_env_frames_per_sec_aggregate" if mesh_spec
         else "impala_atari_env_frames_per_sec_per_chip"
     )
@@ -1540,10 +1701,10 @@ if __name__ == "__main__":
             if _mi + 1 >= len(sys.argv):
                 raise SystemExit("--mode requires an argument (anakin | sharded)")
             _mode = sys.argv[_mi + 1]
-            if _mode not in ("anakin", "sharded", "serving", "genrl"):
+            if _mode not in ("anakin", "sharded", "serving", "genrl", "disagg"):
                 raise SystemExit(
                     f"unknown --mode {_mode!r}; supported: anakin, sharded, "
-                    "serving, genrl"
+                    "serving, genrl, disagg"
                 )
             if _mode == "genrl" and "--continuous" in sys.argv[1:]:
                 # --mode genrl --continuous: the continuous-batching decode
@@ -1570,6 +1731,8 @@ if __name__ == "__main__":
                             if _mode == "serving"
                             else "genrl_decode_tokens_per_sec_per_chip"
                             if _mode in ("genrl", "genrl-continuous")
+                            else "disagg_sequences_per_sec"
+                            if _mode == "disagg"
                             else "impala_atari_env_frames_per_sec_aggregate"
                             if _argv_mesh() is not None
                             else "impala_atari_env_frames_per_sec_per_chip"

@@ -180,6 +180,10 @@ class RLArguments:
     # Consecutive same-direction pressure verdicts required before acting
     # (scale-down requires one more than scale-up).
     autoscale_hysteresis: int = 2
+    # Generation-tier guard (disaggregated sequence RL): consumed data
+    # staler than this many learner steps (the unified staleness gauge)
+    # is scale-up pressure on the generation fleet.  0 disables the rule.
+    autoscale_max_staleness: float = 0.0
 
     # Pallas kernels (ops/pallas_vtrace.py, ops/pallas_per.py): route the
     # V-trace target computation and the PER priority/sum-tree update
@@ -764,6 +768,22 @@ class GenRLArguments(RLArguments):
     genrl_max_pending: int = 0  # admission queue bound (0 = unbounded)
     genrl_paged_attn: str = "auto"  # pallas | xla | auto (backend)
 
+    # Disaggregated dataflow (genrl/disagg.py, ISSUE 12): N generation
+    # hosts behind jax-free shells stream completed sequences over the
+    # fleet wire into this learner's sequence replay, with quantized
+    # generation-tagged param snapshots flowing back.
+    disagg_hosts: int = 2
+    # Engine-shell admission capacity per host; 0 derives
+    # max(1, genrl_batch // disagg_hosts) so one round's worth of lanes
+    # spreads across the fleet.
+    disagg_lanes_per_host: int = 0
+    disagg_quantize: str = "int8"  # snapshot wire format: int8 | none
+    disagg_upload_batch: int = 4  # completed sequences per uplink frame
+    # How long one train round may wait for the generation fleet to
+    # deliver its sequence batch before raising (a dead fleet must surface
+    # as an error, not a silent hang).
+    disagg_round_timeout_s: float = 120.0
+
     def validate(self) -> None:
         super().validate()
         if self.vocab_size < 4:
@@ -824,6 +844,26 @@ class GenRLArguments(RLArguments):
             raise ValueError(
                 "genrl_paged_attn must be auto | pallas | xla, got "
                 f"{self.genrl_paged_attn!r}"
+            )
+        if self.disagg_hosts < 1:
+            raise ValueError(
+                f"disagg_hosts must be >= 1, got {self.disagg_hosts}"
+            )
+        if self.disagg_lanes_per_host < 0 or self.disagg_upload_batch < 1:
+            raise ValueError(
+                "disagg_lanes_per_host must be >= 0 and "
+                "disagg_upload_batch >= 1, got "
+                f"{self.disagg_lanes_per_host}/{self.disagg_upload_batch}"
+            )
+        if self.disagg_quantize not in ("int8", "none"):
+            raise ValueError(
+                "disagg_quantize must be int8 | none, got "
+                f"{self.disagg_quantize!r}"
+            )
+        if self.disagg_round_timeout_s <= 0:
+            raise ValueError(
+                "disagg_round_timeout_s must be positive, got "
+                f"{self.disagg_round_timeout_s}"
             )
 
 

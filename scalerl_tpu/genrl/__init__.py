@@ -7,22 +7,50 @@ sequence packing into the prioritized sequence replay, and a token-level
 PPO learner with per-token importance ratios against the stored behavior
 logprobs.  ``genrl`` is a graftlint HOT package: the decode loop performs
 exactly ONE batched host read per generation round.
+
+Exports resolve lazily (PEP 562): the engines pull in jax at import time,
+but the disaggregated-dataflow shells (``genrl/disagg.py``) are jax-free by
+design and run in fleet children that must not pay the jax import — so the
+package itself stays import-light and ``scalerl_tpu.genrl.disagg`` can be
+imported without touching the device stack.
 """
 
-from scalerl_tpu.genrl.continuous import (  # noqa: F401
-    CompletedSequence,
-    ContinuousConfig,
-    ContinuousEngine,
-)
-from scalerl_tpu.genrl.engine import (  # noqa: F401
-    GenerationConfig,
-    GenerationEngine,
-    GenerationResult,
-)
-from scalerl_tpu.genrl.paging import PageAllocator  # noqa: F401
-from scalerl_tpu.genrl.rollout import (  # noqa: F401
-    pack_completions,
-    pack_sequences,
-    sequence_field_shapes,
-)
-from scalerl_tpu.genrl.task import TokenRecallTask  # noqa: F401
+from typing import Any
+
+_EXPORTS = {
+    "CompletedSequence": "scalerl_tpu.genrl.continuous",
+    "ContinuousConfig": "scalerl_tpu.genrl.continuous",
+    "ContinuousEngine": "scalerl_tpu.genrl.continuous",
+    "GenerationConfig": "scalerl_tpu.genrl.engine",
+    "GenerationEngine": "scalerl_tpu.genrl.engine",
+    "GenerationResult": "scalerl_tpu.genrl.engine",
+    "PageAllocator": "scalerl_tpu.genrl.paging",
+    "pack_completions": "scalerl_tpu.genrl.rollout",
+    "pack_sequences": "scalerl_tpu.genrl.rollout",
+    "sequence_field_shapes": "scalerl_tpu.genrl.rollout",
+    "TokenRecallTask": "scalerl_tpu.genrl.task",
+    # the disaggregated dataflow (jax-free shells)
+    "CohortEngineShell": "scalerl_tpu.genrl.disagg",
+    "ContinuousEngineShell": "scalerl_tpu.genrl.disagg",
+    "DisaggConfig": "scalerl_tpu.genrl.disagg",
+    "GenerationHost": "scalerl_tpu.genrl.disagg",
+    "GenerationTierExecutor": "scalerl_tpu.genrl.disagg",
+    "LocalGenerationFleet": "scalerl_tpu.genrl.disagg",
+    "SequenceLearner": "scalerl_tpu.genrl.disagg",
+    "disagg_signal_source": "scalerl_tpu.genrl.disagg",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module_name), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

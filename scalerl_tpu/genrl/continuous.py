@@ -140,6 +140,10 @@ class CompletedSequence(NamedTuple):
     submit_time: float
     admit_time: float
     finish_time: float
+    # opaque caller tag carried from submit() to harvest — the disagg
+    # shell routes prompt-lease ids through it so out-of-order completions
+    # still close the lease that admitted them
+    tag: Any = None
 
 
 @dataclass
@@ -158,6 +162,7 @@ class _Lane:
     generation: int = 0
     submit_time: float = 0.0
     admit_time: float = 0.0
+    tag: Any = None
 
 
 class ContinuousEngine(ParamSnapshotPlane):
@@ -270,10 +275,16 @@ class ContinuousEngine(ParamSnapshotPlane):
         )
 
     # -- admission ------------------------------------------------------
-    def submit(self, prompt: np.ndarray, prompt_length: Optional[int] = None) -> bool:
+    def submit(
+        self,
+        prompt: np.ndarray,
+        prompt_length: Optional[int] = None,
+        tag: Any = None,
+    ) -> bool:
         """Queue one prompt for admission; False = shed (queue at
         ``max_pending``).  ``prompt``: 1-D int32 (or the right-padded
-        ``[L0]`` row with an explicit true length)."""
+        ``[L0]`` row with an explicit true length).  ``tag`` rides the lane
+        unchanged and comes back on the :class:`CompletedSequence`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = int(prompt_length) if prompt_length is not None else len(prompt)
         if n < 1 or n > self.config.max_prompt_len:
@@ -285,7 +296,7 @@ class ContinuousEngine(ParamSnapshotPlane):
                 conn=None,
                 req_id=None,
                 lanes=1,
-                payload={"prompt": prompt[:n].copy(), "len": n},
+                payload={"prompt": prompt[:n].copy(), "len": n, "tag": tag},
             )
         )
 
@@ -368,6 +379,7 @@ class ContinuousEngine(ParamSnapshotPlane):
             lane.generation = gen
             lane.submit_time = req.t_enqueue
             lane.admit_time = now
+            lane.tag = req.payload.get("tag")
             self._table[lane_id] = 0
             self._table[lane_id, : len(pages)] = pages
             tokens[row, :n] = prompt
@@ -648,6 +660,7 @@ class ContinuousEngine(ParamSnapshotPlane):
                         submit_time=lane.submit_time,
                         admit_time=lane.admit_time,
                         finish_time=finish,
+                        tag=lane.tag,
                     )
                 )
                 # release the lane: pages + reservation return to the pool

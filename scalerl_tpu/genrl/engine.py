@@ -26,7 +26,6 @@ sampling.  The host dispatches once and reads back once:
 
 from __future__ import annotations
 
-import threading
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -45,8 +44,7 @@ from scalerl_tpu.models.transformer import (
 from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.device_loop import resolve_iter_mode
 from scalerl_tpu.runtime.dispatch import steady_state_guard
-from scalerl_tpu.runtime.param_server import _tree_map, jnp_copy
-from scalerl_tpu.runtime.quantize import dequantize_tree, quantize_tree
+from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
 from scalerl_tpu.utils.buckets import bucket_for, default_buckets
 
 # module seams: tests monkeypatch these to count host transfers and assert
@@ -128,52 +126,6 @@ class GenerationConfig:
             raise ValueError(
                 f"eos_token {self.eos_token} outside vocab {self.vocab_size}"
             )
-
-
-class ParamSnapshotPlane:
-    """Generation-tagged parameter snapshots, optionally quantized.
-
-    The shared parameter half of both generation engines (fixed-cohort and
-    continuous): :meth:`push_params` publishes a device-side snapshot copy
-    with a monotonic generation bump (the ``InferenceServer`` idiom — the
-    copy detaches the snapshot from the learner's donated buffers), and
-    ``_snapshot_params`` hands programs the serve-ready tree.
-
-    ``quantize="int8" | "bf16"`` stores the ROADMAP's compressed broadcast
-    format instead (``runtime/quantize.py``: per-leaf symmetric int8 with
-    f32 scales, or a bf16 cast; 1-D f32-sensitive leaves pass through) and
-    dequantizes ON READ, cached per generation — so a non-learner replica
-    holds the small format at rest and pays one fused dequant per publish.
-    """
-
-    def _init_param_plane(self, params: Any) -> None:
-        self._param_lock = threading.Lock()
-        self._params = _tree_map(jnp_copy, params)
-        self._quantized = None
-        self.generation = 0
-
-    def push_params(self, params: Any, quantize: Optional[str] = None) -> int:
-        """Publish fresh params (device-side copy or quantized snapshot +
-        monotonic generation bump; no host transfer).  Returns the new
-        generation."""
-        if quantize is None:
-            snapshot, qsnap = _tree_map(jnp_copy, params), None
-        else:
-            # round/clip/cast produce fresh buffers, so the quantized tree
-            # is already detached from the learner's donated params
-            snapshot, qsnap = None, quantize_tree(params, quantize)
-        with self._param_lock:
-            self.generation += 1
-            self._params = snapshot
-            self._quantized = qsnap
-            return self.generation
-
-    def _snapshot_params(self) -> Tuple[Any, int]:
-        with self._param_lock:
-            if self._params is None:
-                # dequant-on-read, cached until the next push
-                self._params = dequantize_tree(self._quantized)
-            return self._params, self.generation
 
 
 class GenerationResult(NamedTuple):

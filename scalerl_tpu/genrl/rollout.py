@@ -28,6 +28,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from scalerl_tpu.genrl.engine import GenerationResult
+from scalerl_tpu.runtime import telemetry
 
 
 def sequence_field_shapes(
@@ -146,10 +147,34 @@ def pack_completions(
     pad_token: int = 0,
 ) -> PackedCompletions:
     """Pack ``CompletedSequence``s (variable prompt/response lengths) into
-    the fixed bucket-pair geometry the replay and learner compile against."""
+    the fixed bucket-pair geometry the replay and learner compile against.
+
+    A zero-completion round packs to an empty (``B == 0``) batch — every
+    field keeps its trailing geometry, so callers can branch on ``B``
+    without special-casing shapes.  A completion whose prompt or response
+    exceeds the bucket pair (a foreign host shipped against a different
+    ladder) is SHED — counted in ``genrl.oversize_shed`` and dropped from
+    the packed batch — rather than crashing the learner's ingest loop.
+    """
+    fits = []
+    shed = 0
+    for c in completions:
+        if int(c.prompt_len) > prompt_pad or (
+            len(c.response_tokens) > response_pad
+        ):
+            shed += 1
+            continue
+        fits.append(c)
+    if shed:
+        telemetry.get_registry().counter("genrl.oversize_shed").inc(shed)
+        telemetry.record_event(
+            "oversize_shed",
+            count=shed,
+            prompt_pad=prompt_pad,
+            response_pad=response_pad,
+        )
+    completions = fits
     B = len(completions)
-    if B == 0:
-        raise ValueError("pack_completions needs at least one completion")
     S = prompt_pad + response_pad
     prompts = np.full((B, prompt_pad), pad_token, np.int32)
     sequences = np.full((B, S), pad_token, np.int32)
@@ -163,11 +188,6 @@ def pack_completions(
     for i, c in enumerate(completions):
         n = int(c.prompt_len)
         r = int(len(c.response_tokens))
-        if n > prompt_pad or r > response_pad:
-            raise ValueError(
-                f"completion {i} ({n} prompt / {r} response tokens) "
-                f"exceeds the ({prompt_pad}, {response_pad}) bucket pair"
-            )
         prompts[i, :n] = c.prompt[:n]
         sequences[i, prompt_pad - n : prompt_pad] = c.prompt[:n]
         sequences[i, prompt_pad : prompt_pad + r] = c.response_tokens

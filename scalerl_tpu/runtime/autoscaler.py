@@ -64,6 +64,12 @@ class FleetSignals:
     queue_occupancy: float = 0.0     # 0..1 fill of the results/rollout queue
     shed_delta: float = 0.0          # bounded-admission sheds since last eval
     serving_p95_ms: float = 0.0      # inference-plane latency SLO quantile
+    # generation-tier signal (disaggregated sequence RL): the unified
+    # staleness gauge — learner steps behind the newest param generation in
+    # the consumed data.  High staleness means the generation tier is
+    # underproducing relative to the learner (replay serving old
+    # generations), the scale-up pressure of the sequence-RL triad.
+    snapshot_staleness: float = 0.0
     live_workers: int = 0            # capacity the executor currently runs
 
 
@@ -85,6 +91,10 @@ class AutoscalerConfig:
     # optional serving-plane guard: p95 act latency above this sheds load by
     # draining workers (0 disables the rule)
     serving_p95_slo_ms: float = 0.0
+    # optional generation-tier guard (disaggregated sequence RL): consumed
+    # data staler than this many learner steps means the generation fleet
+    # is underproducing — scale it up (0 disables the rule)
+    max_staleness: float = 0.0
     # anti-flap guards
     up_hysteresis: int = 2           # consecutive starved verdicts before up
     down_hysteresis: int = 3         # consecutive flooded verdicts before down
@@ -111,6 +121,9 @@ class AutoscalerConfig:
             max_workers=getattr(args, "autoscale_max_workers", cls.max_workers),
             interval_s=getattr(args, "autoscale_interval_s", cls.interval_s),
             cooldown_s=getattr(args, "autoscale_cooldown_s", cls.cooldown_s),
+            max_staleness=getattr(
+                args, "autoscale_max_staleness", cls.max_staleness
+            ),
         )
         hyst = int(getattr(args, "autoscale_hysteresis", cfg.up_hysteresis))
         # down is deliberately one verdict slower than up: adding capacity
@@ -194,6 +207,10 @@ class Autoscaler:
             return SCALE_DOWN  # queue depth IS policy lag; don't add to it
         if cfg.serving_p95_slo_ms > 0 and s.serving_p95_ms > cfg.serving_p95_slo_ms:
             return SCALE_DOWN  # inference plane past its SLO
+        if cfg.max_staleness > 0 and s.snapshot_staleness > cfg.max_staleness:
+            # generation tier underproducing: the learner is consuming
+            # sequences from old param generations — add decode capacity
+            return SCALE_UP
         if s.queue_occupancy <= cfg.low_occupancy:
             target = cfg.fps_per_learn_step * s.learn_steps_per_s
             if cfg.fps_per_learn_step <= 0 or s.fps < target:

@@ -1,4 +1,4 @@
-"""Versioned parameter server for actor weight publication.
+"""Versioned parameter distribution: the snapshot plane + the pull server.
 
 Parity target: ``ParameterServer`` (``scalerl/hpc/parameter_server.py:4-33``)
 — a push/pull weight holder — upgraded with what the reference lacked:
@@ -7,13 +7,24 @@ no locking), and zero-copy host snapshots (device->host fetch happens once
 per publish, not once per actor pull).  This is the "weight publication
 without stalls" design of SURVEY.md §7: the learner publishes a snapshot;
 actor pulls never block the train step.
+
+Parameter distribution used to exist three times — ``ParameterServer``
+push/pull, ``InferenceServer.push_params``, and the generation engines'
+``push_params`` — each with its own tagging.  :class:`ParamSnapshotPlane`
+is the ONE idiom all three now share (the ROADMAP snapshot-bus refactor):
+a monotonic *generation* id, a device-side snapshot copy detached from the
+learner's donated buffers, optional quantized storage
+(``runtime/quantize.py``) with dequant-on-read cached per generation, a
+``_place`` hook for sharding-aware re-placement, and a bounded
+generation -> learner-step map backing the unified staleness definition
+(learner steps behind the newest generation; docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import sys
 import threading
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -69,40 +80,156 @@ def _tree_map(fn, tree):
     return fn(tree)
 
 
-class ParameterServer:
+class ParamSnapshotPlane:
+    """Generation-tagged parameter snapshots, optionally quantized.
+
+    The shared distribution idiom (``ParameterServer``, ``InferenceServer``,
+    the generation engines, the disagg learner): :meth:`push_params`
+    publishes a snapshot copy with a monotonic generation bump — the copy
+    detaches the snapshot from the learner's donated buffers — and
+    ``_snapshot_params`` hands consumers the serve-ready tree.
+
+    ``quantize="int8" | "bf16"`` stores the ROADMAP's compressed broadcast
+    format instead (``runtime/quantize.py``: per-leaf symmetric int8 with
+    f32 scales, or a bf16 cast; 1-D f32-sensitive leaves pass through) and
+    dequantizes ON READ, cached per generation — so a non-learner replica
+    holds the small format at rest and pays one fused dequant per publish.
+
+    Subclasses may override :meth:`_place` (sharding-aware re-placement:
+    the ``InferenceServer`` re-places snapshots into the learner's live
+    mesh layout) — it is applied to full-precision pushes AND to the
+    dequantized read.  ``learner_step`` on a push records the bounded
+    generation -> learner-step map that :meth:`staleness_steps` reads: the
+    unified staleness definition is *learner steps behind the newest
+    generation* (docs/OBSERVABILITY.md), and at push-per-step the
+    generation delta equals it for entries that aged out of the map.
+
+    jax-optional by design: full-precision pushes of numpy trees work in
+    processes that never imported jax (``_tree_map``/``jnp_copy`` fall back
+    to stdlib walks); only ``quantize=`` requires jax.
+    """
+
+    _GEN_STEPS_CAP = 64
+
+    def _init_param_plane(self, params: Any) -> None:
+        self._param_lock = threading.Lock()
+        self._params = (
+            self._place(_tree_map(jnp_copy, params))
+            if params is not None
+            else None
+        )
+        self._quantized = None
+        self.generation = 0
+        self._gen_steps: Dict[int, int] = {0: 0}
+        self._latest_learner_step = 0
+
+    def _place(self, snapshot: Any) -> Any:
+        """Placement hook: identity here; sharded consumers re-place the
+        snapshot into their live layout (device-side reshard at worst)."""
+        return snapshot
+
+    def push_params(
+        self,
+        params: Any,
+        learner_step: Optional[int] = None,
+        quantize: Optional[str] = None,
+    ) -> int:
+        """Publish fresh params (device-side copy or quantized snapshot +
+        monotonic generation bump; no host transfer).  Returns the new
+        generation."""
+        if quantize is None:
+            snapshot, qsnap = self._place(_tree_map(jnp_copy, params)), None
+        else:
+            # round/clip/cast produce fresh buffers, so the quantized tree
+            # is already detached from the learner's donated params
+            from scalerl_tpu.runtime.quantize import quantize_tree
+
+            snapshot, qsnap = None, quantize_tree(params, quantize)
+        with self._param_lock:
+            self.generation += 1
+            gen = self.generation
+            self._params = snapshot
+            self._quantized = qsnap
+            self._record_step(gen, learner_step)
+            return gen
+
+    def _record_step(self, gen: int, learner_step: Optional[int]) -> None:
+        """Under the param lock: extend the bounded gen -> step map."""
+        self._latest_learner_step = (
+            int(learner_step) if learner_step is not None else gen
+        )
+        self._gen_steps[gen] = self._latest_learner_step
+        while len(self._gen_steps) > self._GEN_STEPS_CAP:
+            self._gen_steps.pop(min(self._gen_steps))
+
+    def _snapshot_params(self) -> Tuple[Any, int]:
+        with self._param_lock:
+            if self._params is None and self._quantized is not None:
+                # dequant-on-read, cached until the next push
+                from scalerl_tpu.runtime.quantize import dequantize_tree
+
+                self._params = self._place(dequantize_tree(self._quantized))
+            return self._params, self.generation
+
+    def staleness_steps(self, served_generation: int) -> float:
+        """Lag (in learner steps) between the newest pushed params and the
+        generation that produced a transition/sequence — the ONE staleness
+        definition every plane reports (docs/OBSERVABILITY.md).  A
+        generation older than the bounded map reports the generation delta,
+        which equals learner steps at push-per-step."""
+        with self._param_lock:
+            newest = self._latest_learner_step
+            served = self._gen_steps.get(
+                int(served_generation), int(served_generation)
+            )
+        return float(max(newest - served, 0))
+
+
+class ParameterServer(ParamSnapshotPlane):
+    """The DCN fleet's pull endpoint over the shared snapshot plane.
+
+    The bespoke version tagging this class used to carry is gone: the
+    monotonic ``generation`` id, the snapshot copy, and the thread-safety
+    contract all come from :class:`ParamSnapshotPlane` — ``version`` is an
+    alias for the plane's generation.  What remains here is the fleet's
+    *pull* shape: pullers always receive host (numpy) pytrees, with the
+    device->host fetch paid once per publish (``to_host=True``) or lazily
+    on first pull, cached per generation (``to_host=False``).
+    """
+
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._version = 0
-        self._weights: Any = None
+        self._init_param_plane(None)
         self._is_host = True
 
     @property
     def version(self) -> int:
-        with self._lock:
-            return self._version
+        with self._param_lock:
+            return self.generation
 
     def push(self, weights: Any, to_host: bool = True) -> int:
-        """Publish new weights; returns the new version.
+        """Publish new weights; returns the new version (generation).
 
         With ``to_host=True`` the pytree is fetched to numpy once here, so N
         actor pulls cost zero device traffic.  SEED-style learners whose
         actors run device inference should push with ``to_host=False``: the
-        per-step publish is then an async *device-side copy* + version bump
-        (no host sync), and the numpy snapshot is materialized lazily —
+        per-step publish is then the plane's device-side copy + generation
+        bump (no host sync), and the numpy snapshot is materialized lazily —
         once, cached per version — only if some off-host consumer pulls.
         The device copy detaches the snapshot from the learner's buffers:
         mesh learn steps donate their state (``parallel/train_step.py``), so
         storing the live params would leave pullers holding deleted arrays.
         """
         if to_host:
-            weights = _to_host(weights)
+            snapshot = _to_host(weights)
         else:
-            weights = _tree_map(jnp_copy, weights)
-        with self._lock:
-            self._version += 1
-            self._weights = weights
+            snapshot = _tree_map(jnp_copy, weights)
+        with self._param_lock:
+            self.generation += 1
+            self._params = snapshot
+            self._quantized = None
             self._is_host = to_host
-            return self._version
+            self._record_step(self.generation, None)
+            return self.generation
 
     def pull(self, have_version: int = -1) -> Tuple[Optional[Any], int]:
         """Return (numpy weights, version), or (None, version) if current.
@@ -114,14 +241,16 @@ class ParameterServer:
         finishing the in-flight step), so a slow pull never stalls the
         learner's next ``push``.
         """
-        with self._lock:
-            if self._weights is None or have_version == self._version:
-                return None, self._version
-            weights, version, is_host = self._weights, self._version, self._is_host
+        with self._param_lock:
+            if self._params is None or have_version == self.generation:
+                return None, self.generation
+            weights, version, is_host = (
+                self._params, self.generation, self._is_host,
+            )
         if not is_host:
             weights = _to_host(weights)
-            with self._lock:
-                if self._version == version:
-                    self._weights = weights
+            with self._param_lock:
+                if self.generation == version:
+                    self._params = weights
                     self._is_host = True
         return weights, version

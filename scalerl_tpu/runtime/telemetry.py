@@ -782,3 +782,24 @@ def observe_train_metrics(host_metrics: Optional[Mapping[str, Any]]) -> None:
                      nonfinite_grads=nonfinite)
     if nonfinite > 0.0:
         reg.counter("train.nonfinite_grads").inc(nonfinite)
+
+
+def observe_staleness(lag_steps: float, plane: str = "") -> float:
+    """Set the unified ``staleness`` gauge: LEARNER STEPS BEHIND THE NEWEST
+    GENERATION — the one staleness definition every distribution path
+    reports (docs/OBSERVABILITY.md).
+
+    ``serving.staleness``, genrl's generation lag, and the disagg snapshot
+    lag used to each carry their own name and unit; they now all funnel
+    here (computed via ``ParamSnapshotPlane.staleness_steps``, whose
+    bounded generation -> learner-step map converts a served generation tag
+    into learner steps).  ``plane`` additionally stamps
+    ``staleness_plane.<plane>`` so a multi-plane process can still tell the
+    reporters apart; the unified gauge always holds the latest report.
+    """
+    lag = float(max(lag_steps, 0.0))
+    reg = get_registry()
+    reg.gauge("staleness").set(lag)
+    if plane:
+        reg.gauge(f"staleness_plane.{plane}").set(lag)
+    return lag

@@ -52,7 +52,7 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import nullcontext
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -66,7 +66,7 @@ from scalerl_tpu.fleet.transport import (
 )
 from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.runtime.dispatch import steady_state_guard
-from scalerl_tpu.runtime.param_server import _tree_map, jnp_copy
+from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
 from scalerl_tpu.serving.batcher import (
     DynamicBatcher,
     ServingConfig,
@@ -135,7 +135,7 @@ def _pad_lanes(arr: np.ndarray, bucket: int) -> np.ndarray:
     return np.pad(arr, pad)
 
 
-class InferenceServer:
+class InferenceServer(ParamSnapshotPlane):
     """Owns one hot jitted policy on device; serves batched act requests.
 
     ``agent``: any policy-value agent exposing ``.model`` (uniform
@@ -158,7 +158,6 @@ class InferenceServer:
         self._model = agent.model
         self._serve = jax.jit(_make_serve_fn(agent.model))
         self._dispatch_guard = dispatch_guard or nullcontext
-        self._param_lock = threading.Lock()
         # mp-sharded learners serve from their LIVE mesh layout: every
         # pushed snapshot is re-placed into the learner's per-leaf
         # NamedShardings, so the jitted serve fn compiles ONE sharded
@@ -171,14 +170,10 @@ class InferenceServer:
             if param_shardings is not None
             else _live_param_shardings(agent)
         )
-        self._params = self._place(_tree_map(jnp_copy, agent.get_weights()))
-        self._quantized = None
-        self.generation = 0
-        # generation -> learner step at push time (bounded map so a long
-        # run never grows it; staleness older than the window reports the
-        # generation delta, which equals learner steps at push-per-step)
-        self._gen_steps: Dict[int, int] = {0: 0}
-        self._latest_learner_step = 0
+        # snapshot distribution rides the shared ParamSnapshotPlane idiom
+        # (runtime/param_server.py): monotonic generation, device-side
+        # copy through the _place hook, bounded gen -> learner-step map
+        self._init_param_plane(agent.get_weights())
         self._key = jax.random.PRNGKey(self.config.seed)
         self.batcher = DynamicBatcher(self.config)
         self.hub = QueueHub(
@@ -211,75 +206,30 @@ class InferenceServer:
         self._listen_sock = None
 
     def _place(self, snapshot):
-        """Re-place a snapshot into the learner's live NamedShardings (a
-        device->device reshard at worst, never a host transfer); identity
-        on the mp=1 unsharded path."""
+        """ParamSnapshotPlane placement hook: re-place a snapshot into the
+        learner's live NamedShardings (a device->device reshard at worst,
+        never a host transfer — so the serve fn never recompiles against a
+        stray placement and never serves an unsharded gather of an
+        mp-sharded policy); identity on the mp=1 unsharded path.  Applied
+        to full-precision pushes AND the dequant-on-read of a
+        ``push_params(quantize=...)`` snapshot (the non-learner replica
+        path).  Callers with a live mesh wrap ``push_params`` in their
+        dispatch guard."""
         if self._param_shardings is None:
             return snapshot
         return jax.device_put(snapshot, self._param_shardings)
 
-    # -- parameter plane ------------------------------------------------
-    def push_params(
-        self,
-        weights,
-        learner_step: Optional[int] = None,
-        quantize: Optional[str] = None,
-    ) -> int:
-        """Publish fresh params: device-side snapshot copy + monotonic
-        generation bump (no host transfer — the copy detaches the snapshot
-        from the learner's donated buffers, ``param_server.jnp_copy``),
-        re-placed into the learner's live mesh layout when one exists (so
-        the serve fn never recompiles against a stray placement and never
-        serves an unsharded gather of an mp-sharded policy).
-        Callers with a live mesh wrap this in their dispatch guard.
-
-        ``quantize="int8" | "bf16"`` stores the compressed snapshot format
-        instead (``runtime/quantize.py`` — the non-learner replica path):
-        the serve-ready tree is dequantized lazily on the first flush after
-        the push and cached until the next one.  Returns the new
-        generation."""
-        if quantize is None:
-            snapshot, qsnap = self._place(_tree_map(jnp_copy, weights)), None
-        else:
-            from scalerl_tpu.runtime.quantize import quantize_tree
-
-            snapshot, qsnap = None, quantize_tree(weights, quantize)
-        with self._param_lock:
-            self.generation += 1
-            gen = self.generation
-            self._params = snapshot
-            self._quantized = qsnap
-            self._latest_learner_step = (
-                int(learner_step) if learner_step is not None else gen
-            )
-            self._gen_steps[gen] = self._latest_learner_step
-            if len(self._gen_steps) > 64:
-                self._gen_steps.pop(min(self._gen_steps))
-        return gen
-
-    def _snapshot_params(self) -> Tuple[Any, int]:
-        with self._param_lock:
-            if self._params is None:
-                # dequant-on-read (quantized push): one fused dequant per
-                # publish, re-placed into the live mesh layout, then cached
-                from scalerl_tpu.runtime.quantize import dequantize_tree
-
-                self._params = self._place(dequantize_tree(self._quantized))
-            return self._params, self.generation
-
     def observe_staleness(self, served_generation: int) -> float:
         """Lag (in learner steps) between the newest pushed params and the
-        generation that served a transition; sets the staleness gauge.
+        generation that served a transition; sets the staleness gauges
+        (the plane-local ``serving.staleness`` and the unified
+        ``staleness``, one definition everywhere — docs/OBSERVABILITY.md).
         The learner calls this when it consumes a batch, closing the loop:
         generation tags on the acting side become a lag measurement on the
         learning side (the quantity V-trace's rho/c clips absorb)."""
-        with self._param_lock:
-            newest = self._latest_learner_step
-            served = self._gen_steps.get(
-                int(served_generation), int(served_generation)
-            )
-        lag = float(max(newest - served, 0))
+        lag = self.staleness_steps(served_generation)
         self._stale_gauge.set(lag)
+        telemetry.observe_staleness(lag, plane="serving")
         return lag
 
     def slo(self) -> Dict[str, float]:

@@ -23,6 +23,9 @@ other trainer families.
 
 from __future__ import annotations
 
+import threading
+import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -94,6 +97,7 @@ class SequenceRLTrainer:
         )
         self.agent = agent or TokenPPOAgent(args, build_genrl_model(args))
         maybe_enable_mesh_from_args(self.agent, args)
+        self._mesh_lock = threading.Lock()
         base_cfg = dict(
             vocab_size=args.vocab_size,
             max_prompt_len=max(
@@ -162,6 +166,17 @@ class SequenceRLTrainer:
         self._kl_gauge = reg.gauge("genrl.kl_ref")
         self.reward_history: List[float] = []
 
+    def _dispatch_guard(self):
+        """Serialize multi-device dispatch when the agent is meshed (the
+        HostPlaneMixin idiom, graftlint JG002): single-device runs keep
+        the lock-free fast path."""
+        if (
+            getattr(self.agent, "mesh", None) is not None
+            or getattr(self.agent, "_learn_mesh", None) is not None
+        ):
+            return self._mesh_lock
+        return nullcontext()
+
     def _generate_round(self):
         prompts, lengths = self.task.sample_prompts(
             self.args.genrl_batch, self._rng
@@ -225,27 +240,34 @@ class SequenceRLTrainer:
             if self.continuous
             else self._round_cohort()
         )
-        self.replay = seq_add(self.replay, fields, (), priorities)
-        self._sample_key, sub = jax.random.split(self._sample_key)
-        batch, _core, _idx, weights = seq_sample(
-            self.replay,
-            sub,
-            self.args.genrl_sample_batch,
-            method=self._seq_method,
-        )
-        batch = dict(batch)
-        batch["is_weight"] = weights
-        metrics = self.agent.learn(batch)  # ONE batched transfer
+        with self._dispatch_guard():
+            self.replay = seq_add(self.replay, fields, (), priorities)
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            batch, _core, _idx, weights = seq_sample(
+                self.replay,
+                sub,
+                self.args.genrl_sample_batch,
+                method=self._seq_method,
+            )
+            batch = dict(batch)
+            batch["is_weight"] = weights
+            metrics = self.agent.learn(batch)  # ONE batched transfer
         self.learn_steps += 1
         self._learn_meter.mark()
         if self.learn_steps % self.args.genrl_push_every == 0:
-            self.engine.push_params(self.agent.get_weights())
-        # staleness in generations, off the metric that already crossed
-        # the host boundary inside the batched read — no extra transfer
-        staleness = max(
-            float(self.engine.generation) - metrics["mean_generation"], 0.0
+            # learner_step feeds the plane's gen -> step map, so staleness
+            # below reports the UNIFIED definition (learner steps behind
+            # the newest generation, docs/OBSERVABILITY.md)
+            self.engine.push_params(
+                self.agent.get_weights(), learner_step=self.learn_steps
+            )
+        # staleness off the metric that already crossed the host boundary
+        # inside the batched read — no extra transfer
+        staleness = self.engine.staleness_steps(
+            int(round(metrics["mean_generation"]))
         )
         self._stale_gauge.set(staleness)
+        telemetry.observe_staleness(staleness, plane="genrl")
         mean_reward = float(np.mean(rewards))
         self._reward_gauge.set(mean_reward)
         if "kl_ref" in metrics:
@@ -276,3 +298,250 @@ class SequenceRLTrainer:
         summary["final_reward_mean"] = float(np.mean(tail)) if tail else 0.0
         summary["rounds"] = float(len(self.reward_history))
         return summary
+
+
+# ---------------------------------------------------------------------------
+# the disaggregated topology (ISSUE 12): generation fleet -> this learner
+
+
+class _WireCompletion:
+    """Adapter: one wire sequence payload viewed through the
+    ``CompletedSequence`` attribute surface ``pack_completions`` reads."""
+
+    __slots__ = (
+        "prompt", "prompt_len", "response_tokens", "behavior_logp",
+        "values", "generation",
+    )
+
+    def __init__(self, payload: Dict[str, Any]) -> None:
+        self.prompt = np.asarray(payload["prompt"], np.int32)
+        self.prompt_len = int(payload["prompt_len"])
+        self.response_tokens = np.asarray(
+            payload["response_tokens"], np.int32
+        )
+        self.behavior_logp = np.asarray(payload["behavior_logp"], np.float32)
+        self.values = np.asarray(payload["values"], np.float32)
+        self.generation = int(payload["generation"])
+
+
+class _CohortShellFactory:
+    """Picklable engine factory for the generation hosts: builds the
+    token-mode model + fixed-cohort engine from the run args INSIDE the
+    host process — the only seam of the disagg shell that touches jax."""
+
+    def __init__(self, args: GenRLArguments, round_batch: int) -> None:
+        self.args = args
+        self.round_batch = round_batch
+
+    def __call__(self, params: Any, generation: int):
+        from scalerl_tpu.genrl.disagg import CohortEngineShell, _device_ready
+
+        args = self.args
+        engine = GenerationEngine(
+            build_genrl_model(args),
+            _device_ready(params),
+            GenerationConfig(
+                vocab_size=args.vocab_size,
+                max_prompt_len=args.prompt_len,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                eos_token=args.eos_token,
+                seed=args.seed,
+            ),
+            iter_mode=args.genrl_iter_mode,
+        )
+        return CohortEngineShell(
+            engine, self.round_batch, initial_generation=generation
+        )
+
+
+class DisaggSequenceRLTrainer:
+    """Sequence RL over the disaggregated dataflow (``genrl/disagg.py``):
+    ``disagg_hosts`` generation hosts behind jax-free shells stream
+    completed, generation-tagged sequences over the codec-v2 fleet wire
+    into this learner's sequence replay; quantized param snapshots flow
+    back every ``genrl_push_every`` learn steps.  The learn half (replay,
+    token-PPO step, dp×mp mesh) is identical to
+    :class:`SequenceRLTrainer` — disaggregation changes WHERE sequences
+    are born, not how they are learned from.
+
+    ``use_threads=True`` (default) runs the hosts as in-process threads —
+    the wire, lease/ack/dedup, and snapshot protocol all still flow, with
+    no per-host jax process spin-up; ``False`` spawns real host processes
+    (the chaos/soak shape).
+    """
+
+    def __init__(
+        self,
+        args: GenRLArguments,
+        task: Optional[Any] = None,
+        agent: Optional[TokenPPOAgent] = None,
+        engine_factory: Optional[Any] = None,
+        use_threads: bool = True,
+    ) -> None:
+        from scalerl_tpu.genrl.disagg import (
+            DisaggConfig,
+            LocalGenerationFleet,
+            SequenceLearner,
+        )
+        from scalerl_tpu.runtime.param_server import _to_host
+
+        args.validate()
+        self.args = args
+        self._to_host = _to_host
+        self.task = task or TokenRecallTask(
+            vocab_size=args.vocab_size,
+            prompt_len=args.prompt_len,
+            response_len=args.max_new_tokens,
+        )
+        self.agent = agent or TokenPPOAgent(args, build_genrl_model(args))
+        maybe_enable_mesh_from_args(self.agent, args)
+        self._mesh_lock = threading.Lock()
+        self._prompt_pad = bucket_for(
+            args.prompt_len, default_buckets(args.prompt_len)
+        )
+        self._response_pad = bucket_for(
+            args.max_new_tokens, default_buckets(args.max_new_tokens)
+        )
+        self.replay = seq_init(
+            sequence_field_shapes(self._prompt_pad, self._response_pad),
+            (),
+            args.genrl_buffer_sequences,
+        )
+        self._seq_method = resolve_sample_method("auto")
+        self._sample_key = jax.random.PRNGKey(args.seed + 1)
+        lanes = args.disagg_lanes_per_host or max(
+            1, args.genrl_batch // args.disagg_hosts
+        )
+        self.config = DisaggConfig(
+            num_hosts=args.disagg_hosts,
+            lanes_per_host=lanes,
+            upload_batch=args.disagg_upload_batch,
+            snapshot_quantize=args.disagg_quantize,
+            # a shallow accepted-sequence queue + stale-eviction keeps the
+            # consumed data fresh: queue depth IS worst-case staleness
+            seq_maxsize=max(4 * args.genrl_batch, 2 * lanes * args.disagg_hosts),
+        )
+        # the learner owns the prompts: leases carry the task-sampled
+        # tokens so generation hosts stay task-agnostic decode capacity
+        self._lease_rng = np.random.default_rng(args.seed + 2)
+        self._lease_lock = threading.Lock()
+        self._lease_seq = 0
+        self.learner = SequenceLearner(self.config, self._next_lease)
+        self.learner.start()
+        self.learner.publish(
+            self._to_host(self.agent.get_weights()), learner_step=0
+        )
+        self.fleet = LocalGenerationFleet(
+            self.learner,
+            self.config,
+            engine_factory or _CohortShellFactory(args, lanes),
+            use_threads=use_threads,
+        )
+        self.fleet.start()
+        self.learn_steps = 0
+        reg = telemetry.get_registry()
+        self._learn_meter = reg.meter("genrl.learn_steps_per_s")
+        self._reward_gauge = reg.gauge("genrl.mean_reward")
+        self.reward_history: List[float] = []
+
+    def _dispatch_guard(self):
+        """Serialize multi-device dispatch when the agent is meshed (the
+        HostPlaneMixin idiom, graftlint JG002).  Meshed runs should pair
+        this with PROCESS hosts (``use_threads=False``) so generation
+        dispatch lives in its own jax runtime entirely."""
+        if (
+            getattr(self.agent, "mesh", None) is not None
+            or getattr(self.agent, "_learn_mesh", None) is not None
+        ):
+            return self._mesh_lock
+        return nullcontext()
+
+    def _next_lease(self) -> Dict[str, Any]:
+        with self._lease_lock:
+            self._lease_seq += 1
+            seq = self._lease_seq
+            prompts, lengths = self.task.sample_prompts(1, self._lease_rng)
+        n = int(lengths[0])
+        return {
+            "seed": seq,
+            "prompt": prompts[0, :n].astype(np.int32),
+            "length": n,
+        }
+
+    def train_round(self) -> Dict[str, float]:
+        """One disaggregated round: drain ``genrl_batch`` wire sequences
+        from the fleet -> pack -> score -> insert -> sample -> learn ->
+        publish a quantized snapshot."""
+        B = self.args.genrl_batch
+        batch: List[_WireCompletion] = []
+        deadline = time.monotonic() + self.args.disagg_round_timeout_s
+        while len(batch) < B:
+            payload = self.learner.get_sequence(timeout=0.2)
+            if payload is not None:
+                batch.append(_WireCompletion(payload))
+            elif time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"disagg round starved: {len(batch)}/{B} sequences "
+                    f"after {self.args.disagg_round_timeout_s:.0f}s "
+                    f"(live hosts: {self.learner.live_host_count()})"
+                )
+        packed = pack_completions(
+            batch, self._prompt_pad, self._response_pad
+        )
+        rewards = self.task.score(
+            packed.prompts,
+            packed.prompt_len,
+            packed.response_tokens,
+            packed.response_len,
+        )
+        fields, priorities = packed.fields(rewards)
+        with self._dispatch_guard():
+            self.replay = seq_add(self.replay, fields, (), priorities)
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            learn_batch, _core, _idx, weights = seq_sample(
+                self.replay,
+                sub,
+                self.args.genrl_sample_batch,
+                method=self._seq_method,
+            )
+            learn_batch = dict(learn_batch)
+            learn_batch["is_weight"] = weights
+            metrics = self.agent.learn(learn_batch)  # ONE batched transfer
+        self.learn_steps += 1
+        self._learn_meter.mark()
+        if self.learn_steps % self.args.genrl_push_every == 0:
+            self.learner.publish(
+                self._to_host(self.agent.get_weights()),
+                learner_step=self.learn_steps,
+            )
+        staleness = self.learner.observe_consumed(
+            int(round(metrics["mean_generation"]))
+        )
+        mean_reward = float(np.mean(rewards))
+        self._reward_gauge.set(mean_reward)
+        metrics["round_reward"] = mean_reward
+        metrics["staleness"] = staleness
+        metrics["decode_tokens"] = float(packed.decode_tokens)
+        self.reward_history.append(mean_reward)
+        return metrics
+
+    def train(self, rounds: Optional[int] = None) -> Dict[str, float]:
+        rounds = rounds if rounds is not None else self.args.genrl_rounds
+        metrics: Dict[str, float] = {}
+        try:
+            for _ in range(rounds):
+                metrics = self.train_round()
+        finally:
+            self.close()
+        summary = dict(metrics)
+        tail = self.reward_history[-10:]
+        summary["final_reward_mean"] = float(np.mean(tail)) if tail else 0.0
+        summary["rounds"] = float(len(self.reward_history))
+        summary["wire_sequences"] = float(self.learner.total_sequences)
+        return summary
+
+    def close(self) -> None:
+        self.learner.stop()
+        self.fleet.join(timeout=5.0)

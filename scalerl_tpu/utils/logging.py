@@ -57,6 +57,12 @@ def process_index() -> int:
     env = os.environ.get("SCALERL_PROCESS_INDEX")
     if env is not None:
         return int(env)
+    if "jax" not in sys.modules:
+        # jax was never imported, so neither jax.distributed nor a backend
+        # can be initialized — and importing jax here would charge every
+        # jax-free fleet/disagg child the multi-second package import just
+        # to learn the answer is 0
+        return 0
     try:  # multihost: jax.distributed.initialize() recorded a process id
         from jax._src import distributed
 

@@ -50,6 +50,30 @@ def test_steady_signals_hold():
     assert d.action == HOLD and d.reason == "steady"
 
 
+def test_snapshot_staleness_pressure_scales_generation_tier_up():
+    """The generation-tier signal (disaggregated sequence RL): consumed
+    data staler than max_staleness learner steps means the generation
+    fleet is underproducing — scale-up pressure, with the same hysteresis
+    guard as every other rule."""
+    a = _engine(max_staleness=5.0, up_hysteresis=2, low_occupancy=-1.0)
+    stale = FleetSignals(
+        live_workers=4, queue_occupancy=0.5, snapshot_staleness=9.0
+    )
+    assert a.evaluate(stale, now=0.0).action == HOLD  # hysteresis 1/2
+    d = a.evaluate(stale, now=1.0)
+    assert d.action == SCALE_UP
+    # rule disabled (max_staleness=0) or below threshold: no pressure
+    b = _engine(max_staleness=0.0, low_occupancy=-1.0)
+    assert (
+        b.evaluate(stale, now=0.0).action == HOLD
+    )
+    c = _engine(max_staleness=5.0, low_occupancy=-1.0)
+    fresh = FleetSignals(
+        live_workers=4, queue_occupancy=0.5, snapshot_staleness=2.0
+    )
+    assert c.evaluate(fresh, now=0.0).action == HOLD
+
+
 def test_floor_breach_backfills_immediately_bypassing_guards():
     """A preemption wave below min_workers is backfilled with no hysteresis
     and no cooldown — riding the wave, not flapping."""
@@ -196,6 +220,10 @@ def test_config_validation_and_from_args():
     assert cfg.min_workers == 3 and cfg.max_workers == 12
     assert cfg.interval_s == 2.0 and cfg.cooldown_s == 7.0
     assert cfg.up_hysteresis == 2 and cfg.down_hysteresis == 3
+    # the generation-tier staleness guard rides from_args too
+    stale_args = RLArguments(autoscale_max_staleness=8.0)
+    assert AutoscalerConfig.from_args(stale_args).max_staleness == 8.0
+    assert cfg.max_staleness == 0.0  # default: rule disabled
     with pytest.raises(ValueError):
         RLArguments(autoscale_min_workers=5, autoscale_max_workers=4).validate()
     with pytest.raises(ValueError):

@@ -289,9 +289,92 @@ def test_pack_completions_layout_and_fields():
     np.testing.assert_array_equal(fields["generation"], [2, 5])
     np.testing.assert_array_equal(prios, [1.0, 1.0])
     with pytest.raises(ValueError):
-        pack_completions([c0], prompt_pad=2, response_pad=4)  # overflow
-    with pytest.raises(ValueError):
         packed.fields(np.zeros(3, np.float32))  # wrong reward batch
+
+
+def test_pack_completions_zero_round_packs_empty():
+    """A zero-completion round is a legitimate continuous/disagg outcome
+    (every lane mid-decode): the pack is empty but shape-correct, and
+    fields() still produces the replay schema at B=0."""
+    packed = pack_completions([], prompt_pad=4, response_pad=4)
+    assert packed.sequences.shape == (0, 8)
+    assert packed.prompts.shape == (0, 4)
+    assert packed.decode_tokens == 0
+    fields, prios = packed.fields(np.zeros(0, np.float32))
+    assert set(fields) == set(sequence_field_shapes(4, 4))
+    assert all(v.shape[0] == 0 for v in fields.values())
+    assert prios.shape == (0,)
+
+
+def _completion(prompt_len, resp_len, generation, token=3):
+    return CompletedSequence(
+        prompt=np.full(prompt_len, token, np.int32), prompt_len=prompt_len,
+        response_tokens=np.full(resp_len, token, np.int32),
+        behavior_logp=np.full(resp_len, -1.0, np.float32),
+        values=np.zeros(resp_len, np.float32),
+        generation=generation, submit_time=0.0, admit_time=0.0,
+        finish_time=0.0,
+    )
+
+
+def test_pack_completions_backlog_straddles_three_generations():
+    """A backlog batch whose members were admitted under three different
+    param generations keeps the per-sequence tags — the learner's
+    importance ratios see each sequence's true behavior generation."""
+    batch = [_completion(2, 2, g) for g in (3, 4, 5)]
+    packed = pack_completions(batch, prompt_pad=4, response_pad=4)
+    np.testing.assert_array_equal(packed.generations, [3, 4, 5])
+    fields, _ = packed.fields(np.zeros(3, np.float32))
+    np.testing.assert_array_equal(fields["generation"], [3, 4, 5])
+
+
+def test_pack_completions_oversize_sheds_with_counter():
+    """An oversize completion (prompt or response past the bucket pair —
+    a foreign host shipping against a different ladder) is shed with a
+    counter, never a crash; survivors pack normally."""
+    from scalerl_tpu.runtime import telemetry
+
+    before = telemetry.get_registry().counter("genrl.oversize_shed").value
+    batch = [
+        _completion(2, 2, 1),
+        _completion(6, 2, 1),   # prompt overflows prompt_pad=4
+        _completion(2, 9, 1),   # response overflows response_pad=4
+    ]
+    packed = pack_completions(batch, prompt_pad=4, response_pad=4)
+    assert packed.sequences.shape[0] == 1
+    np.testing.assert_array_equal(packed.generations, [1])
+    after = telemetry.get_registry().counter("genrl.oversize_shed").value
+    assert after - before == 2
+    # an all-oversize batch degrades to the empty pack, still no crash
+    packed = pack_completions([_completion(6, 9, 1)], 4, 4)
+    assert packed.sequences.shape[0] == 0
+
+
+def test_submit_tag_rides_to_completion():
+    """submit(tag=...) comes back on the CompletedSequence — the disagg
+    shell's lease routing — even when lanes complete out of order."""
+    m = _model()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=1.0, eos_token=1, seed=7, lanes=2,
+            page_size=2, steps_per_macro=2,
+        ),
+    )
+    rng = np.random.default_rng(11)
+    tags = [f"lease-{i}" for i in range(5)]
+    prompts = {}
+    for t in tags:
+        n = int(rng.integers(1, P_MAX + 1))
+        p = rng.integers(2, V, size=n).astype(np.int32)
+        prompts[t] = p
+        eng.submit(p, n, tag=t)
+    done = eng.run_until(5, max_macro_steps=200)
+    assert sorted(c.tag for c in done) == sorted(tags)
+    for c in done:
+        np.testing.assert_array_equal(c.prompt, prompts[c.tag])
 
 
 def test_trainer_rides_continuous_engine():

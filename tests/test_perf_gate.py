@@ -264,3 +264,44 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     assert result["pages_capacity"] > 0
     assert result["completed_sequences"] >= 2
     assert result["iter_mode"] in ("scan", "unroll")
+
+
+def test_disagg_bench_artifact_schema(capsys, monkeypatch):
+    """bench --mode disagg artifacts carry the disaggregated-dataflow
+    headline (end-to-end sequences/s through the wire) plus the
+    snapshot-push numbers (publish->adoption latency, int8 wire bytes),
+    under their own gate mode so disagg history only gates disagg runs.
+    Runs in-process with a shrunken window — the full CPU shape is the
+    tpu_watch ``bench-disagg`` step."""
+    import importlib.util
+
+    monkeypatch.setenv("BENCH_DISAGG_TARGET_S", "1.0")
+    spec = importlib.util.spec_from_file_location(
+        "bench_disagg_mod", REPO / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_disagg_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ]
+    result = json.loads(lines[-1])
+    assert result["metric"] == "disagg_sequences_per_sec"
+    assert result["mode"] == "disagg"
+    assert result["value"] > 0
+    assert result["value"] == result["sequences_per_sec"]
+    assert result["hosts"] == 2 and result["lanes_per_host"] > 0
+    assert result["snapshot_wire_bytes"] > 0
+    assert result["snapshot_quantize_ms"] >= 0
+    if result["snapshot_pushes"]:
+        assert result["snapshot_push_latency_ms_p50"] > 0
+        assert result["snapshot_push_latency_ms_max"] >= (
+            result["snapshot_push_latency_ms_p50"]
+        )
+    assert result["accepted_sequences"] >= 2
+    # the like-for-like gate treats disagg rows like the other modes
+    from tools.tpu_watch import perf_gate_verdict
+
+    ok, median = perf_gate_verdict(result["value"], [result["value"]])
+    assert ok and median == result["value"]

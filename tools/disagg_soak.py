@@ -1,0 +1,190 @@
+"""Disaggregated-dataflow soak: a seeded preemption wave mid-decode.
+
+The tpu_watch ``disagg-soak`` payload step (non-quorum, like the chaos and
+elastic soaks): a jax-free pipe fleet of 2 generation hosts (scripted
+engines — deterministic payloads, so bit-exactness is checkable) streams
+sequences into a :class:`SequenceLearner`; a seeded ``mass_kill`` wave
+SIGTERMs half the hosts while lanes are mid-decode, and the autoscaler's
+floor rule backfills.  One JSON verdict line gates the step: ``lost``
+sequences (exact unique accounting over the lease ids + the
+(host, epoch, seq) dedup keys), consumer-visible ``duplicates``, and
+``payload_mismatches`` (every accepted byte re-derived from the lease seed).
+
+jax-free on purpose: the generation hosts are spawn children that never
+import jax, so the soak stays bounded (~1 min) even on a tunnel-down CI
+host while still exercising the full wire/lease/ack/drain machinery.
+
+Run: ``python tools/disagg_soak.py`` (options below).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from scalerl_tpu.genrl.disagg import (
+    DisaggConfig,
+    GenerationTierExecutor,
+    LocalGenerationFleet,
+    ScriptedEngineFactory,
+    SequenceLearner,
+    disagg_signal_source,
+    scripted_sequence_payload,
+)
+from scalerl_tpu.runtime import chaos, telemetry
+from scalerl_tpu.runtime.autoscaler import Autoscaler, AutoscalerConfig
+
+RESPONSE_LEN = 8
+VOCAB = 32
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--leases", type=int, default=96)
+    parser.add_argument("--hosts", type=int, default=2)
+    parser.add_argument("--lanes", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument("--kills", type=int, default=0,
+                        help="victims per wave (0 = half the hosts)")
+    parser.add_argument("--warmup", type=int, default=6,
+                        help="sequences collected before the wave lands")
+    parser.add_argument("--deadline-s", type=float, default=240.0)
+    args = parser.parse_args()
+
+    # the wave fires on the FIRST chaos_poll draw (rate 1.0@1) — the soak
+    # lands it deliberately after warmup, so the kill is provably
+    # mid-decode rather than mid-boot
+    os.environ.setdefault(
+        chaos.ENV_VAR, f"{args.seed}:mass_kill=1.0@1,kills={args.kills}"
+    )
+    chaos.clear()
+
+    n = args.leases
+    counter = {"i": 0}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if counter["i"] >= n:
+                return None
+            counter["i"] += 1
+            return {"seed": counter["i"], "length": 4}
+
+    cfg = DisaggConfig(
+        num_hosts=args.hosts,
+        lanes_per_host=args.lanes,
+        upload_batch=1,
+        heartbeat_interval_s=0.5,
+    )
+    learner = SequenceLearner(cfg, source)
+    learner.start()
+    rng = np.random.default_rng(0)
+    weights = {"w": rng.standard_normal((32, 32)).astype(np.float32)}
+    learner.publish(weights, learner_step=0)
+    # slow scripted decode (one token per step + a sleep) so sequences are
+    # genuinely in flight when the wave lands.  spawn, not fork: a
+    # SIGTERMed fork child inherits live pipe fds and lingers (the
+    # elastic_soak verdict); spawn children boot in well under a second
+    # because the shells never import jax.
+    fleet = LocalGenerationFleet(
+        learner,
+        cfg,
+        ScriptedEngineFactory(
+            lanes=args.lanes,
+            response_len=RESPONSE_LEN,
+            tokens_per_step=1,
+            step_sleep_s=0.02,
+            vocab=VOCAB,
+        ),
+        mp_context="spawn",
+        auto_chaos=False,  # the soak times the wave itself (post-warmup)
+    )
+    fleet.start()
+    # max restarts are nobody's job here: the AUTOSCALER's floor rule must
+    # backfill the wave — that is the property this soak certifies
+    autoscaler = Autoscaler(
+        AutoscalerConfig(
+            min_workers=args.hosts,
+            max_workers=2 * args.hosts,
+            interval_s=0.25,
+            cooldown_s=1.0,
+            up_hysteresis=1,
+            down_hysteresis=2,
+            low_occupancy=-1.0,  # floor backfill only (see elastic_soak)
+        ),
+        executor=GenerationTierExecutor(learner, fleet),
+        signal_source=disagg_signal_source(learner),
+    ).start()
+
+    t0 = time.monotonic()
+    seqs = []
+    killed = []
+    try:
+        deadline = t0 + args.deadline_s
+        while len(seqs) < n and time.monotonic() < deadline:
+            s = learner.get_sequence(timeout=0.2)
+            if s is not None:
+                seqs.append(s)
+            if not killed and len(seqs) >= args.warmup:
+                # the seeded wave: half the generation hosts, mid-decode
+                killed = fleet.chaos_poll()
+    finally:
+        autoscaler.stop()
+        learner.stop()
+        fleet.join()
+
+    elapsed = time.monotonic() - t0
+    lease_ids = [s.get("lease_id") for s in seqs]
+    unique = len(set(lease_ids))
+    mismatches = 0
+    for s in seqs:
+        expect = scripted_sequence_payload(
+            s["seed"], RESPONSE_LEN, VOCAB, s["generation"]
+        )
+        for key in ("prompt", "response_tokens", "behavior_logp", "values"):
+            if not np.array_equal(s[key], expect[key]):
+                mismatches += 1
+                break
+    waves = telemetry.get_recorder().events("mass_kill")
+    verdict = {
+        "metric": "disagg_soak",
+        "expected": n,
+        "received": len(seqs),
+        "unique": unique,
+        "lost": n - unique,
+        # duplicates that REACHED the consumer (must be 0: the dedup
+        # layers absorb redelivery); absorbed ones are the design working
+        "duplicates": len(seqs) - unique,
+        "payload_mismatches": mismatches,
+        "absorbed_duplicates": learner.duplicate_sequences
+        + learner.duplicate_leases,
+        "requeued_leases": learner.requeued_leases,
+        "hosts_killed": len(killed),
+        "waves": len(waves),
+        "scale_ups": autoscaler.scale_ups,
+        "scale_downs": autoscaler.scale_downs,
+        "snapshot_wire_bytes": learner.snapshot_wire_bytes,
+        "elapsed_s": round(elapsed, 1),
+        "chaos": os.environ.get(chaos.ENV_VAR, ""),
+    }
+    print(json.dumps(verdict), flush=True)
+    ok = (
+        verdict["lost"] == 0
+        and verdict["duplicates"] == 0
+        and verdict["payload_mismatches"] == 0
+        and len(killed) > 0
+        and autoscaler.scale_ups >= 1
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

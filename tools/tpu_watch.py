@@ -191,6 +191,52 @@ def _elastic_marker(bl, start_offset: int, flap_per_min: float = 10.0) -> str:
         return ""
 
 
+def _disagg_marker(bl, start_offset: int) -> str:
+    """Gate the disagg-soak step on its JSON verdict line.
+
+    ``tools/disagg_soak.py`` prints one ``{"metric": "disagg_soak", ...}``
+    line: lost/duplicated sequences, payload mismatches, and the
+    autoscaler's backfill count after a seeded mid-decode preemption wave.
+    Any loss, consumer-visible duplicate, corrupt payload, or missing
+    backfill marks the outcome ``!disagg(...)``; a clean wave marks
+    ``+disagg``.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        verdict = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "disagg_soak":
+                verdict = obj
+        if not verdict:
+            return ""
+        bad = []
+        if int(verdict.get("lost", 0)) > 0:
+            bad.append(f"lost={verdict['lost']}")
+        if int(verdict.get("duplicates", 0)) > 0:
+            bad.append(f"dup={verdict['duplicates']}")
+        if int(verdict.get("payload_mismatches", 0)) > 0:
+            bad.append(f"corrupt={verdict['payload_mismatches']}")
+        if int(verdict.get("scale_ups", 0)) < 1:
+            bad.append("no-backfill")
+        if bad:
+            bl.write(f"[watcher] DISAGG GATE: {','.join(bad)} — flagging\n")
+            return "!disagg(" + ",".join(bad) + ")"
+        return "+disagg"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] disagg gate failed: {e}\n")
+        return ""
+
+
 def perf_gate_verdict(
     new_value: float, prior_values, threshold: float = 0.2
 ):
@@ -249,6 +295,7 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
             "sharded_train_step_frames_per_sec",
             "serving_requests_per_sec",
             "genrl_decode_tokens_per_sec_per_chip",
+            "disagg_sequences_per_sec",
         }
         result = None
         for line in segment.splitlines():
@@ -344,7 +391,15 @@ def run_payload(n_devices: int = 1) -> None:
         # fleet mark the outcome !elastic(...)
         ("elastic-soak", [sys.executable, "tools/elastic_soak.py"],
          600, dict(env, JAX_PLATFORMS="cpu")),
-        # genrl soak fourth: the hermetic token-PPO e2e (generate -> score
+        # disagg soak: a jax-free pipe fleet of 2 generation hosts + the
+        # sequence learner under a seeded mid-decode mass_kill wave
+        # (tools/disagg_soak.py).  Like the elastic soak it is bounded,
+        # runs tunnel-down, does not count toward the witness quorum, and
+        # its JSON verdict is gated by _disagg_marker: lost/duplicated/
+        # corrupt sequences or a missing backfill mark !disagg(...)
+        ("disagg-soak", [sys.executable, "tools/disagg_soak.py"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
+        # genrl soak: the hermetic token-PPO e2e (generate -> score
         # -> learn on the synthetic recall task, scan/unroll decode parity,
         # reward-improvement threshold).  CPU-pinned and ~1 min (measured
         # well under the step budget — the ISSUE 10 admission condition),
@@ -396,6 +451,12 @@ def run_payload(n_devices: int = 1) -> None:
         ("bench-genrl-cont",
          [sys.executable, "bench.py", "--mode", "genrl", "--continuous"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # disaggregated dataflow: end-to-end sequences/s through the full
+        # generation-host -> wire -> learner path plus snapshot-push
+        # latency for the int8 wire format; perf-gated like-for-like
+        # against disagg-mode history (metric disagg_sequences_per_sec)
+        ("bench-disagg", [sys.executable, "bench.py", "--mode", "disagg"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
         # learner-step-only MFU at the north-star shape (the fused loop's
         # MFU is env-bound by design; this is the train-step number)
         ("bench-learn", [sys.executable, "bench.py", "--learn"], 1500, env),
@@ -439,6 +500,8 @@ def run_payload(n_devices: int = 1) -> None:
                         status = "FAILED" + gate
                 if name == "elastic-soak":
                     status += _elastic_marker(bl, step_start)
+                if name == "disagg-soak":
+                    status += _disagg_marker(bl, step_start)
                 outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
@@ -451,7 +514,9 @@ def run_payload(n_devices: int = 1) -> None:
     if not any(
         status.startswith("ok")
         for name, status in outcomes
-        if name not in ("lint", "chaos-soak", "elastic-soak", "genrl-soak")
+        if name not in (
+            "lint", "chaos-soak", "elastic-soak", "disagg-soak", "genrl-soak"
+        )
     ):
         # nothing TPU-witnessed succeeded (lint, the chaos soak, the
         # elastic soak, and the genrl soak are CPU-only and pass
