@@ -460,7 +460,8 @@ def _run_serving_measurement() -> None:
     if on_accel:
         n_clients, lanes, max_batch, target_s = 16, 16, 256, 10.0
     else:
-        n_clients, lanes, max_batch, target_s = 4, 4, 32, 4.0
+        n_clients, lanes, max_batch = 4, 4, 32
+        target_s = float(os.environ.get("BENCH_SERVING_TARGET_S", "4.0"))
 
     args = ImpalaArguments(
         use_lstm=False, hidden_size=256, rollout_length=8, batch_size=4,
@@ -697,6 +698,9 @@ def _run_genrl_continuous_measurement() -> None:
         "admission_latency_p95_ms": round(
             admit_hist.quantile(0.95) * 1e3, 3
         ),
+        "admission_latency_p99_ms": round(
+            admit_hist.quantile(0.99) * 1e3, 3
+        ),
         "completed_sequences": completed,
         "arrival_rate_per_s": round(rate, 2),
         "shed_total": engine._batcher.shed_total,
@@ -846,6 +850,18 @@ def _run_disagg_measurement() -> None:
         "sequences_per_sec": round(accepted / elapsed, 2),
         "snapshot_push_latency_ms_p50": round(
             float(np.median(push_lat_ms)), 2
+        )
+        if push_lat_ms
+        else None,
+        # real tail quantiles (exact percentile over every sample, not the
+        # reservoir max standing in for one)
+        "snapshot_push_latency_ms_p95": round(
+            float(np.percentile(push_lat_ms, 95)), 2
+        )
+        if push_lat_ms
+        else None,
+        "snapshot_push_latency_ms_p99": round(
+            float(np.percentile(push_lat_ms, 99)), 2
         )
         if push_lat_ms
         else None,

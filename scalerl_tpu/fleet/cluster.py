@@ -84,7 +84,7 @@ from scalerl_tpu.fleet.transport import (
     send_recv,
     wait_readable,
 )
-from scalerl_tpu.runtime import chaos, telemetry
+from scalerl_tpu.runtime import chaos, telemetry, tracing
 from scalerl_tpu.runtime.param_server import ParameterServer
 from scalerl_tpu.runtime.supervisor import (
     DRAIN,
@@ -201,6 +201,8 @@ def worker_loop(
             task = send_recv(conn, {"kind": "task"})
             if task is None:
                 break
+            t_task = time.monotonic()
+            task_ctx = tracing.extract(task)
             want = int(task.get("param_version", -1))
             if want >= 0 and want != version:
                 reply = send_recv(
@@ -211,7 +213,17 @@ def worker_loop(
                     weights = reply["weights"]
                     reg.counter("worker.param_fetches").inc()
             try:
-                result = runner(task, weights, worker_id)
+                # activate the task's trace for the episode: any flight
+                # event recorded inside (env error, chaos injection in this
+                # process) carries the trace id — forensics link both ways
+                with tracing.get_tracer().activate(task_ctx):
+                    result = runner(task, weights, worker_id)
+                if task_ctx is not None:
+                    tracing.record_span(
+                        "task.episode", parent=task_ctx, t_start=t_task,
+                        t_end=time.monotonic(), kind="fleet",
+                        worker=worker_id,
+                    )
             except Exception as exc:  # noqa: BLE001 - funneled upstream
                 reg.counter("worker.errors").inc()
                 conn.send(
@@ -714,8 +726,10 @@ class WorkerServer:
         # fleet telemetry merge point: gathers piggyback compact snapshots
         # on pongs and uploads; the hub's recv pump hands every "telem"
         # payload here, and the aggregator's tree rides the process-wide
-        # registry snapshot under fleet.*
-        self.telemetry = TelemetryAggregator()
+        # registry snapshot under fleet.*.  BOUNDED: elastic churn mints a
+        # fresh source id per respawn, so dead sources must age out instead
+        # of accumulating in the learner's view forever
+        self.telemetry = TelemetryAggregator(max_sources=1024)
         self.hub = QueueHub(
             heartbeat_interval=config.heartbeat_interval_s,
             heartbeat_timeout=config.heartbeat_timeout
@@ -758,6 +772,9 @@ class WorkerServer:
         self._conn_tasks: Dict[Connection, Set[int]] = {}
         self._completed_tasks: "OrderedDict[int, None]" = OrderedDict()
         self._completed_cap = 65536
+        # open per-task root spans (head-sampled at dispatch; closed by the
+        # dedup verdict) — bounded like the completed-task table
+        self._task_traces: "OrderedDict[int, Any]" = OrderedDict()
         self._returned_tasks: Deque[Any] = deque()
         self.requeued_tasks = 0
         self.duplicate_tasks = 0
@@ -1053,6 +1070,18 @@ class WorkerServer:
             if "_task_id" not in task:
                 task["_task_id"] = self._next_task_id
                 self._next_task_id += 1
+                # head-sampled task trace: the root rides the task frame
+                # (dispatch -> worker episode -> upload -> dedup verdict);
+                # a requeued task keeps its original context
+                root = tracing.start_span(
+                    "task", kind="fleet", task=task["_task_id"]
+                )
+                if root.sampled:
+                    self._task_traces[task["_task_id"]] = root
+                    while len(self._task_traces) > self._completed_cap:
+                        _tid, stale = self._task_traces.popitem(last=False)
+                        stale.end(verdict="abandoned")
+                    tracing.inject(task, root)
             tid = task["_task_id"]
             self._outstanding[tid] = (conn, task)
             self._conn_tasks.setdefault(conn, set()).add(tid)
@@ -1109,6 +1138,12 @@ class WorkerServer:
                             if entry is not None:
                                 self._conn_tasks.get(entry[0], set()).discard(tid)
                             dup_task = False
+                        root = self._task_traces.pop(tid, None)
+                    if root is not None:
+                        # the dedup verdict closes the task trace either way
+                        root.end(
+                            verdict="duplicate" if dup_task else "accepted"
+                        )
                     if dup_task:
                         reg.counter("server.duplicate_tasks").inc()
                         continue

@@ -31,7 +31,7 @@ from scalerl_tpu.fleet.transport import (
     open_worker_pipes,
     wait_readable,
 )
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.supervisor import (
     LivenessTracker,
     is_heartbeat,
@@ -206,6 +206,12 @@ class QueueHub:
                     # liveness — consumers never see a heartbeat kind
                     if msg.get("kind") == "ping":
                         self.send(conn, make_pong(msg))
+                    elif "rt" in msg:
+                        # the pong echoes our ping's wall t and adds the
+                        # responder's rt/host: one free clock-skew sample
+                        # per heartbeat, feeding the tracer's per-link
+                        # offset table (tools/trace_report.py alignment)
+                        tracing.observe_pong(msg)
                     continue
                 if self.max_pending > 0:
                     # bounded admission: shed the STALEST queued message so

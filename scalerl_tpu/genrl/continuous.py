@@ -62,7 +62,7 @@ from scalerl_tpu.models.transformer import (
     prompt_attention_mask,
 )
 from scalerl_tpu.ops.pallas_paged_attention import make_paged_attn_fn
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.device_loop import resolve_iter_mode
 from scalerl_tpu.runtime.dispatch import steady_state_guard
 from scalerl_tpu.serving.batcher import (
@@ -583,6 +583,7 @@ class ContinuousEngine(ParamSnapshotPlane):
     def step(self) -> List[CompletedSequence]:
         """One engine cycle: admit -> decode macro-step (ONE dispatch, ONE
         batched read) -> harvest.  Returns the sequences that completed."""
+        t_step0 = time.monotonic()
         self._admit()
         if self.live_lanes == 0:
             return []
@@ -620,7 +621,17 @@ class ContinuousEngine(ParamSnapshotPlane):
                 host = _device_get(outputs)
         self._warm = True
         self.macro_steps += 1
-        return self._harvest(host)
+        completions = self._harvest(host)
+        if tracing.sampling_enabled():
+            # ONE head-sampled span per macro-step/harvest — never per
+            # token, never per lane; stamps are the host monotonic reads
+            # this method already pays (graftlint JG001 good twin)
+            tracing.record_span(
+                "genrl.macro_step", None, t_step0, time.monotonic(),
+                kind="genrl", completed=len(completions),
+                live_lanes=self.live_lanes, occupancy=round(occ, 4),
+            )
+        return completions
 
     def _harvest(self, host: Dict[str, np.ndarray]) -> List[CompletedSequence]:
         mask = np.asarray(host["mask"], np.float32)

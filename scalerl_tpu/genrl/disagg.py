@@ -76,13 +76,13 @@ import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
-from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Deque, Dict, List, Mapping, Optional, Set, Tuple
 
 import numpy as np
 
 from scalerl_tpu.fleet.hub import QueueHub
 from scalerl_tpu.fleet.transport import Connection, PipeConnection
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.autoscaler import FleetSignals
 from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
 from scalerl_tpu.runtime.supervisor import (
@@ -271,6 +271,68 @@ def _device_ready(params: Any) -> Any:
 
 
 # ---------------------------------------------------------------------------
+# tracing helpers: the sequence lifecycle is ONE trace — root opened by the
+# learner at lease issue, every edge a retroactive span off host monotonic
+# stamps (docs/OBSERVABILITY.md "Distributed tracing" has the taxonomy)
+
+# private host-side stamps riding the lease through the engine shells;
+# stripped before a payload goes on the wire
+_T_SUBMIT = "_t_submit"
+_T_RECV = "_t_recv"
+
+
+def _inherit_trace(payload: Dict[str, Any], lease: Mapping[str, Any]) -> None:
+    """Copy the lease's propagated context (and the submit stamp) onto its
+    completion payload, so the host shell can emit the decode edge and the
+    learner/trainer can keep extending the same trace."""
+    ctx = lease.get(tracing.TRACE_KEY)
+    if ctx is not None:
+        payload[tracing.TRACE_KEY] = ctx
+        t_sub = lease.get(_T_SUBMIT)
+        if t_sub is not None:
+            payload[_T_SUBMIT] = t_sub
+
+
+def record_consumption_trace(
+    payloads: List[Dict[str, Any]],
+    t_drain: float,
+    t_add0: float,
+    t_add1: float,
+    t_learn0: float,
+    t_learn1: float,
+    learn_step: int,
+) -> int:
+    """Extend every traced wire payload with the learner-side edges —
+    ``seq.replay_wait`` (accepted-queue dwell), ``seq.seq_add`` (replay
+    insert) and ``seq.learn_step`` (the learn step that consumed it).  All
+    arguments are ``time.monotonic()`` stamps the caller already took
+    around work it already does; returns the number of traces extended.
+    Shared by :class:`~scalerl_tpu.trainer.sequence_rl.
+    DisaggSequenceRLTrainer` and the jax-free soak's consumption loop."""
+    n = 0
+    for p in payloads:
+        ctx = tracing.extract(p)
+        if ctx is None:
+            continue
+        n += 1
+        t_q = p.get("_t_q")
+        if isinstance(t_q, (int, float)):
+            tracing.record_span(
+                "seq.replay_wait", parent=ctx, t_start=float(t_q),
+                t_end=t_drain, kind="disagg",
+            )
+        tracing.record_span(
+            "seq.seq_add", parent=ctx, t_start=t_add0, t_end=t_add1,
+            kind="disagg", step=learn_step,
+        )
+        tracing.record_span(
+            "seq.learn_step", parent=ctx, t_start=t_learn0, t_end=t_learn1,
+            kind="disagg", step=learn_step,
+        )
+    return n
+
+
+# ---------------------------------------------------------------------------
 # engine shells: the duck-typed surface GenerationHost drives
 #
 #   generation: int                      wire generation currently loaded
@@ -360,6 +422,7 @@ class ScriptedSequenceEngine:
                 tid = entry["lease"].get("_task_id")
                 if tid is not None:
                     payload["_task_id"] = tid
+                _inherit_trace(payload, entry["lease"])
                 done.append(payload)
                 del self._live[key]
         return done
@@ -476,6 +539,7 @@ class CohortEngineShell:
             tid = t.get("_task_id")
             if tid is not None:
                 payload["_task_id"] = tid
+            _inherit_trace(payload, t)
             out.append(payload)
         return out
 
@@ -550,6 +614,7 @@ class ContinuousEngineShell:
             tid = lease.get("_task_id")
             if tid is not None:
                 payload["_task_id"] = tid
+            _inherit_trace(payload, lease)
             out.append(payload)
         return out
 
@@ -591,6 +656,9 @@ class GenerationHost:
         self._seq_id = 0
         self._upload_seq = 0
         self._unacked: Dict[int, List[Dict[str, Any]]] = {}
+        # per-upload trace metadata: [(ctx, t_flush), ...] so the ack can
+        # close each sequence's seq.upload edge (flush -> ack, wire + wait)
+        self._unacked_trace: Dict[int, List[Tuple[Any, float]]] = {}
         self._exhausted = False
         self._draining = False
         reg = telemetry.get_registry()
@@ -642,7 +710,17 @@ class GenerationHost:
                 self.conn.send(make_pong(msg))
             return True
         if isinstance(msg, dict) and msg.get("kind") == "seq_ack":
-            self._unacked.pop(int(msg.get("seq", -1)), None)
+            seq = int(msg.get("seq", -1))
+            self._unacked.pop(seq, None)
+            now = time.monotonic()
+            for ctx, t_flush in self._unacked_trace.pop(seq, ()):
+                # the upload edge closes at the ACK, so a reconnect
+                # retransmit shows up as a long seq.upload span — exactly
+                # the causality the critical-path report exists to surface
+                tracing.record_span(
+                    "seq.upload", parent=ctx, t_start=t_flush, t_end=now,
+                    kind="disagg", host=self.host_id,
+                )
             return True
         if isinstance(msg, dict) and msg.get("kind") == DRAIN:
             self._draining = True
@@ -670,6 +748,7 @@ class GenerationHost:
 
     # -- dataflow --------------------------------------------------------
     def _fetch_params(self) -> None:
+        t0 = time.monotonic()
         reply = self._rpc({"kind": "params", "have": self._have_gen})
         if not isinstance(reply, dict) or "weights" not in reply:
             return
@@ -682,6 +761,15 @@ class GenerationHost:
             self.engine.push_params(params, gen)
         self._have_gen = gen
         self._latest_gen = max(self._latest_gen, gen)
+        ctx = tracing.extract(reply)
+        if ctx is not None:
+            # child of the learner's snapshot_publish span: fetch + decode
+            # + engine adoption, one edge per host per generation
+            tracing.record_span(
+                "snapshot.fetch", parent=ctx, t_start=t0,
+                t_end=time.monotonic(), kind="disagg",
+                generation=gen, host=self.host_id,
+            )
 
     def _request_leases(self) -> None:
         want = min(
@@ -696,11 +784,46 @@ class GenerationHost:
             {"kind": "lease", "n": want, "have_gen": self._have_gen}
         )
         self._latest_gen = max(self._latest_gen, int(reply.get("gen", 0)))
+        now = time.monotonic()
         for lease in reply.get("v", []):
             if lease is None:
                 self._exhausted = True
             else:
+                if isinstance(lease, dict) and tracing.TRACE_KEY in lease:
+                    # the queue-wait edge opens here: lease in hand, not
+                    # yet admitted to a lane
+                    lease[_T_RECV] = now
                 self._queued.append(lease)
+
+    def _trace_submit(self, lease: Any) -> Any:
+        """Close the queue-wait edge and stamp the submit time the decode
+        edge starts from (host monotonic stamps only)."""
+        if isinstance(lease, dict):
+            ctx = tracing.extract(lease)
+            if ctx is not None:
+                now = time.monotonic()
+                tracing.record_span(
+                    "seq.queue_wait", parent=ctx,
+                    t_start=float(lease.pop(_T_RECV, now)), t_end=now,
+                    kind="disagg", host=self.host_id,
+                )
+                lease[_T_SUBMIT] = now
+        return lease
+
+    def _trace_harvest(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Close the decode edge (engine submit -> harvested completion;
+        one span per harvested sequence, never per token)."""
+        ctx = tracing.extract(payload)
+        if ctx is not None:
+            t_sub = payload.pop(_T_SUBMIT, None)
+            if t_sub is not None:
+                tracing.record_span(
+                    "seq.decode", parent=ctx, t_start=float(t_sub),
+                    t_end=time.monotonic(), kind="disagg",
+                    host=self.host_id,
+                    tokens=int(np.size(payload.get("response_tokens", ()))),
+                )
+        return payload
 
     def _flush(self, force: bool = False) -> None:
         if not self._completed:
@@ -710,6 +833,13 @@ class GenerationHost:
         batch, self._completed = self._completed, []
         self._upload_seq += 1
         self._unacked[self._upload_seq] = batch
+        now = time.monotonic()
+        traced = [
+            (tracing.extract(p), now) for p in batch
+            if tracing.extract(p) is not None
+        ]
+        if traced:
+            self._unacked_trace[self._upload_seq] = traced
         self._upload_counter.inc()
         self._send(
             {"kind": "seq_batch", "v": batch, "seq": self._upload_seq},
@@ -759,11 +889,15 @@ class GenerationHost:
                 ):
                     self._request_leases()
                 while self._queued and self.engine.capacity() > 0:
-                    self.engine.submit(self._queued.popleft())
+                    self.engine.submit(
+                        self._trace_submit(self._queued.popleft())
+                    )
                 if self.engine.live() > 0:
                     for payload in self.engine.step():
                         self._seq_counter.inc()
-                        self._completed.append(self._stamp(payload))
+                        self._completed.append(
+                            self._stamp(self._trace_harvest(payload))
+                        )
                     self._flush()
                 elif self._exhausted and not self._queued:
                     # source dry, everything decoded: final flush + acks,
@@ -794,8 +928,16 @@ class GenerationHost:
                 if self.engine.live() == 0:
                     break
                 for payload in self.engine.step():
-                    self._completed.append(self._stamp(payload))
+                    self._completed.append(
+                        self._stamp(self._trace_harvest(payload))
+                    )
             returned.extend(self.engine.abandon())
+        for lease in returned:
+            if isinstance(lease, dict):
+                # host-local monotonic stamps are meaningless on the host
+                # that gets the reissue — it re-stamps its own edges
+                lease.pop(_T_RECV, None)
+                lease.pop(_T_SUBMIT, None)
         if returned:
             self._send({"kind": "lease_return", "v": returned})
         self._flush(force=True)
@@ -872,6 +1014,11 @@ class SequenceLearner(ParamSnapshotPlane):
         self._conn_leases: Dict[Connection, Set[int]] = {}
         self._completed_leases: "OrderedDict[int, None]" = OrderedDict()
         self._completed_cap = 65536
+        # open root spans per lease (head-sampled at issue time; closed at
+        # ingest); bounded like the completed-lease table so a lease the
+        # fleet never completes cannot leak a span forever
+        self._trace_roots: "OrderedDict[int, Any]" = OrderedDict()
+        self._snapshot_trace: Optional[Any] = None
         self._returned: Deque[Any] = deque()
         self.requeued_leases = 0
         self.duplicate_leases = 0
@@ -916,6 +1063,7 @@ class SequenceLearner(ParamSnapshotPlane):
         unified staleness definition reads.  Hosts pull lazily (the lease
         reply advertises the newest generation), so N hosts cost one
         quantization, not N."""
+        span = tracing.start_span("snapshot_publish", kind="disagg")
         wire = quantize_wire_tree(host_weights, self.config.snapshot_quantize)
         self.snapshot_wire_bytes = wire_tree_bytes(wire)
         with self._param_lock:
@@ -924,7 +1072,11 @@ class SequenceLearner(ParamSnapshotPlane):
             self._params = wire
             self._quantized = None
             self._record_step(gen, learner_step)
-            return gen
+            # the generation's trace rides every params reply, so each
+            # host's snapshot.fetch span parents back to this publish
+            self._snapshot_trace = span.context if span.sampled else None
+        span.end(generation=gen, wire_bytes=self.snapshot_wire_bytes)
+        return gen
 
     def observe_consumed(self, served_generation: int) -> float:
         """The trainer consumed sequences tagged ``served_generation``:
@@ -1023,6 +1175,18 @@ class SequenceLearner(ParamSnapshotPlane):
             if "_task_id" not in lease:
                 lease["_task_id"] = self._next_task_id
                 self._next_task_id += 1
+                # head sampling happens HERE, once per sequence lifecycle:
+                # the root span rides the lease (and every requeue of it)
+                # as the "trace" wire key; rate 0 keeps this a no-op
+                root = tracing.start_span(
+                    "sequence", kind="disagg", lease=lease["_task_id"]
+                )
+                if root.sampled:
+                    self._trace_roots[lease["_task_id"]] = root
+                    while len(self._trace_roots) > self._completed_cap:
+                        _tid, stale = self._trace_roots.popitem(last=False)
+                        stale.end(outcome="abandoned")
+                    tracing.inject(lease, root)
             tid = lease["_task_id"]
             self._outstanding[tid] = (conn, lease)
             self._conn_leases.setdefault(conn, set()).add(tid)
@@ -1109,14 +1273,13 @@ class SequenceLearner(ParamSnapshotPlane):
         elif kind == "params":
             with self._param_lock:
                 wire, gen = self._params, self.generation
+                snap_trace = self._snapshot_trace
             if wire is None or int(msg.get("have", -1)) == gen:
                 self.hub.send(conn, {"kind": "params", "generation": gen})
             else:
-                self.hub.send(
-                    conn,
-                    {"kind": "params", "generation": gen, "weights": wire},
-                    compress=True,
-                )
+                reply = {"kind": "params", "generation": gen, "weights": wire}
+                tracing.inject(reply, snap_trace)
+                self.hub.send(conn, reply, compress=True)
         elif kind == "seq_batch":
             # ack FIRST: the host retains the batch until this lands;
             # dedup below absorbs any redelivery
@@ -1211,6 +1374,14 @@ class SequenceLearner(ParamSnapshotPlane):
                     reg.counter("disagg.duplicate_leases").inc()
                     continue
                 seq["lease_id"] = tid
+                root = self._trace_roots.pop(tid, None)
+                if root is not None:
+                    # the root span covers lease issue -> accepted ingest;
+                    # the trainer's seq_add/learn_step edges extend the
+                    # trace afterwards (record_consumption_trace)
+                    root.end(host=seq.get("host_id"))
+            if tracing.TRACE_KEY in seq:
+                seq["_t_q"] = time.monotonic()  # replay-wait edge opens
             self.total_sequences += 1
             self._seq_meter.mark()
             try:

@@ -26,6 +26,7 @@ sampling.  The host dispatches once and reads back once:
 
 from __future__ import annotations
 
+import time
 from contextlib import nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
@@ -41,7 +42,7 @@ from scalerl_tpu.models.transformer import (
     prefill_attention_mask,
     sequence_positions,
 )
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.device_loop import resolve_iter_mode
 from scalerl_tpu.runtime.dispatch import steady_state_guard
 from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
@@ -375,6 +376,7 @@ class GenerationEngine(ParamSnapshotPlane):
         and reads the outputs back with a single batched ``_device_get`` —
         armed with ``steady_state_guard()`` once the bucket pair is warm.
         """
+        t_round0 = time.monotonic()
         prompts = np.asarray(prompts, np.int32)
         B, L = prompts.shape
         if prompt_lengths is None:
@@ -427,4 +429,13 @@ class GenerationEngine(ParamSnapshotPlane):
         self._round_counter.inc()
         self._prompt_meter.mark(result.prompt_tokens)
         self._decode_meter.mark(result.decode_tokens)
+        if tracing.sampling_enabled():
+            # ONE head-sampled span per generation round (the whole fused
+            # prefill+decode dispatch + its single batched read) — never
+            # per token; host monotonic stamps only (JG001 good twin)
+            tracing.record_span(
+                "genrl.generate_round", None, t_round0, time.monotonic(),
+                kind="genrl", batch=B, prompt_pad=P, response_pad=R,
+                decode_tokens=int(result.decode_tokens), generation=gen,
+            )
         return result

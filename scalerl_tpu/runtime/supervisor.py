@@ -61,7 +61,16 @@ def make_ping() -> Dict[str, Any]:
 
 
 def make_pong(ping_msg: Dict[str, Any]) -> Dict[str, Any]:
-    return {"kind": PONG, "t": ping_msg.get("t", 0.0)}
+    # echoes the ping's send time and adds the responder's wall clock +
+    # host id: the pinger gets (t_send, t_peer, t_recv) per heartbeat —
+    # exactly the NTP-style sample runtime/tracing.py's ClockSkewEstimator
+    # needs to align multi-host trace timelines, with zero extra traffic
+    return {
+        "kind": PONG,
+        "t": ping_msg.get("t", 0.0),
+        "rt": time.time(),
+        "host": telemetry.host_id(),
+    }
 
 
 def is_heartbeat(msg: Any) -> bool:

@@ -57,6 +57,7 @@ from scalerl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 ENV_DIR = "SCALERL_TELEMETRY_DIR"
+ENV_HOST_ID = "SCALERL_HOST_ID"
 
 # instrument kind tags used by the Prometheus exposition writer
 _KIND_COUNTER = "counter"
@@ -67,6 +68,37 @@ _KIND_METER = "meter"
 
 def _now() -> float:
     return time.monotonic()
+
+
+_HOST_ID: Optional[str] = None
+
+
+def host_id() -> str:
+    """A stable per-process identity for merged multi-host artifacts
+    (flight-event ordering, trace span files): ``SCALERL_HOST_ID`` when the
+    deployment sets one, else ``<hostname>-<pid>`` — distinct per process,
+    stable for the process lifetime."""
+    global _HOST_ID
+    if _HOST_ID is None:
+        env = os.environ.get(ENV_HOST_ID, "")
+        if env:
+            _HOST_ID = env
+        else:
+            import socket as _socket
+
+            _HOST_ID = f"{_socket.gethostname()}-{os.getpid()}"
+    return _HOST_ID
+
+
+# runtime/tracing.py registers its current-trace lookup here, so every
+# flight event recorded while a span is active carries the trace id —
+# without telemetry (imported by everything) importing the tracer
+_TRACE_ID_PROVIDER: Optional[Callable[[], Optional[str]]] = None
+
+
+def set_trace_id_provider(fn: Optional[Callable[[], Optional[str]]]) -> None:
+    global _TRACE_ID_PROVIDER
+    _TRACE_ID_PROVIDER = fn
 
 
 # ---------------------------------------------------------------------------
@@ -408,10 +440,22 @@ class FlightRecorder:
             "t_wall": time.time(),
             "t_mono": time.monotonic(),
             "kind": kind,
+            # merged multi-host timelines (trace_report, soak verdicts)
+            # order on (host_id, seq) — deterministic even when the hosts'
+            # wall clocks disagree
+            "host_id": host_id(),
         }
+        if _TRACE_ID_PROVIDER is not None:
+            try:
+                tid = _TRACE_ID_PROVIDER()
+            except Exception:  # noqa: BLE001 — stamping must never fail a record
+                tid = None
+            if tid:
+                evt["trace"] = tid
         if fields:
             evt.update(fields)
         with self._lock:
+            evt["seq"] = self.total_recorded  # monotonic per process
             self._events.append(evt)
             self.total_recorded += 1
 
@@ -437,8 +481,13 @@ class FlightRecorder:
             f"({self.total_recorded} total recorded, capacity {self.capacity})"
         ]
         for e in evts:
+            # host_id/seq are ordering stamps, constant/monotonic within one
+            # process — noise in a single-process stall dump (trace stays:
+            # it is the cross-reference into the span files)
             extra = {
-                k: v for k, v in e.items() if k not in ("t_wall", "t_mono", "kind")
+                k: v
+                for k, v in e.items()
+                if k not in ("t_wall", "t_mono", "kind", "host_id", "seq")
             }
             stamp = time.strftime("%H:%M:%S", time.localtime(e["t_wall"]))
             lines.append(f"  [{stamp}] {e['kind']} {extra}" if extra
@@ -479,13 +528,22 @@ class TelemetryAggregator:
     series value) plus a last-seen stamp; ``aggregate`` sums each key across
     sources.  ``tree()`` is what the registry binding exposes under
     ``fleet.*`` in the merged snapshot.
+
+    Elastic churn means dead sources: a preempted worker's series would
+    otherwise sit in the learner's view forever (every respawn adds a
+    fresh source id), so the aggregator is BOUNDED — ``max_sources > 0``
+    evicts the stalest source when a new one would exceed the cap, and
+    :meth:`evict_stale` drops every source silent past ``max_age_s``
+    (``age_s`` in the tree is the staleness a human reads).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, max_sources: int = 0) -> None:
         self._lock = threading.Lock()
         self._latest: Dict[str, Dict[str, float]] = {}
         self._seen: Dict[str, float] = {}
         self.frames_absorbed = 0
+        self.max_sources = int(max_sources)
+        self.evicted = 0
 
     def absorb(self, source: str, compact: Mapping[str, Any]) -> None:
         if not isinstance(compact, Mapping):
@@ -499,6 +557,25 @@ class TelemetryAggregator:
             self._latest[str(source)] = clean
             self._seen[str(source)] = time.monotonic()
             self.frames_absorbed += 1
+            while self.max_sources > 0 and len(self._latest) > self.max_sources:
+                stalest = min(self._seen, key=self._seen.get)
+                self._latest.pop(stalest, None)
+                self._seen.pop(stalest, None)
+                self.evicted += 1
+
+    def evict_stale(self, max_age_s: float) -> int:
+        """Drop every source silent for longer than ``max_age_s``; returns
+        the count — the learner's fleet view stays bounded across elastic
+        churn (dead gathers/workers age out instead of accumulating)."""
+        horizon = time.monotonic() - max_age_s
+        dropped = 0
+        with self._lock:
+            for src in [s for s, t in self._seen.items() if t < horizon]:
+                self._latest.pop(src, None)
+                self._seen.pop(src, None)
+                dropped += 1
+            self.evicted += dropped
+        return dropped
 
     def absorb_payload(self, payload: Any) -> None:
         """Absorb one piggybacked ``{"src": ..., "v": {...}, "workers":
@@ -532,6 +609,7 @@ class TelemetryAggregator:
         return {
             "sources": len(per_worker),
             "frames_absorbed": self.frames_absorbed,
+            "evicted": self.evicted,
             "aggregate": self.aggregate(),
             "per_worker": {
                 src: {**snap, "age_s": round(now - seen.get(src, now), 3)}

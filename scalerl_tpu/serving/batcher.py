@@ -81,6 +81,9 @@ class ServingRequest:
     lanes: int
     payload: Dict[str, Any]
     t_enqueue: float = field(default_factory=time.monotonic)
+    # propagated trace context (runtime/tracing.py) when the client's act
+    # request carried one — the server emits queue-wait/flush spans off it
+    trace: Any = None
 
 
 class DynamicBatcher:

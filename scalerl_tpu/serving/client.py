@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from scalerl_tpu.fleet.transport import Connection
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.supervisor import exp_backoff, is_heartbeat, make_pong
 from scalerl_tpu.utils.logging import get_logger
 
@@ -347,21 +347,28 @@ class RemotePolicyClient:
         returns ``(action, logits, new_core)`` as host numpy."""
         if not self.fallen_back:
             self._reg.counter("serving_client.requests").inc()
+            # head-sampled request trace: the context rides the act frame
+            # (the ``trace`` wire key) so the server's queue-wait/flush
+            # spans land in the same trace as this end-to-end span
+            span = tracing.start_span("serve.request", kind="serving")
+            msg = self._act_msg(obs, last_action, reward, done, core_state)
+            tracing.inject(msg, span)
             try:
-                reply = self._rpc(
-                    self._act_msg(obs, last_action, reward, done, core_state)
-                )
+                reply = self._rpc(msg)
             except ServingUnavailable:
+                span.end(outcome="unavailable")
                 if self._fallback is None:
                     raise
                 reply = {"use_fallback": True}
             if not reply.get("use_fallback"):
                 self.generation = int(reply.get("gen", self.generation))
+                span.end(gen=self.generation)
                 return (
                     np.asarray(reply["action"]),
                     np.asarray(reply["logits"]),
                     _as_core(reply.get("core")),
                 )
+            span.end(outcome="fallback")
         # degraded mode: local inference on the fallback policy keeps the
         # env loop alive (the pre-serving topology); guarded — under a mesh
         # this is a multi-device dispatch racing the learner's

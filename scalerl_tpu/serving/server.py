@@ -64,7 +64,7 @@ from scalerl_tpu.fleet.transport import (
     accept_connection,
     listen_socket,
 )
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.dispatch import steady_state_guard
 from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
 from scalerl_tpu.serving.batcher import (
@@ -315,6 +315,7 @@ class InferenceServer(ParamSnapshotPlane):
                 conn=conn,
                 req_id=msg.get("req"),
                 lanes=int(obs.shape[0]),
+                trace=tracing.extract(msg),
                 payload={
                     "obs": obs,
                     "last_action": np.asarray(msg["last_action"], np.int32),
@@ -386,6 +387,7 @@ class InferenceServer(ParamSnapshotPlane):
     def _flush(self, batch: List[ServingRequest]) -> None:
         lanes = sum(r.lanes for r in batch)
         bucket = bucket_for(lanes, self.batcher.buckets)
+        t_flush0 = time.monotonic()
         host = self._assemble(batch, bucket)
         params, gen = self._snapshot_params()
         # steady state is per bucket: the first flush at a shape compiles
@@ -409,9 +411,16 @@ class InferenceServer(ParamSnapshotPlane):
         self.flushes += 1
         self._flush_counter.inc()
         self._occ_hist.observe(lanes / max(bucket, 1))
-        self._reply(batch, out, gen)
+        self._reply(batch, out, gen, t_flush0, bucket)
 
-    def _reply(self, batch: List[ServingRequest], out, gen: int) -> None:
+    def _reply(
+        self,
+        batch: List[ServingRequest],
+        out,
+        gen: int,
+        t_flush0: float = 0.0,
+        bucket: int = 0,
+    ) -> None:
         """Demux the flushed [bucket, ...] outputs back to per-request
         slices; every reply is tagged with the generation that served it
         (an in-flight push bumps ``self.generation`` but never this tag)."""
@@ -427,6 +436,19 @@ class InferenceServer(ParamSnapshotPlane):
             self._lat_hist.observe(max(now - req.t_enqueue, 0.0))
             self._req_counter.inc()
             self._req_meter.mark()
+            if req.trace is not None:
+                # lifecycle edges off stamps the flush already took:
+                # batcher dwell, then the whole assemble+device round trip
+                # (one span per FLUSH membership, never per lane)
+                tracing.record_span(
+                    "serve.queue_wait", parent=req.trace,
+                    t_start=req.t_enqueue, t_end=t_flush0, kind="serving",
+                )
+                tracing.record_span(
+                    "serve.flush", parent=req.trace, t_start=t_flush0,
+                    t_end=now, kind="serving", lanes=req.lanes,
+                    bucket=bucket, gen=gen,
+                )
             self.hub.send(
                 req.conn,
                 {

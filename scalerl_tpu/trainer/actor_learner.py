@@ -628,8 +628,17 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                 for c in self._serving_clients:
                     c.close()
                 self.inference_server.stop()
+            # joins run on ONE shared wall-clock budget per group: a wedged
+            # thread (env backend stuck in step) must not multiply the
+            # teardown by the thread count — preemption budgets are
+            # wall-clock, and daemon threads die with the process anyway.
+            # After a DIAGNOSED stall the grace shrinks further: the
+            # watchdog already proved the threads are wedged, so a long
+            # wait buys nothing but a slower failure.
+            stalled = watchdog is not None and watchdog.stalled is not None
+            deadline = time.monotonic() + (0.5 if stalled else 3.0)
             for t in assemble_threads:
-                t.join(timeout=3.0)
+                t.join(timeout=max(0.05, deadline - time.monotonic()))
             if prefetch_q is not None:
                 # release device-resident trajectories still queued
                 while True:
@@ -637,8 +646,9 @@ class HostActorLearnerTrainer(HostPlaneMixin, BaseTrainer):
                         prefetch_q.get_nowait()
                     except queue_mod.Empty:
                         break
+            deadline = time.monotonic() + (0.5 if stalled else 5.0)
             for a in actors:
-                a.join(timeout=5.0)
+                a.join(timeout=max(0.05, deadline - time.monotonic()))
             for a in actors:
                 try:
                     a.envs.close()

@@ -45,7 +45,7 @@ from scalerl_tpu.genrl.task import TokenRecallTask
 from scalerl_tpu.models.transformer import TransformerPolicy
 from scalerl_tpu.ops.pallas_per import resolve_sample_method
 from scalerl_tpu.parallel.train_step import maybe_enable_mesh_from_args
-from scalerl_tpu.runtime import telemetry
+from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.utils.buckets import bucket_for, default_buckets
 from scalerl_tpu.utils.logging import get_logger
 
@@ -235,11 +235,17 @@ class SequenceRLTrainer:
 
     def train_round(self) -> Dict[str, float]:
         """One generate -> score -> insert -> sample -> learn round."""
+        # head-sampled per-round trace (SCALERL_TRACE_SAMPLE): monotonic
+        # stamps around work the round already does — tracing off is a
+        # handful of no-op calls, never a transfer (JG001 twin)
+        root = tracing.start_span("genrl.round", kind="genrl")
+        t_gen0 = time.monotonic()
         fields, priorities, rewards, decode_tokens = (
             self._round_continuous()
             if self.continuous
             else self._round_cohort()
         )
+        t_add0 = time.monotonic()
         with self._dispatch_guard():
             self.replay = seq_add(self.replay, fields, (), priorities)
             self._sample_key, sub = jax.random.split(self._sample_key)
@@ -251,7 +257,23 @@ class SequenceRLTrainer:
             )
             batch = dict(batch)
             batch["is_weight"] = weights
+            t_learn0 = time.monotonic()
             metrics = self.agent.learn(batch)  # ONE batched transfer
+        if root.sampled:
+            t_learn1 = time.monotonic()
+            tracing.record_span(
+                "round.generate", parent=root, t_start=t_gen0, t_end=t_add0,
+                kind="genrl", decode_tokens=float(decode_tokens),
+            )
+            tracing.record_span(
+                "round.seq_add", parent=root, t_start=t_add0,
+                t_end=t_learn0, kind="genrl",
+            )
+            tracing.record_span(
+                "round.learn", parent=root, t_start=t_learn0,
+                t_end=t_learn1, kind="genrl",
+            )
+            root.end(step=self.learn_steps + 1)
         self.learn_steps += 1
         self._learn_meter.mark()
         if self.learn_steps % self.args.genrl_push_every == 0:
@@ -384,8 +406,11 @@ class DisaggSequenceRLTrainer:
             DisaggConfig,
             LocalGenerationFleet,
             SequenceLearner,
+            record_consumption_trace,
         )
         from scalerl_tpu.runtime.param_server import _to_host
+
+        self._record_consumption_trace = record_consumption_trace
 
         args.validate()
         self.args = args
@@ -476,10 +501,12 @@ class DisaggSequenceRLTrainer:
         publish a quantized snapshot."""
         B = self.args.genrl_batch
         batch: List[_WireCompletion] = []
+        raw: List[Dict[str, Any]] = []  # keeps the trace/_t_q wire keys
         deadline = time.monotonic() + self.args.disagg_round_timeout_s
         while len(batch) < B:
             payload = self.learner.get_sequence(timeout=0.2)
             if payload is not None:
+                raw.append(payload)
                 batch.append(_WireCompletion(payload))
             elif time.monotonic() > deadline:
                 raise RuntimeError(
@@ -487,6 +514,7 @@ class DisaggSequenceRLTrainer:
                     f"after {self.args.disagg_round_timeout_s:.0f}s "
                     f"(live hosts: {self.learner.live_host_count()})"
                 )
+        t_drain = time.monotonic()
         packed = pack_completions(
             batch, self._prompt_pad, self._response_pad
         )
@@ -497,6 +525,7 @@ class DisaggSequenceRLTrainer:
             packed.response_len,
         )
         fields, priorities = packed.fields(rewards)
+        t_add0 = time.monotonic()
         with self._dispatch_guard():
             self.replay = seq_add(self.replay, fields, (), priorities)
             self._sample_key, sub = jax.random.split(self._sample_key)
@@ -508,8 +537,17 @@ class DisaggSequenceRLTrainer:
             )
             learn_batch = dict(learn_batch)
             learn_batch["is_weight"] = weights
+            t_learn0 = time.monotonic()
             metrics = self.agent.learn(learn_batch)  # ONE batched transfer
         self.learn_steps += 1
+        # extend each consumed sequence's trace with the learner-side edges
+        # (replay wait -> seq_add -> the learn step that consumed it) — the
+        # monotonic stamps above were taken around work the round already
+        # does, so tracing off costs nothing
+        self._record_consumption_trace(
+            raw, t_drain, t_add0, t_learn0, t_learn0, time.monotonic(),
+            self.learn_steps,
+        )
         self._learn_meter.mark()
         if self.learn_steps % self.args.genrl_push_every == 0:
             self.learner.publish(

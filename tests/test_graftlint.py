@@ -842,3 +842,60 @@ def test_jg001_continuous_per_lane_eos_read_flags():
     findings = lint(BAD_CONT_PER_LANE_EOS_READ, relpath=GENRL)
     assert rules_of(findings) == ["JG001"]
     assert "device_get" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# distributed-tracing fixtures (ISSUE 13): scalerl_tpu/runtime is a HOT
+# package and the tracer lives there — spans must be stamped from HOST
+# monotonic clocks the loop already reads, never by materializing a device
+# value per iteration so a span attribute can carry a "timestamp"
+
+TRACING_HOT = "scalerl_tpu/runtime/fixture.py"
+
+GOOD_TRACE_HOST_MONOTONIC_STAMPS = """
+    import time
+
+    from scalerl_tpu.runtime import tracing
+
+    def macro_loop(decode_macro, state, get_metrics):
+        for _ in range(64):
+            t0 = time.monotonic()
+            state, outputs = decode_macro(state)
+            host = get_metrics(outputs)  # ONE sanctioned batched read
+            # host-side monotonic stamps only: ending a span costs two
+            # clock reads and a dict append, never a transfer
+            tracing.record_span(
+                "decode.macro", None, t0, time.monotonic(),
+                kind="genrl", tokens=host["tokens"],
+            )
+"""
+
+BAD_TRACE_PER_ITERATION_DEVICE_TIMESTAMP = """
+    import jax
+
+    def macro_loop(tracer, decode_macro, state):
+        for _ in range(64):
+            span = tracer.start_span("decode.macro")
+            state, outputs = decode_macro(state)
+            # the span "timestamp" forces a blocking device_get EVERY
+            # macro-step: the tracer just reintroduced the per-iteration
+            # host sync the fused decode loop exists to prevent
+            span.end(t_done=jax.device_get(outputs["t_done"]))
+"""
+
+
+def test_jg001_tracer_host_monotonic_stamps_are_clean():
+    """The tracer's sanctioned shape — retroactive spans off monotonic
+    stamps plus the one batched read — lints clean in the runtime
+    package."""
+    assert lint(GOOD_TRACE_HOST_MONOTONIC_STAMPS, relpath=TRACING_HOT) == []
+
+
+def test_jg001_tracer_per_iteration_device_timestamp_flags():
+    """span.end() materializing a device value per macro-step is the
+    tracing JG001 violation: JG001 flags the device_get at its line."""
+    findings = lint(
+        BAD_TRACE_PER_ITERATION_DEVICE_TIMESTAMP, relpath=TRACING_HOT
+    )
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message

@@ -163,25 +163,26 @@ def test_sharded_bench_artifact_schema():
     assert result["value"] > 0
 
 
-def test_serving_bench_artifact_schema():
+def test_serving_bench_artifact_schema(capsys, monkeypatch):
     """bench --mode serving artifacts carry the SLO fields the docs table
     promises (p50/p95/p99, occupancy) and the like-for-like gate keys
-    (metric + mode) so serving history only gates serving runs."""
-    import subprocess
-    import sys as _sys
+    (metric + mode) so serving history only gates serving runs.  Runs
+    in-process at a shrunken window (the genrl schema-test shape) — a
+    subprocess would pay a whole fresh jax import for the same assert."""
+    import importlib.util
 
-    env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
-    out = subprocess.run(
-        [_sys.executable, str(REPO / "bench.py"), "--run", "--cpu",
-         "--bench-mode", "serving"],
-        env=env, capture_output=True, text=True, timeout=500, cwd=str(REPO),
+    monkeypatch.setenv("BENCH_SERVING_TARGET_S", "1.0")
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving_mod", REPO / "bench.py"
     )
-    assert out.returncode == 0, out.stderr[-2000:]
-    line = [
-        l for l in out.stdout.splitlines()
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_serving_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
         if l.strip().startswith("{") and l.strip().endswith("}")
-    ][-1]
-    result = json.loads(line)
+    ]
+    result = json.loads(lines[-1])
     assert result["metric"] == "serving_requests_per_sec"
     assert result["mode"] == "serving"
     assert result["value"] > 0
@@ -260,6 +261,10 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     assert result["admission_latency_p95_ms"] >= (
         result["admission_latency_p50_ms"]
     )
+    # the real tail quantile rides the artifact (ISSUE 13 satellite)
+    assert result["admission_latency_p99_ms"] >= (
+        result["admission_latency_p95_ms"]
+    )
     assert result["lanes"] > 0 and result["page_size"] > 0
     assert result["pages_capacity"] > 0
     assert result["completed_sequences"] >= 2
@@ -296,8 +301,16 @@ def test_disagg_bench_artifact_schema(capsys, monkeypatch):
     assert result["snapshot_quantize_ms"] >= 0
     if result["snapshot_pushes"]:
         assert result["snapshot_push_latency_ms_p50"] > 0
-        assert result["snapshot_push_latency_ms_max"] >= (
+        # real percentiles over every sample, ordered p50 <= p95 <= p99
+        # <= max — the max no longer stands in for a tail quantile
+        assert result["snapshot_push_latency_ms_p95"] >= (
             result["snapshot_push_latency_ms_p50"]
+        )
+        assert result["snapshot_push_latency_ms_p99"] >= (
+            result["snapshot_push_latency_ms_p95"]
+        )
+        assert result["snapshot_push_latency_ms_max"] >= (
+            result["snapshot_push_latency_ms_p99"]
         )
     assert result["accepted_sequences"] >= 2
     # the like-for-like gate treats disagg rows like the other modes

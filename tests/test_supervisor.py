@@ -112,7 +112,13 @@ def test_watchdog_interrupts_wedged_main_thread():
     wd.counter("never_bumped")
     with wd:
         with pytest.raises(KeyboardInterrupt):
-            time.sleep(10.0)
+            # interrupt_main() only raises at a bytecode boundary, so a
+            # single long sleep would always burn its full duration before
+            # the KeyboardInterrupt surfaces — sleep in short slices (the
+            # wedged-but-interruptible shape) so the test ends at the
+            # deadline, not at the sleep's
+            for _ in range(100):
+                time.sleep(0.1)
     assert wd.stalled is not None
 
 

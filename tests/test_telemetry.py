@@ -505,3 +505,85 @@ def test_piggyback_disabled_keeps_wire_clean():
     finally:
         cluster.join()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13 satellites: ordered multi-host flight events, bounded aggregator
+# staleness, real tail quantiles
+
+
+def test_flight_recorder_stamps_host_id_and_monotonic_seq():
+    """Merged multi-host timelines order on (host_id, seq) — deterministic
+    even when the hosts' wall clocks disagree."""
+    fr = FlightRecorder(capacity=8)
+    for i in range(3):
+        fr.record("evt", i=i)
+    evts = fr.events()
+    assert [e["seq"] for e in evts] == [0, 1, 2]
+    assert all(e["host_id"] == telemetry.host_id() for e in evts)
+    # a second process (simulated: fresh recorder, different host id) can
+    # be merged deterministically regardless of wall-clock skew
+    other = FlightRecorder(capacity=8)
+    other.record("evt", i=99)
+    merged = sorted(
+        evts + [dict(other.events()[0], host_id="other-host")],
+        key=lambda e: (e["host_id"], e["seq"]),
+    )
+    assert [e["seq"] for e in merged] == [0, 0, 1, 2]
+    # explicit caller fields still win over the stamps (drain events pass
+    # host=<int> today)
+    fr.record("drain_begin", host=7)
+    assert fr.events("drain_begin")[0]["host"] == 7
+    assert fr.events("drain_begin")[0]["host_id"] == telemetry.host_id()
+
+
+def test_aggregator_age_advances_for_silent_sources(monkeypatch):
+    agg = TelemetryAggregator()
+    agg.absorb("gather:0", {"x": 1})
+    base = time.monotonic()
+    monkeypatch.setattr(time, "monotonic", lambda: base + 7.5)
+    tree = agg.tree()
+    assert tree["per_worker"]["gather:0"]["age_s"] >= 7.4
+
+
+def test_aggregator_evicts_stale_sources(monkeypatch):
+    """A dead source's series is evictable, so the learner's fleet view
+    stays bounded across elastic churn."""
+    agg = TelemetryAggregator()
+    agg.absorb("gather:dead", {"x": 1})
+    base = time.monotonic()
+    monkeypatch.setattr(time, "monotonic", lambda: base + 30.0)
+    agg.absorb("gather:live", {"x": 2})
+    assert agg.evict_stale(max_age_s=10.0) == 1
+    tree = agg.tree()
+    assert tree["sources"] == 1
+    assert "gather:dead" not in tree["per_worker"]
+    assert tree["evicted"] == 1
+    # nothing stale left: idempotent
+    assert agg.evict_stale(max_age_s=10.0) == 0
+
+
+def test_aggregator_max_sources_bound_evicts_stalest():
+    agg = TelemetryAggregator(max_sources=3)
+    for i in range(5):
+        agg.absorb(f"gather:{i}", {"x": float(i)})
+    tree = agg.tree()
+    assert tree["sources"] == 3
+    assert set(tree["per_worker"]) == {"gather:2", "gather:3", "gather:4"}
+    assert agg.evicted == 2
+
+
+def test_histogram_read_has_p99_and_sum_and_compact_strips_them():
+    reg = MetricsRegistry()
+    h = reg.histogram("serving.latency_s", reservoir_size=512)
+    for i in range(200):
+        h.observe(i / 1000.0)
+    h.observe(5.0)  # one outlier: max must NOT stand in for p99
+    read = h.read()
+    assert read["sum"] == pytest.approx(sum(i / 1000.0 for i in range(200)) + 5.0)
+    assert read["p99"] < read["max"]  # the real quantile, not reservoir-max
+    assert read["p99"] >= read["p95"] >= read["p50"]
+    compact = reg.compact()
+    for field in ("p50", "p95", "p99", "min", "max", "sum"):
+        assert f"serving.latency_s.{field}" not in compact
+    assert "serving.latency_s.count" in compact

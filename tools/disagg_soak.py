@@ -39,11 +39,13 @@ from scalerl_tpu.genrl.disagg import (
     disagg_signal_source,
     scripted_sequence_payload,
 )
-from scalerl_tpu.runtime import chaos, telemetry
+from scalerl_tpu.genrl.disagg import record_consumption_trace
+from scalerl_tpu.runtime import chaos, telemetry, tracing
 from scalerl_tpu.runtime.autoscaler import Autoscaler, AutoscalerConfig
 
 RESPONSE_LEN = 8
 VOCAB = 32
+LEARN_BATCH = 8  # pseudo learn-round size for the traced consumption loop
 
 
 def main() -> int:
@@ -57,6 +59,13 @@ def main() -> int:
     parser.add_argument("--warmup", type=int, default=6,
                         help="sequences collected before the wave lands")
     parser.add_argument("--deadline-s", type=float, default=240.0)
+    parser.add_argument(
+        "--trace-dir", default="",
+        help="arm SCALERL_TRACE_SAMPLE=1.0 + per-host span export, then "
+        "run tools/trace_report.py over the merged files (the tpu_watch "
+        "trace-soak step): every completed sequence must yield one "
+        "root-to-learn-step trace with zero orphan spans",
+    )
     args = parser.parse_args()
 
     # the wave fires on the FIRST chaos_poll draw (rate 1.0@1) — the soak
@@ -66,6 +75,18 @@ def main() -> int:
         chaos.ENV_VAR, f"{args.seed}:mass_kill=1.0@1,kills={args.kills}"
     )
     chaos.clear()
+
+    if args.trace_dir:
+        # spawn children inherit the env, so every generation host samples
+        # at 1.0 and appends spans to its own file as they finish (a
+        # SIGTERM'd host loses at most the line in flight)
+        os.makedirs(args.trace_dir, exist_ok=True)
+        for stale in os.listdir(args.trace_dir):
+            if stale.startswith("spans_") or stale == "trace_events.json":
+                os.unlink(os.path.join(args.trace_dir, stale))
+        os.environ[tracing.ENV_SAMPLE] = "1.0"
+        os.environ[tracing.ENV_DIR] = args.trace_dir
+        tracing.reset()
 
     n = args.leases
     counter = {"i": 0}
@@ -127,15 +148,37 @@ def main() -> int:
     t0 = time.monotonic()
     seqs = []
     killed = []
+    pending_learn = []
+    learn_steps = 0
+
+    def pseudo_learn(batch) -> None:
+        # the soak is jax-free, so the "learn step" is a stamp-only twin of
+        # DisaggSequenceRLTrainer's: the same record_consumption_trace call
+        # with monotonic stamps around the (trivial) consumption work —
+        # every accepted sequence's trace still ends in seq.learn_step
+        nonlocal learn_steps
+        learn_steps += 1
+        now = time.monotonic()
+        record_consumption_trace(
+            batch, now, now, now, now, time.monotonic(), learn_steps
+        )
+
     try:
         deadline = t0 + args.deadline_s
         while len(seqs) < n and time.monotonic() < deadline:
             s = learner.get_sequence(timeout=0.2)
             if s is not None:
                 seqs.append(s)
+                if args.trace_dir:
+                    pending_learn.append(s)
+                    if len(pending_learn) >= LEARN_BATCH:
+                        pseudo_learn(pending_learn)
+                        pending_learn = []
             if not killed and len(seqs) >= args.warmup:
                 # the seeded wave: half the generation hosts, mid-decode
                 killed = fleet.chaos_poll()
+        if args.trace_dir and pending_learn:
+            pseudo_learn(pending_learn)
     finally:
         autoscaler.stop()
         learner.stop()
@@ -183,6 +226,27 @@ def main() -> int:
         and len(killed) > 0
         and autoscaler.scale_ups >= 1
     )
+    if args.trace_dir:
+        # merge the per-host span files and gate on trace completeness:
+        # every accepted sequence must have one root-to-learn-step trace
+        # with zero orphan spans (the tpu_watch !trace(...) marker reads
+        # the trace_report verdict line printed here)
+        tracing.export_skew()
+        from tools.trace_report import build_report, print_report, write_chrome
+
+        report = build_report(args.trace_dir)
+        tv = report["verdict"]
+        tv["chrome"] = write_chrome(
+            report, os.path.join(args.trace_dir, "trace_events.json")
+        )
+        tv["expected_sequences"] = len(seqs)
+        print_report(report)
+        print(json.dumps(tv), flush=True)
+        ok = ok and (
+            tv["orphan_spans"] == 0
+            and tv["incomplete"] == 0
+            and tv["sequence_traces"] >= len(seqs)
+        )
     return 0 if ok else 1
 
 

@@ -237,6 +237,51 @@ def _disagg_marker(bl, start_offset: int) -> str:
         return ""
 
 
+def _trace_marker(bl, start_offset: int) -> str:
+    """Gate the trace-soak step on the trace_report verdict line.
+
+    The trace soak is the disagg soak re-run with ``SCALERL_TRACE_SAMPLE=
+    1.0`` + per-host span export; ``tools/trace_report.py`` merges the
+    span files and prints one ``{"metric": "trace_report", ...}`` line.
+    Completeness is the gate: every soaked sequence must yield a single
+    root-to-learn-step trace — incomplete lifecycles or orphan spans
+    (a span whose parent never made it into the merge) mark the outcome
+    ``!trace(...)``; a fully-stitched run marks ``+trace``.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        verdict = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "trace_report":
+                verdict = obj
+        if not verdict:
+            return ""
+        bad = []
+        if int(verdict.get("sequence_traces", 0)) < 1:
+            bad.append("no-traces")
+        if int(verdict.get("incomplete", 0)) > 0:
+            bad.append(f"incomplete={verdict['incomplete']}")
+        if int(verdict.get("orphan_spans", 0)) > 0:
+            bad.append(f"orphans={verdict['orphan_spans']}")
+        if bad:
+            bl.write(f"[watcher] TRACE GATE: {','.join(bad)} — flagging\n")
+            return "!trace(" + ",".join(bad) + ")"
+        return "+trace"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] trace gate failed: {e}\n")
+        return ""
+
+
 def perf_gate_verdict(
     new_value: float, prior_values, threshold: float = 0.2
 ):
@@ -399,6 +444,17 @@ def run_payload(n_devices: int = 1) -> None:
         # corrupt sequences or a missing backfill mark !disagg(...)
         ("disagg-soak", [sys.executable, "tools/disagg_soak.py"],
          600, dict(env, JAX_PLATFORMS="cpu")),
+        # trace soak: the disagg soak with SCALERL_TRACE_SAMPLE=1.0 and
+        # per-host span export — tools/trace_report.py merges the files
+        # into Chrome trace_event JSON + a critical-path breakdown, and
+        # _trace_marker gates on completeness: every soaked sequence must
+        # yield ONE root-to-learn-step trace with zero orphan spans.
+        # jax-free, bounded, runs tunnel-down, non-quorum like the other
+        # soaks
+        ("trace-soak",
+         [sys.executable, "tools/disagg_soak.py", "--trace-dir",
+          "/tmp/tpu_watch_trace", "--leases", "48"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
         # genrl soak: the hermetic token-PPO e2e (generate -> score
         # -> learn on the synthetic recall task, scan/unroll decode parity,
         # reward-improvement threshold).  CPU-pinned and ~1 min (measured
@@ -502,6 +558,9 @@ def run_payload(n_devices: int = 1) -> None:
                     status += _elastic_marker(bl, step_start)
                 if name == "disagg-soak":
                     status += _disagg_marker(bl, step_start)
+                if name == "trace-soak":
+                    status += _disagg_marker(bl, step_start)
+                    status += _trace_marker(bl, step_start)
                 outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
@@ -515,7 +574,8 @@ def run_payload(n_devices: int = 1) -> None:
         status.startswith("ok")
         for name, status in outcomes
         if name not in (
-            "lint", "chaos-soak", "elastic-soak", "disagg-soak", "genrl-soak"
+            "lint", "chaos-soak", "elastic-soak", "disagg-soak",
+            "trace-soak", "genrl-soak",
         )
     ):
         # nothing TPU-witnessed succeeded (lint, the chaos soak, the

@@ -1,0 +1,326 @@
+"""Trace merge + critical-path analyzer for the distributed tracer.
+
+Input: a directory of per-host span files (``spans_<host>_<pid>.jsonl``)
+written by ``scalerl_tpu/runtime/tracing.py`` — one JSON object per line:
+span records, one ``meta`` line per file, and optional ``skew`` lines
+carrying the writer's per-peer clock offsets (estimated off heartbeat
+ping/pong RTTs).  Output, in one pass:
+
+1. **merged trace trees** — spans grouped by trace id, skew-corrected onto
+   the observer's clock, roots identified, orphans counted (a span whose
+   parent id is absent from its trace — the completeness failure mode a
+   lost host file produces);
+2. **Chrome/Perfetto ``trace_event`` JSON** (``--chrome``, default
+   ``<dir>/trace_events.json``) — one ``ph: "X"`` complete event per span,
+   ``pid`` = host, ``tid`` = trace, so chrome://tracing renders each
+   sequence lifecycle as one row spanning generation host -> learner;
+3. a **critical-path breakdown** — top traces by duration with per-edge
+   attribution, plus the aggregate % of traced wall-clock spent on
+   queue-wait vs compute vs wire.  Attribution walks each trace's
+   timeline from root start to last span end, charging every interval to
+   the span covering it (ties: the later-starting span) or to
+   ``untracked`` — so per-edge durations sum to the end-to-end latency
+   EXACTLY, and the report can never double-count overlap.
+
+The last stdout line is a one-line JSON verdict
+(``{"metric": "trace_report", ...}``) that ``tools/tpu_watch.py`` gates
+its trace-soak step on: ``sequence_traces`` vs ``complete_sequences``
+(root -> learn_step present) and ``orphan_spans``.
+
+jax-free, stdlib-only: runs anywhere the soak ran.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# edge-name -> cost class for the queue/compute/wire rollup
+EDGE_CLASSES = {
+    "seq.queue_wait": "queue",
+    "seq.replay_wait": "queue",
+    "serve.queue_wait": "queue",
+    "seq.decode": "compute",
+    "seq.seq_add": "compute",
+    "seq.learn_step": "compute",
+    "serve.flush": "compute",
+    "task.episode": "compute",
+    "genrl.macro_step": "compute",
+    "genrl.generate_round": "compute",
+    "round.generate": "compute",
+    "round.seq_add": "compute",
+    "round.learn": "compute",
+    "seq.upload": "wire",
+    "snapshot.fetch": "wire",
+    "snapshot_publish": "wire",
+    "serve.request": "wire",
+}
+
+# roots whose traces the completeness verdict inspects, and the leaf edge
+# that must be present for the lifecycle to count as complete
+COMPLETENESS = {"sequence": "seq.learn_step"}
+
+
+def classify(name: str) -> str:
+    return EDGE_CLASSES.get(name, "other")
+
+
+def load_dir(trace_dir: str) -> Tuple[List[Dict], Dict[str, float]]:
+    """All span records in ``trace_dir``, skew-corrected.
+
+    Skew lines carry ``offsets[peer] = peer_wall - observer_wall`` as
+    measured by the writing host; the host with the most measured peers
+    (the learner — it pings everyone) becomes the reference, and every
+    measured peer's spans shift by ``-offset`` onto its clock.  Files
+    without skew data pass through untouched (same-machine soaks).
+    """
+    spans: List[Dict] = []
+    skew_by_observer: Dict[str, Dict[str, float]] = {}
+    for path in sorted(glob.glob(os.path.join(trace_dir, "spans_*.jsonl"))):
+        with open(path, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue  # a torn last line from a SIGTERM'd host
+                if "span" in obj:
+                    spans.append(obj)
+                elif obj.get("kind") == "skew":
+                    skew_by_observer.setdefault(
+                        str(obj.get("host")), {}
+                    ).update(obj.get("offsets") or {})
+    offsets: Dict[str, float] = {}
+    if skew_by_observer:
+        reference = max(
+            skew_by_observer, key=lambda h: len(skew_by_observer[h])
+        )
+        offsets = dict(skew_by_observer[reference])
+        offsets.pop(reference, None)
+    for s in spans:
+        off = offsets.get(str(s.get("host")))
+        if off:
+            s["t0"] = float(s["t0"]) - off
+    return spans, offsets
+
+
+def build_traces(spans: List[Dict]) -> Dict[str, Dict[str, Any]]:
+    """Group spans by trace id; identify each trace's root and orphans."""
+    traces: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        traces.setdefault(s["trace"], {"spans": []})["spans"].append(s)
+    for t in traces.values():
+        ids = {s["span"] for s in t["spans"]}
+        t["root"] = next(
+            (s for s in t["spans"] if not s.get("parent")), None
+        )
+        t["orphans"] = [
+            s for s in t["spans"]
+            if s.get("parent") and s["parent"] not in ids
+        ]
+        t0 = min(float(s["t0"]) for s in t["spans"])
+        t1 = max(float(s["t0"]) + float(s["dur"]) for s in t["spans"])
+        if t["root"] is not None:
+            t0 = min(t0, float(t["root"]["t0"]))
+        t["t0"], t["t1"] = t0, t1
+        t["e2e"] = max(t1 - t0, 0.0)
+    return traces
+
+
+def attribute_edges(trace: Dict[str, Any]) -> Dict[str, float]:
+    """Charge every interval of [trace start, trace end] to exactly one
+    edge (or ``untracked``): walk the child spans in start order, clip to
+    the un-attributed suffix, fill holes with ``untracked``.  The values
+    sum to ``e2e`` by construction."""
+    edges: Dict[str, float] = {}
+    start, end = trace["t0"], trace["t1"]
+    root = trace["root"]
+    children = sorted(
+        (
+            s for s in trace["spans"]
+            if root is None or s["span"] != root["span"]
+        ),
+        key=lambda s: float(s["t0"]),
+    )
+    cursor = start
+    for s in children:
+        s0 = max(float(s["t0"]), cursor)
+        s1 = min(float(s["t0"]) + float(s["dur"]), end)
+        if s0 > cursor:
+            edges["untracked"] = edges.get("untracked", 0.0) + (s0 - cursor)
+            cursor = s0
+        if s1 > cursor:
+            edges[s["name"]] = edges.get(s["name"], 0.0) + (s1 - cursor)
+            cursor = s1
+    if end > cursor:
+        edges["untracked"] = edges.get("untracked", 0.0) + (end - cursor)
+    return edges
+
+
+def build_report(trace_dir: str, top: int = 5) -> Dict[str, Any]:
+    spans, offsets = load_dir(trace_dir)
+    traces = build_traces(spans)
+    orphan_spans = sum(len(t["orphans"]) for t in traces.values())
+    # completeness: every root-named lifecycle must reach its leaf edge
+    seq_traces = incomplete = 0
+    for t in traces.values():
+        root = t["root"]
+        leaf = root is not None and COMPLETENESS.get(root["name"])
+        if not leaf:
+            continue
+        seq_traces += 1
+        if not any(s["name"] == leaf for s in t["spans"]):
+            incomplete += 1
+    # per-trace edge attribution + the queue/compute/wire rollup
+    per_trace: List[Dict[str, Any]] = []
+    agg_edges: Dict[str, float] = {}
+    agg_classes: Dict[str, float] = {}
+    for tid, t in traces.items():
+        edges = attribute_edges(t)
+        for name, dur in edges.items():
+            agg_edges[name] = agg_edges.get(name, 0.0) + dur
+            cls = "untracked" if name == "untracked" else classify(name)
+            agg_classes[cls] = agg_classes.get(cls, 0.0) + dur
+        per_trace.append(
+            {
+                "trace": tid,
+                "name": t["root"]["name"] if t["root"] else "<orphaned>",
+                "e2e_ms": t["e2e"] * 1e3,
+                "edges": edges,
+                "edge_sum_ms": sum(edges.values()) * 1e3,
+            }
+        )
+    per_trace.sort(key=lambda r: r["e2e_ms"], reverse=True)
+    total = sum(agg_classes.values()) or 1.0
+    e2es = sorted(t["e2e"] for t in traces.values())
+    return {
+        "dir": trace_dir,
+        "spans": len(spans),
+        "traces": traces,
+        "top_traces": per_trace[:top],
+        "agg_edges": agg_edges,
+        "agg_classes": agg_classes,
+        "class_fractions": {
+            k: v / total for k, v in sorted(agg_classes.items())
+        },
+        "skew_offsets": offsets,
+        "verdict": {
+            "metric": "trace_report",
+            "spans": len(spans),
+            "traces": len(traces),
+            "sequence_traces": seq_traces,
+            "complete_sequences": seq_traces - incomplete,
+            "incomplete": incomplete,
+            "orphan_spans": orphan_spans,
+            "tracked_fraction": round(
+                1.0 - agg_classes.get("untracked", 0.0) / total, 4
+            ),
+            "p50_e2e_ms": round(e2es[len(e2es) // 2] * 1e3, 3)
+            if e2es
+            else 0.0,
+            "max_e2e_ms": round(e2es[-1] * 1e3, 3) if e2es else 0.0,
+        },
+    }
+
+
+def write_chrome(report: Dict[str, Any], path: str) -> str:
+    """Chrome/Perfetto ``trace_event`` JSON: complete ("X") events, host as
+    pid, trace as tid — load in chrome://tracing or ui.perfetto.dev."""
+    t_base = min(
+        (t["t0"] for t in report["traces"].values()), default=0.0
+    )
+    events = []
+    for tid, t in report["traces"].items():
+        for s in t["spans"]:
+            events.append(
+                {
+                    "ph": "X",
+                    "name": s["name"],
+                    "cat": s.get("kind") or "span",
+                    "pid": str(s.get("host", "?")),
+                    "tid": tid,
+                    "ts": round((float(s["t0"]) - t_base) * 1e6, 1),
+                    "dur": round(float(s["dur"]) * 1e6, 1),
+                    "args": dict(s.get("attrs") or {}, span=s["span"]),
+                }
+            )
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+def print_report(report: Dict[str, Any], out=sys.stdout) -> None:
+    v = report["verdict"]
+    print(
+        f"trace report: {v['spans']} spans, {v['traces']} traces "
+        f"({v['sequence_traces']} sequence lifecycles, "
+        f"{v['complete_sequences']} complete, {v['orphan_spans']} orphan "
+        "spans)",
+        file=out,
+    )
+    if report["skew_offsets"]:
+        print(
+            "clock-skew correction applied: "
+            + ", ".join(
+                f"{h}={o * 1e3:+.3f}ms"
+                for h, o in sorted(report["skew_offsets"].items())
+            ),
+            file=out,
+        )
+    print("wall-clock attribution (all traces):", file=out)
+    for cls, frac in sorted(
+        report["class_fractions"].items(), key=lambda kv: -kv[1]
+    ):
+        print(
+            f"  {cls:<10} {100 * frac:5.1f}%  "
+            f"({report['agg_classes'][cls] * 1e3:.1f} ms)",
+            file=out,
+        )
+    print("top traces by end-to-end latency:", file=out)
+    for r in report["top_traces"]:
+        edges = "  ".join(
+            f"{name}={dur * 1e3:.1f}ms"
+            for name, dur in sorted(
+                r["edges"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        print(
+            f"  {r['name']}[{r['trace'][:8]}] e2e={r['e2e_ms']:.1f}ms "
+            f"(edges sum {r['edge_sum_ms']:.1f}ms): {edges}",
+            file=out,
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace_dir", help="directory of spans_*.jsonl files")
+    parser.add_argument(
+        "--chrome",
+        default=None,
+        help="trace_event JSON output path (default <dir>/trace_events.json)",
+    )
+    parser.add_argument("--top", type=int, default=5)
+    args = parser.parse_args(argv)
+
+    report = build_report(args.trace_dir, top=args.top)
+    chrome = args.chrome or os.path.join(args.trace_dir, "trace_events.json")
+    report["verdict"]["chrome"] = write_chrome(report, chrome)
+    print_report(report)
+    # the gate line LAST: tpu_watch scans for the newest matching object
+    print(json.dumps(report["verdict"]), flush=True)
+    ok = (
+        report["verdict"]["orphan_spans"] == 0
+        and report["verdict"]["incomplete"] == 0
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
