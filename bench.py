@@ -557,6 +557,14 @@ def _run_genrl_continuous_measurement() -> None:
     Decode tokens/s counts REAL (mask=1) tokens for both engines over
     whole-phase wall clock — an honest end-to-end rate, not a
     padding-subtracted estimate.
+
+    ``BENCH_GENRL_GROUP=n`` (ISSUE 14) switches arrivals to GROUP shape:
+    every Poisson arrival is one prompt submitted via ``submit_group`` for
+    ``n`` completions (the GRPO workload the prefix-CoW fork exists for) —
+    the artifact then carries ``group: n`` so the perf gate compares
+    like-for-like at the same group shape, and the
+    ``prefill_tokens_saved_ratio`` / ``prefix_hit_rate`` fields report how
+    much full-page prefix prefill the cache + CoW sharing skipped.
     """
     import jax
     import numpy as np
@@ -594,6 +602,10 @@ def _run_genrl_continuous_measurement() -> None:
         target_s = float(os.environ.get("BENCH_GENRL_TARGET_S", "3.0"))
         lanes = int(os.environ.get("BENCH_GENRL_LANES", lanes))
         R = int(os.environ.get("BENCH_GENRL_RESPONSE", R))
+    # group-arrival mode: n completions per arriving prompt (1 = the
+    # ungrouped workload; its artifact carries no "group" key, so the two
+    # shapes never gate each other)
+    group = max(int(os.environ.get("BENCH_GENRL_GROUP", "1")), 1)
 
     base = dict(
         vocab_size=V, max_prompt_len=P_max, max_new_tokens=R,
@@ -614,15 +626,28 @@ def _run_genrl_continuous_measurement() -> None:
         prompts = rng.integers(2, V, size=(n, P_max)).astype(np.int32)
         return prompts, lengths
 
+    def sample_prompt_batch(n):
+        """Group mode tiles each distinct prompt ``group`` times — the
+        cohort twin of submit_group, so both phases see the SAME prompt
+        distribution at the same group shape."""
+        if group > 1:
+            k = max(n // group, 1)
+            prompts, lengths = sample_prompts(k)
+            reps = -(-n // k)
+            prompts = np.repeat(prompts, reps, axis=0)[:n]
+            lengths = np.repeat(lengths, reps, axis=0)[:n]
+            return prompts, lengths
+        return sample_prompts(n)
+
     # phase 1: fixed-cohort rounds at the same lane count
     cohort = GenerationEngine(model, params, GenerationConfig(**base))
-    prompts, lengths = sample_prompts(lanes)
+    prompts, lengths = sample_prompt_batch(lanes)
     cohort.generate(prompts, lengths)  # warm/compile
     t0 = time.perf_counter()
     cohort_tokens = 0
     cohort_rounds = 0
     while time.perf_counter() - t0 < target_s or cohort_rounds < 2:
-        prompts, lengths = sample_prompts(lanes)
+        prompts, lengths = sample_prompt_batch(lanes)
         result = cohort.generate(prompts, lengths)
         cohort_tokens += result.decode_tokens
         cohort_rounds += 1
@@ -645,13 +670,17 @@ def _run_genrl_continuous_measurement() -> None:
             **base,
         ),
     )
-    rate = 2.0 * cohort_seq_per_s
+    # arrivals in SEQUENCES stay at ~2x the cohort completion rate; in
+    # group mode each Poisson arrival is one prompt fanned into `group`
+    # lanes via submit_group (the GRPO shape the prefix-CoW fork serves)
+    rate = 2.0 * cohort_seq_per_s / group
     # warm: churn several lane-fills through so the decode program AND the
     # admission (prompt, admit) bucket programs all compile off the clock
-    prompts, lengths = sample_prompts(6 * lanes)
-    for i in range(6 * lanes):
-        engine.submit(prompts[i], lengths[i])
-    while engine.live_lanes or engine.pending:
+    n_warm = max(6 * lanes // group, 2)
+    prompts, lengths = sample_prompts(n_warm)
+    for i in range(n_warm):
+        engine.submit_group(prompts[i], group, lengths[i])
+    while engine.live_lanes or engine.pending or engine._inflight:
         engine.step()
     t0 = time.perf_counter()
     next_arrival = rng.exponential(1.0 / rate)
@@ -667,7 +696,7 @@ def _run_genrl_continuous_measurement() -> None:
         if n_new:
             prompts, lengths = sample_prompts(n_new)
             for i in range(n_new):
-                engine.submit(prompts[i], lengths[i])
+                engine.submit_group(prompts[i], group, lengths[i])
         if engine.live_lanes == 0 and engine.pending == 0:
             continue  # idle until the next arrival lands
         done = engine.step()
@@ -675,6 +704,15 @@ def _run_genrl_continuous_measurement() -> None:
         cont_tokens += sum(len(c.response_tokens) for c in done)
     cont_elapsed = time.perf_counter() - t0
     cont_tps = cont_tokens / cont_elapsed
+    # ratios (not rates): computed over the engine's whole lifetime —
+    # warmup included, which runs the same group shape — so a short
+    # measured window can never report an empty 0/0 sample
+    saved = engine.prefix_tokens_saved
+    total = engine.prefix_tokens_total
+    hit_num = hit_den = 0
+    if engine._prefix_cache is not None:
+        hit_num = engine._prefix_cache.hits
+        hit_den = hit_num + engine._prefix_cache.misses
     admit_hist = telemetry.get_registry().histogram(
         "genrl.admission_latency_s"
     )
@@ -704,6 +742,12 @@ def _run_genrl_continuous_measurement() -> None:
         "completed_sequences": completed,
         "arrival_rate_per_s": round(rate, 2),
         "shed_total": engine._batcher.shed_total,
+        # shared-prefix reuse (ISSUE 14): fraction of admitted full-page
+        # prefix tokens whose prefill was skipped (cache hits + CoW group
+        # shares), and the admission-level cache hit rate
+        "prefill_tokens_saved_ratio": round(saved / max(total, 1), 4),
+        "prefix_hit_rate": round(hit_num / max(hit_den, 1), 4),
+        "steps_in_flight": engine.config.steps_in_flight,
         "lanes": lanes,
         "page_size": page_size,
         "macro_steps": macro_steps,
@@ -717,6 +761,9 @@ def _run_genrl_continuous_measurement() -> None:
         "device_kind": device_kind,
         "measured_s": round(cohort_elapsed + cont_elapsed, 1),
     }
+    if group > 1:
+        # the group shape keys its own like-for-like perf-gate history
+        result_obj["group"] = group
     print(json.dumps(result_obj))
 
 

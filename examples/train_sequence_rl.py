@@ -23,6 +23,16 @@ docs/SEQUENCE_RL.md "Continuous batching")::
 
     python examples/train_sequence_rl.py --genrl-engine continuous \
         --genrl-lanes 32 --genrl-page-size 8 --genrl-macro-steps 4
+
+GRPO-shaped group sampling over the shared-prefix CoW cache (ISSUE 14,
+docs/SEQUENCE_RL.md "Prefix caching & group sampling") — each round
+samples genrl_batch / samples_per_prompt distinct prompts and decodes
+samples_per_prompt completions per prompt, the group forking off ONE
+prompt prefill; steps-in-flight pipelines admission under decode::
+
+    python examples/train_sequence_rl.py --genrl-engine continuous \
+        --genrl-lanes 32 --samples-per-prompt 8 \
+        --genrl-steps-in-flight 2
 """
 
 import os

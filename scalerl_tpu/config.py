@@ -767,6 +767,20 @@ class GenRLArguments(RLArguments):
     genrl_admit_wait_ms: float = 0.0
     genrl_max_pending: int = 0  # admission queue bound (0 = unbounded)
     genrl_paged_attn: str = "auto"  # pallas | xla | auto (backend)
+    # Group sampling (ISSUE 14): generate this many completions per
+    # prompt — the GRPO data layout.  Rounds sample genrl_batch /
+    # samples_per_prompt distinct prompts; on the continuous engine each
+    # group admits via submit_group (shared-prefix CoW fork, ~1/n of the
+    # prefill), on the cohort engine prompts are tiled (layout only).
+    samples_per_prompt: int = 1
+    # Macro-step pipelining: K macro dispatches in flight, host read
+    # lagging by K-1 so harvest/admission/prefill overlap device decode
+    # (1 = the old synchronous semantics, parity-pinned).
+    genrl_steps_in_flight: int = 2
+    # Shared-prefix KV reuse: cache full prompt pages and share them
+    # copy-on-write into later admissions of the same prefix (flushed on
+    # every param push; off = always prefill from scratch).
+    genrl_prefix_cache: bool = True
 
     # Disaggregated dataflow (genrl/disagg.py, ISSUE 12): N generation
     # hosts behind jax-free shells stream completed sequences over the
@@ -844,6 +858,22 @@ class GenRLArguments(RLArguments):
             raise ValueError(
                 "genrl_paged_attn must be auto | pallas | xla, got "
                 f"{self.genrl_paged_attn!r}"
+            )
+        if self.samples_per_prompt < 1:
+            raise ValueError(
+                f"samples_per_prompt must be >= 1, got "
+                f"{self.samples_per_prompt}"
+            )
+        if self.genrl_batch % self.samples_per_prompt != 0:
+            raise ValueError(
+                f"genrl_batch ({self.genrl_batch}) must be a multiple of "
+                f"samples_per_prompt ({self.samples_per_prompt}) so rounds "
+                "hold whole groups"
+            )
+        if self.genrl_steps_in_flight < 1:
+            raise ValueError(
+                f"genrl_steps_in_flight must be >= 1, got "
+                f"{self.genrl_steps_in_flight}"
             )
         if self.disagg_hosts < 1:
             raise ValueError(

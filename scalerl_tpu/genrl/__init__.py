@@ -25,6 +25,7 @@ _EXPORTS = {
     "GenerationEngine": "scalerl_tpu.genrl.engine",
     "GenerationResult": "scalerl_tpu.genrl.engine",
     "PageAllocator": "scalerl_tpu.genrl.paging",
+    "PrefixCache": "scalerl_tpu.genrl.prefix_cache",
     "pack_completions": "scalerl_tpu.genrl.rollout",
     "pack_sequences": "scalerl_tpu.genrl.rollout",
     "sequence_field_shapes": "scalerl_tpu.genrl.rollout",

@@ -14,36 +14,63 @@ the host swaps *sequences* through them —
   ``paged_attn_fn`` seam), carry the fresh logits.  The host dispatches
   once and reads back once — PR 10's one-batched-read round discipline at
   macro-step granularity, under ``steady_state_guard()`` once warm;
+- **pipelined admission/decode** (ISSUE 14) — ``steps_in_flight`` macro
+  dispatches stay in flight with the host read lagging dispatch by K-1
+  (the PR 1 ``MetricsPipeline`` idiom applied to the decode loop), so
+  harvest, cache lookups, admission bookkeeping and prefill overlap
+  device decode instead of serializing with it.  ``K=1`` is the old
+  fully-synchronous semantics, parity-pinned.  A lane that latches done
+  mid-flight keeps null-writing until its macro is read — exactly the
+  within-macro dead-lane behavior, stretched K-1 macros;
 - **continuous admission** — between macro-steps the host harvests lanes
-  that latched done (frees their pages immediately — KV memory tracks
-  LIVE tokens), then admits queued prompts into the freed lanes through
-  the serving batcher's flush-on-size-or-deadline predicate
-  (:meth:`DynamicBatcher.poll_batch`) and the shared pow2 bucket ladder:
-  one jitted *prefill* program per (prompt bucket, admit bucket) writes
-  the prompt K/V straight into newly-allocated pages and scatters the
-  lane state (last logits/value, cursor, flags) device-side — no host
-  read anywhere in admission;
+  that finished (frees their pages immediately — KV memory tracks LIVE
+  tokens), then admits queued prompts into the freed lanes through the
+  serving batcher's flush-on-size-or-deadline predicate
+  (:meth:`DynamicBatcher.poll_batch`) and the shared pow2 bucket ladder.
+  Admission looks up the :class:`~scalerl_tpu.genrl.prefix_cache
+  .PrefixCache` first: the longest cached full-page prefix is *shared*
+  into the lane's table (a refcount bump, zero FLOPs) and only the
+  uncached tail is prefilled — through the local-attention prefill
+  program when nothing matched, or the shared-table tail-prefill program
+  (gather-through-table attention) on a hit;
+- **group sampling (CoW fork)** — :meth:`submit_group` admits one prompt
+  into ``n`` lanes: the leader prefills (tail only, as above), the other
+  ``n-1`` lanes map the SAME full prompt pages copy-on-write and only the
+  last partial page is physically copied per lane by a small jitted
+  page-copy program — so a GRPO-shaped round pays ~1/n of its prefill;
 - **paged KV** — ``models/transformer.py``'s ``PagedKVCache`` pools plus
-  the jax-free :class:`~scalerl_tpu.genrl.paging.PageAllocator`:
-  admission reserves a sequence's worst-case pages (exhaustion
-  backpressures, never corrupts) while physical pages are drawn lazily as
-  contexts grow.
+  the jax-free refcounting :class:`~scalerl_tpu.genrl.paging
+  .PageAllocator`: admission reserves a sequence's worst-case pages
+  (exhaustion backpressures, never corrupts; shared pages count against
+  EVERY holder's reservation, so sharing never loosens the guarantee)
+  while physical pages are drawn lazily as contexts grow.
 
 Sampling math is shared with the fixed-cohort engine (``engine.py``'s
 ``adjust_logits``/``sample_tokens``), so at temperature 0 the two engines
 are token-identical on the same params — the parity the acceptance tests
-pin.  A sequence is tagged with the param generation that admitted it; a
-``push_params`` mid-flight rotates the policy under lanes already decoding
-(inherent to continuous batching; the token-PPO ratios absorb it exactly
-like actor lag).
+pin, with the prefix cache on or off.  A sequence is tagged with the param
+generation that admitted it; a ``push_params`` mid-flight rotates the
+policy under lanes already decoding (inherent to continuous batching; the
+token-PPO ratios absorb it exactly like actor lag) and FLUSHES the prefix
+cache — cached K/V belongs to the generation that wrote it.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from contextlib import nullcontext
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    List,
+    NamedTuple,
+    Optional,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +83,9 @@ from scalerl_tpu.genrl.engine import (
     sample_tokens,
 )
 from scalerl_tpu.genrl.paging import PageAllocator
+from scalerl_tpu.genrl.prefix_cache import PrefixCache
 from scalerl_tpu.models.transformer import (
+    PagedKVCache,
     TransformerPolicy,
     init_paged_kv_cache,
     prompt_attention_mask,
@@ -103,6 +132,17 @@ class ContinuousConfig(GenerationConfig):
     # trades a little occupancy for much cheaper admission (the measured
     # CPU sweet spot; see docs/SEQUENCE_RL.md "Continuous batching").
     min_free_lanes: int = 1
+    # Macro-step pipelining (ISSUE 14): K macro dispatches stay in flight
+    # with the host read lagging by K-1, so harvest/admission/prefill
+    # overlap device decode.  1 = the old read-after-every-dispatch
+    # semantics (parity-pinned); 2 is the measured sweet spot — deeper
+    # only lengthens harvest lag without adding overlap.
+    steps_in_flight: int = 2
+    # Shared-prefix KV reuse (ISSUE 14): cache full prompt pages keyed by
+    # rolling block hash, share them copy-on-write into later admissions
+    # of the same prefix.  Off = every admission prefills from scratch
+    # (the cache-off twin the token-identity tests compare against).
+    prefix_cache: bool = True
 
     def validate(self) -> None:
         super().validate()
@@ -124,6 +164,10 @@ class ContinuousConfig(GenerationConfig):
         if self.num_pages < 0:
             raise ValueError(
                 f"num_pages must be >= 0 (0 = auto), got {self.num_pages}"
+            )
+        if self.steps_in_flight < 1:
+            raise ValueError(
+                f"steps_in_flight must be >= 1, got {self.steps_in_flight}"
             )
 
 
@@ -163,6 +207,10 @@ class _Lane:
     submit_time: float = 0.0
     admit_time: float = 0.0
     tag: Any = None
+    # index of the first macro dispatch that includes this occupancy: a
+    # pipelined read of an OLDER macro must not be applied to it (the
+    # lane id may have been recycled from a finished occupancy)
+    admit_macro: int = 0
 
 
 class ContinuousEngine(ParamSnapshotPlane):
@@ -170,10 +218,11 @@ class ContinuousEngine(ParamSnapshotPlane):
 
     ``model``: a token-mode :class:`TransformerPolicy` whose ``max_len``
     covers ``prompt_bucket_max + response_bucket``.  The engine compiles
-    exactly ONE decode macro-step program (lane count static) plus one
-    prefill program per (prompt bucket, admit bucket) pair — the
-    ``_decode_traces`` / ``_prefill_traces`` counters let tests pin zero
-    retraces after warmup.
+    exactly ONE decode macro-step program (lane count static), one prefill
+    program per (bucket, admit-bucket) pair — local-attention for cold
+    prompts, shared-table for cached-prefix tails — and one page-copy fork
+    program per admit bucket; the ``_decode_traces`` / ``_prefill_traces``
+    / ``_fork_traces`` counters let tests pin zero retraces after warmup.
     """
 
     def __init__(
@@ -220,6 +269,13 @@ class ContinuousEngine(ParamSnapshotPlane):
         num_pages = config.num_pages or (L * self._pages_per_lane + 1)
         self.allocator = PageAllocator(num_pages, ps)
         self._worst_pages = self.allocator.pages_for_tokens(max_context)
+        self._prefix_cache: Optional[PrefixCache] = None
+        if config.prefix_cache:
+            self._prefix_cache = PrefixCache(self.allocator, ps)
+            # cached-but-unreferenced chains are reclaimed on demand, so
+            # the cache occupies the pool's slack without ever
+            # backpressuring admission
+            self.allocator.set_reclaim_hook(self._prefix_cache.evict)
         # admission queue: the serving batcher reused verbatim — flush on
         # size (free lanes) OR deadline, bounded by max_pending with sheds
         self._batcher = DynamicBatcher(
@@ -246,21 +302,35 @@ class ContinuousEngine(ParamSnapshotPlane):
         self._table = np.zeros((L, self._pages_per_lane), np.int32)
         self._key = jax.random.PRNGKey(config.seed)
         self._decode_fn = self._build_decode()
-        self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
+        self._prefill_fns: Dict[Tuple, Callable] = {}
+        self._fork_fns: Dict[int, Callable] = {}
+        # in-flight macro reads: (dispatch index, device outputs); reads
+        # pop the left end once depth reaches steps_in_flight
+        self._inflight: Deque[Tuple[int, Any]] = deque()
         self._decode_traces = 0
         self._prefill_traces = 0
+        self._fork_traces = 0
         self._warm = False
         self.macro_steps = 0
         self.completed_total = 0
         self._occupancy_sum = 0.0
+        # prefill-savings accounting (the bench's saved-ratio numerator /
+        # denominator): full-page prefix tokens admitted vs those skipped
+        # via cache hits and CoW group shares
+        self.prefix_tokens_total = 0
+        self.prefix_tokens_saved = 0
+        self.prefill_tokens = 0
         reg = telemetry.get_registry()
         self._decode_meter = reg.meter("genrl.decode_tokens_per_s")
         self._prompt_meter = reg.meter("genrl.prompt_tokens_per_s")
         self._occupancy_gauge = reg.gauge("genrl.lane_occupancy")
         self._admitted_counter = reg.counter("genrl.admitted")
         self._completed_counter = reg.counter("genrl.completed")
+        self._shared_counter = reg.counter("genrl.pages_shared")
         self._admit_hist = reg.histogram("genrl.admission_latency_s")
         reg.bind("genrl.pages", self.allocator.stats)
+        if self._prefix_cache is not None:
+            reg.bind("genrl.prefix", self._prefix_cache.stats)
         reg.bind(
             "genrl.continuous",
             lambda: {
@@ -268,7 +338,8 @@ class ContinuousEngine(ParamSnapshotPlane):
                 "macro_steps": self.macro_steps,
                 "completed": self.completed_total,
                 "live_lanes": sum(l.busy for l in self._lanes),
-                "pending": self._batcher.stats()["pending_requests"],
+                "pending": self._batcher.stats()["pending_lanes"],
+                "in_flight": len(self._inflight),
                 "shed_total": self._batcher.shed_total,
                 "iter_mode": self.iter_mode,
             },
@@ -284,34 +355,77 @@ class ContinuousEngine(ParamSnapshotPlane):
         """Queue one prompt for admission; False = shed (queue at
         ``max_pending``).  ``prompt``: 1-D int32 (or the right-padded
         ``[L0]`` row with an explicit true length).  ``tag`` rides the lane
-        unchanged and comes back on the :class:`CompletedSequence`."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        n = int(prompt_length) if prompt_length is not None else len(prompt)
-        if n < 1 or n > self.config.max_prompt_len:
+        unchanged and comes back on the :class:`CompletedSequence`.
+        Single prompts take the same cache-lookup admission path as
+        groups (a hit still skips the cached prefix's prefill)."""
+        return self.submit_group(prompt, 1, prompt_length, tag)
+
+    def submit_group(
+        self,
+        prompt: np.ndarray,
+        n: int,
+        prompt_length: Optional[int] = None,
+        tag: Any = None,
+    ) -> bool:
+        """Queue one prompt for ``n`` sampled completions (the GRPO group
+        shape); False = shed.  The group admits atomically into ``n``
+        lanes that share the prompt's KV copy-on-write: one tail prefill
+        for the leader, full prompt pages shared into the other ``n-1``
+        tables, and only the last partial page physically copied per
+        lane.  Every member completes as its own
+        :class:`CompletedSequence` carrying the same ``tag``."""
+        if n < 1 or n > self.config.lanes:
             raise ValueError(
-                f"prompt length {n} outside [1, {self.config.max_prompt_len}]"
+                f"group size must be in [1, lanes], got {n}"
+            )
+        if n * self._worst_pages > self.allocator.capacity:
+            # groups admit atomically: one the pool can never cover would
+            # sit queued forever (every member reserves its full worst
+            # case — sharing never loosens the exhaustion guarantee)
+            raise ValueError(
+                f"group of {n} needs {n * self._worst_pages} worst-case "
+                f"pages but the pool caps at {self.allocator.capacity}"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        m = int(prompt_length) if prompt_length is not None else len(prompt)
+        if m < 1 or m > self.config.max_prompt_len:
+            raise ValueError(
+                f"prompt length {m} outside [1, {self.config.max_prompt_len}]"
             )
         return self._batcher.submit(
             ServingRequest(
                 conn=None,
                 req_id=None,
-                lanes=1,
-                payload={"prompt": prompt[:n].copy(), "len": n, "tag": tag},
+                lanes=n,
+                payload={
+                    "prompt": prompt[:m].copy(),
+                    "len": m,
+                    "n": n,
+                    "tag": tag,
+                },
             )
         )
 
     @property
     def pending(self) -> int:
-        return self._batcher.stats()["pending_requests"]
+        """Queued-but-unadmitted LANES (a group of n counts n)."""
+        return self._batcher.stats()["pending_lanes"]
 
     @property
     def live_lanes(self) -> int:
         return sum(l.busy for l in self._lanes)
 
+    @property
+    def prefix_saved_ratio(self) -> float:
+        """Fraction of admitted full-page prefix tokens whose prefill was
+        skipped (cache hits + CoW group shares)."""
+        return self.prefix_tokens_saved / max(self.prefix_tokens_total, 1)
+
     def _admit(self) -> None:
         """Admit queued prompts into free lanes via the batcher's
-        flush-on-size-or-deadline predicate, grouped per prompt bucket so
-        each prefill dispatch reuses a compiled (P, A) program."""
+        flush-on-size-or-deadline predicate.  All table math is host-side
+        numpy; the device sees one batched upload per prefill group plus
+        one for the CoW fork — never a per-lane transfer."""
         free_ids = [i for i, l in enumerate(self._lanes) if not l.busy]
         if not free_ids:
             return
@@ -323,6 +437,8 @@ class ContinuousEngine(ParamSnapshotPlane):
             return
         # admission never over-commits the page pool: cap the flush at the
         # number of worst-case sequences the allocator can still reserve
+        # (shared pages count against every holder's reservation, so the
+        # cap is exact with or without the prefix cache)
         affordable = (
             self.allocator.capacity - self.allocator.reserved
         ) // self._worst_pages
@@ -331,67 +447,144 @@ class ContinuousEngine(ParamSnapshotPlane):
         if not batch:
             return
         now = time.monotonic()
-        groups: Dict[int, List[Tuple[int, ServingRequest]]] = {}
-        for req in batch:
-            lane_id = free_ids.pop(0)
-            n = req.payload["len"]
-            P = bucket_for(n, self.config.resolved_prompt_buckets())
-            groups.setdefault(P, []).append((lane_id, req))
+        ps = self.config.page_size
         params, gen = self._snapshot_params()
-        for P, members in groups.items():
-            self._prefill_group(P, members, params, gen, now)
+        local: Dict[int, List[Tuple]] = {}
+        prefix: Dict[int, List[Tuple]] = {}
+        forks: List[Tuple[int, int, int, int]] = []
+        inserts: List[Tuple[np.ndarray, int, List[int]]] = []
+        admitted = 0
+        for req in batch:
+            prompt = req.payload["prompt"]
+            m = req.payload["len"]
+            n = req.payload.get("n", 1)
+            lane_ids = [free_ids.pop(0) for _ in range(n)]
+            leader = lane_ids[0]
+            # longest cached full-page prefix — capped at m-1 tokens so
+            # the uncached tail always holds the token whose forward
+            # produces the lane's first decode logits
+            cached: List[int] = []
+            if self._prefix_cache is not None:
+                cached = self._prefix_cache.lookup(prompt, m - 1)
+            ck = len(cached) * ps
+            worst = self.allocator.pages_for_tokens(
+                m + self._response_budget
+            )
+            full_tokens = (m // ps) * ps  # full-page prefix tokens
+            ok = self.allocator.try_reserve(worst)
+            assert ok, "admission cap should have prevented over-reserve"
+            holder = f"lane[{leader}]"
+            if cached:
+                self.allocator.share(cached, holder=holder)
+                self._shared_counter.inc(len(cached))
+            tail_pages = self.allocator.alloc(
+                self.allocator.pages_for_tokens(m) - len(cached),
+                holder=holder,
+            )
+            pages = cached + tail_pages
+            self._occupy(leader, req, prompt, m, pages, worst, gen, now)
+            t_len = m - ck
+            row = (leader, prompt, m, ck, pages)
+            if ck == 0:
+                P = bucket_for(m, self.config.resolved_prompt_buckets())
+                local.setdefault(P, []).append(row)
+            else:
+                T = bucket_for(
+                    t_len, self.config.resolved_prompt_buckets()
+                )
+                prefix.setdefault(T, []).append(row)
+            self.prefix_tokens_total += full_tokens
+            self.prefix_tokens_saved += min(ck, full_tokens)
+            self.prefill_tokens += t_len
+            self._prompt_meter.mark(t_len)
+            # group members fork off the leader copy-on-write: shared full
+            # prompt pages, one physical copy of the partial page
+            n_full = m // ps
+            partial = pages[n_full] if m % ps else None
+            for member in lane_ids[1:]:
+                ok = self.allocator.try_reserve(worst)
+                assert ok, "admission cap should have prevented over-reserve"
+                mh = f"lane[{member}]"
+                mpages = list(pages[:n_full])
+                if n_full:
+                    self.allocator.share(mpages, holder=mh)
+                    self._shared_counter.inc(n_full)
+                if partial is not None:
+                    copy = self.allocator.alloc(1, holder=mh)[0]
+                    mpages.append(copy)
+                    forks.append((leader, member, partial, copy))
+                else:
+                    forks.append((leader, member, 0, 0))
+                self._occupy(member, req, prompt, m, mpages, worst, gen, now)
+                self.prefix_tokens_total += full_tokens
+                self.prefix_tokens_saved += full_tokens
+            admitted += n
+            self._admit_hist.observe(now - req.t_enqueue)
+            if self._prefix_cache is not None and n_full:
+                inserts.append((prompt, m, pages[:n_full]))
+        self._admitted_counter.inc(admitted)
+        for P, rows in local.items():
+            self._dispatch_local_prefill(P, rows, params)
+        for T, rows in prefix.items():
+            self._dispatch_prefix_prefill(T, rows, params)
+        if forks:
+            self._dispatch_fork(forks)
+        # register the freshly-written chains AFTER the prefill dispatches
+        # (device programs are ordered, so any later reader through a
+        # shared table sees the completed writes)
+        for prompt, m, full_pages in inserts:
+            self._prefix_cache.insert(prompt, m, full_pages)
 
-    def _prefill_group(
+    def _occupy(
         self,
-        P: int,
-        members: List[Tuple[int, ServingRequest]],
-        params: Any,
+        lane_id: int,
+        req: ServingRequest,
+        prompt: np.ndarray,
+        m: int,
+        pages: List[int],
+        reserved: int,
         gen: int,
         now: float,
     ) -> None:
+        lane = self._lanes[lane_id]
+        lane.busy = True
+        lane.prompt = prompt
+        lane.prompt_len = m
+        lane.context_len = m
+        lane.pages = pages
+        lane.reserved = reserved
+        lane.tokens, lane.logps, lane.values = [], [], []
+        lane.generation = gen
+        lane.submit_time = req.t_enqueue
+        lane.admit_time = now
+        lane.tag = req.payload.get("tag")
+        lane.admit_macro = self.macro_steps
+        self._table[lane_id] = 0
+        self._table[lane_id, : len(pages)] = pages
+
+    # -- prefill dispatch ------------------------------------------------
+    def _dispatch_local_prefill(
+        self, P: int, rows: List[Tuple], params: Any
+    ) -> None:
+        """Cold prompts (no cached prefix): causal local-attention prefill
+        over the compact batch, K/V written straight into the lanes'
+        fresh pages — ONE batched upload, no read."""
         ps = self.config.page_size
-        A = bucket_for(len(members), self._admit_buckets)
+        A = bucket_for(len(rows), self._admit_buckets)
         L = self.config.lanes
         tokens = np.full((A, P), self.config.pad_token, np.int32)
         lengths = np.ones((A,), np.int32)
         lane_ids = np.full((A,), L, np.int32)  # pad rows scatter-drop
         page_ids = np.zeros((A, P), np.int32)  # pad writes -> null page
         offsets = np.zeros((A, P), np.int32)
-        for row, (lane_id, req) in enumerate(members):
-            prompt = req.payload["prompt"]
-            n = req.payload["len"]
-            lane = self._lanes[lane_id]
-            reserved = self.allocator.pages_for_tokens(
-                n + self._response_budget
-            )
-            ok = self.allocator.try_reserve(reserved)
-            assert ok, "admission cap should have prevented over-reserve"
-            pages = self.allocator.alloc(
-                self.allocator.pages_for_tokens(n)
-            )
-            lane.busy = True
-            lane.prompt = prompt
-            lane.prompt_len = n
-            lane.context_len = n
-            lane.pages = pages
-            lane.reserved = reserved
-            lane.tokens, lane.logps, lane.values = [], [], []
-            lane.generation = gen
-            lane.submit_time = req.t_enqueue
-            lane.admit_time = now
-            lane.tag = req.payload.get("tag")
-            self._table[lane_id] = 0
-            self._table[lane_id, : len(pages)] = pages
-            tokens[row, :n] = prompt
-            lengths[row] = n
-            lane_ids[row] = lane_id
-            pos = np.arange(n)
-            page_ids[row, :n] = np.asarray(lane.pages, np.int32)[pos // ps]
-            offsets[row, :n] = pos % ps
-            self._admit_hist.observe(now - req.t_enqueue)
-            self._prompt_meter.mark(n)
-        self._admitted_counter.inc(len(members))
-        fn = self._prefill_fn(P, A)
+        for r, (lane_id, prompt, m, _ck, pages) in enumerate(rows):
+            tokens[r, :m] = prompt
+            lengths[r] = m
+            lane_ids[r] = lane_id
+            pos = np.arange(m)
+            page_ids[r, :m] = np.asarray(pages, np.int32)[pos // ps]
+            offsets[r, :m] = pos % ps
+        fn = self._prefill_fn(("local", P, A))
         with self._dispatch_guard():
             # ONE explicit batched host->device upload per prefill dispatch
             up = _device_put((tokens, lengths, lane_ids, page_ids, offsets))
@@ -413,12 +606,112 @@ class ContinuousEngine(ParamSnapshotPlane):
                 *up,
             )
 
+    def _dispatch_prefix_prefill(
+        self, T: int, rows: List[Tuple], params: Any
+    ) -> None:
+        """Cache-hit prompts: prefill ONLY the uncached tail.  The tail's
+        K/V scatters into lane-owned pages; attention gathers the whole
+        context (shared prefix + tail) through the page table — sharing
+        is purely a page-table fact."""
+        ps = self.config.page_size
+        A = bucket_for(len(rows), self._admit_buckets)
+        L = self.config.lanes
+        Mp = self._pages_per_lane
+        tokens = np.full((A, T), self.config.pad_token, np.int32)
+        tail_lengths = np.ones((A,), np.int32)
+        lane_ids = np.full((A,), L, np.int32)
+        page_ids = np.zeros((A, T), np.int32)
+        offsets = np.zeros((A, T), np.int32)
+        table = np.zeros((A, Mp), np.int32)
+        starts = np.zeros((A,), np.int32)
+        for r, (lane_id, prompt, m, ck, pages) in enumerate(rows):
+            t_len = m - ck
+            tokens[r, :t_len] = prompt[ck:m]
+            tail_lengths[r] = t_len
+            lane_ids[r] = lane_id
+            gpos = ck + np.arange(t_len)
+            page_ids[r, :t_len] = np.asarray(pages, np.int32)[gpos // ps]
+            offsets[r, :t_len] = gpos % ps
+            table[r, : len(pages)] = pages
+            starts[r] = ck
+        fn = self._prefill_fn(("prefix", T, A))
+        with self._dispatch_guard():
+            up = _device_put(
+                (tokens, tail_lengths, lane_ids, page_ids, offsets,
+                 table, starts)
+            )
+            (
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+            ) = fn(
+                params,
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+                *up,
+            )
+
+    def _dispatch_fork(self, forks: List[Tuple[int, int, int, int]]) -> None:
+        """One jitted page-copy + lane-state fork for EVERY group member
+        admitted this cycle: copies the leader's partial prompt page into
+        the member's private page and replicates the leader's post-prefill
+        decode carry — one small upload, no read."""
+        F = bucket_for(len(forks), self._admit_buckets)
+        L = self.config.lanes
+        src_lane = np.zeros((F,), np.int32)
+        dst_lane = np.full((F,), L, np.int32)  # pad rows scatter-drop
+        src_page = np.zeros((F,), np.int32)  # pad copies null -> null
+        dst_page = np.zeros((F,), np.int32)
+        for i, (sl, dl, sp, dp) in enumerate(forks):
+            src_lane[i] = sl
+            dst_lane[i] = dl
+            src_page[i] = sp
+            dst_page[i] = dp
+        fn = self._fork_fn(F)
+        with self._dispatch_guard():
+            up = _device_put((src_lane, dst_lane, src_page, dst_page))
+            (
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+            ) = fn(
+                self._pools,
+                self._logits_st,
+                self._value_st,
+                self._cl,
+                self._done,
+                self._resp,
+                *up,
+            )
+
     # -- program construction -------------------------------------------
-    def _prefill_fn(self, P: int, A: int) -> Callable:
-        fn = self._prefill_fns.get((P, A))
+    def _prefill_fn(self, key: Tuple) -> Callable:
+        fn = self._prefill_fns.get(key)
         if fn is None:
-            fn = self._build_prefill(P, A)
-            self._prefill_fns[(P, A)] = fn
+            kind, a, b = key
+            fn = (
+                self._build_prefill(a, b)
+                if kind == "local"
+                else self._build_prefix_prefill(a, b)
+            )
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _fork_fn(self, F: int) -> Callable:
+        fn = self._fork_fns.get(F)
+        if fn is None:
+            fn = self._build_fork(F)
+            self._fork_fns[F] = fn
         return fn
 
     def _build_prefill(self, P: int, A: int) -> Callable:
@@ -457,6 +750,79 @@ class ContinuousEngine(ParamSnapshotPlane):
             return pools, logits_st, value_st, cl, done, resp
 
         return jax.jit(prefill, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+    def _build_prefix_prefill(self, T: int, A: int) -> Callable:
+        """Chunked tail prefill over a shared cached prefix: the ``T``
+        tail tokens of ``A`` lanes scatter K/V into lane-owned pages and
+        attend through the page table (cached prefix + tail) with a
+        causal-from-start mask; last-position logits/value + cursor
+        scattered exactly like the local prefill."""
+        model = self.model
+
+        def prefill(
+            params, pools, logits_st, value_st, cl, done, resp,
+            tokens, tail_lengths, lane_ids, page_ids, page_offsets,
+            table, starts,
+        ):
+            self._prefill_traces += 1
+            positions = jnp.clip(
+                starts[:, None] + jnp.arange(T)[None, :],
+                0,
+                model.max_len - 1,
+            )
+            out, pools = model.apply(
+                params,
+                tokens,
+                positions=positions,
+                paged_cache=pools,
+                page_ids=page_ids,
+                page_offsets=page_offsets,
+                page_table=table,
+                prefix_starts=starts,
+            )
+            rows = jnp.arange(A)
+            last = tail_lengths - 1
+            logits_last = out.policy_logits[rows, last]
+            value_last = out.baseline[rows, last]
+            logits_st = logits_st.at[lane_ids].set(logits_last, mode="drop")
+            value_st = value_st.at[lane_ids].set(value_last, mode="drop")
+            cl = cl.at[lane_ids].set(starts + tail_lengths, mode="drop")
+            done = done.at[lane_ids].set(False, mode="drop")
+            resp = resp.at[lane_ids].set(0, mode="drop")
+            return pools, logits_st, value_st, cl, done, resp
+
+        return jax.jit(prefill, donate_argnums=(1, 2, 3, 4, 5, 6))
+
+    def _build_fork(self, F: int) -> Callable:
+        """The CoW fork program at admit bucket ``F``: batched pool-page
+        copy (``pools[dst] = pools[src]`` per layer — only partial prompt
+        pages ever ride here) plus leader -> member lane-state
+        replication.  Pad rows copy null -> null and scatter-drop."""
+
+        def fork(
+            pools, logits_st, value_st, cl, done, resp,
+            src_lane, dst_lane, src_page, dst_page,
+        ):
+            self._fork_traces += 1
+            new_k = tuple(
+                kp.at[dst_page].set(kp[src_page]) for kp in pools.k
+            )
+            new_v = tuple(
+                vp.at[dst_page].set(vp[src_page]) for vp in pools.v
+            )
+            pools = PagedKVCache(k=new_k, v=new_v)
+            logits_st = logits_st.at[dst_lane].set(
+                logits_st[src_lane], mode="drop"
+            )
+            value_st = value_st.at[dst_lane].set(
+                value_st[src_lane], mode="drop"
+            )
+            cl = cl.at[dst_lane].set(cl[src_lane], mode="drop")
+            done = done.at[dst_lane].set(done[src_lane], mode="drop")
+            resp = resp.at[dst_lane].set(resp[src_lane], mode="drop")
+            return pools, logits_st, value_st, cl, done, resp
+
+        return jax.jit(fork, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     def _build_decode(self) -> Callable:
         """The ONE macro-step program: ``steps_per_macro`` fused substeps
@@ -554,13 +920,33 @@ class ContinuousEngine(ParamSnapshotPlane):
 
         return jax.jit(decode, donate_argnums=(1, 2, 3, 4, 5, 6))
 
+    # -- param plane -----------------------------------------------------
+    def push_params(
+        self,
+        params: Any,
+        learner_step: Optional[int] = None,
+        quantize: Optional[str] = None,
+    ) -> int:
+        """Publish fresh params AND flush the prefix cache: cached K/V was
+        computed under the previous generation, and reusing it would break
+        the temperature-0 token-identity contract.  Live lanes keep their
+        shared pages (their own refs) until harvest — only the cache's
+        index drops."""
+        gen = super().push_params(params, learner_step, quantize)
+        if self._prefix_cache is not None:
+            self._prefix_cache.flush()
+        return gen
+
     # -- the macro-step --------------------------------------------------
     def _ensure_pages(self) -> None:
-        """Pre-extend each live lane's page list to cover the next macro's
-        worst case (all allocation stays within the lane's admission-time
-        reservation, so it can never fail mid-flight)."""
+        """Pre-extend each live lane's page list to cover the in-flight
+        decode horizon's worst case (all allocation stays within the
+        lane's admission-time reservation, so it can never fail
+        mid-flight).  With K macros in flight the host's ``context_len``
+        is stale by up to K-1 macros, so the horizon covers the pending
+        dispatches plus the one about to go out."""
         ps = self.config.page_size
-        steps = self.config.steps_per_macro
+        steps = self.config.steps_per_macro * (len(self._inflight) + 1)
         for lane_id, lane in enumerate(self._lanes):
             if not lane.busy:
                 continue
@@ -573,7 +959,9 @@ class ContinuousEngine(ParamSnapshotPlane):
             )
             delta = need - len(lane.pages)
             if delta > 0:
-                new_pages = self.allocator.alloc(delta)
+                new_pages = self.allocator.alloc(
+                    delta, holder=f"lane[{lane_id}]"
+                )
                 start = len(lane.pages)
                 lane.pages.extend(new_pages)
                 self._table[
@@ -581,47 +969,68 @@ class ContinuousEngine(ParamSnapshotPlane):
                 ] = new_pages
 
     def step(self) -> List[CompletedSequence]:
-        """One engine cycle: admit -> decode macro-step (ONE dispatch, ONE
-        batched read) -> harvest.  Returns the sequences that completed."""
+        """One engine cycle: admit -> dispatch the next decode macro-step
+        (ONE upload) -> read the OLDEST in-flight macro once
+        ``steps_in_flight`` are pending (ONE batched read, lagging
+        dispatch by K-1) -> harvest.  Returns the sequences that
+        completed in the macro(s) read this cycle."""
         t_step0 = time.monotonic()
         self._admit()
-        if self.live_lanes == 0:
-            return []
-        self._ensure_pages()
-        params, _gen = self._snapshot_params()
-        occ = self.live_lanes / self.config.lanes
-        self._occupancy_gauge.set(occ)
-        self._occupancy_sum += occ
-        guard = steady_state_guard() if self._warm else nullcontext()
-        with guard:
-            with self._dispatch_guard():
-                self._key, sub = jax.random.split(self._key)
-                # ONE explicit batched host->device upload per macro-step
-                table_dev = _device_put(self._table)
-                (
-                    self._pools,
-                    self._logits_st,
-                    self._value_st,
-                    self._cl,
-                    self._done,
-                    self._resp,
-                    outputs,
-                ) = self._decode_fn(
-                    params,
-                    self._pools,
-                    self._logits_st,
-                    self._value_st,
-                    self._cl,
-                    self._done,
-                    self._resp,
-                    table_dev,
-                    sub,
-                )
+        dispatched = False
+        occ = 0.0
+        if self.live_lanes > 0:
+            self._ensure_pages()
+            params, _gen = self._snapshot_params()
+            occ = self.live_lanes / self.config.lanes
+            self._occupancy_gauge.set(occ)
+            self._occupancy_sum += occ
+            guard = steady_state_guard() if self._warm else nullcontext()
+            with guard:
+                with self._dispatch_guard():
+                    self._key, sub = jax.random.split(self._key)
+                    # ONE explicit batched host->device upload per macro
+                    table_dev = _device_put(self._table)
+                    (
+                        self._pools,
+                        self._logits_st,
+                        self._value_st,
+                        self._cl,
+                        self._done,
+                        self._resp,
+                        outputs,
+                    ) = self._decode_fn(
+                        params,
+                        self._pools,
+                        self._logits_st,
+                        self._value_st,
+                        self._cl,
+                        self._done,
+                        self._resp,
+                        table_dev,
+                        sub,
+                    )
+            self._inflight.append((self.macro_steps, outputs))
+            self.macro_steps += 1
+            self._warm = True
+            dispatched = True
+        completions: List[CompletedSequence] = []
+        # read the oldest in-flight macro once K are pending (reads lag
+        # dispatch by K-1); with nothing dispatched this cycle, drain —
+        # outputs are loop OUTPUTS (never donated), so holding device
+        # references to K of them while later macros run is safe by
+        # construction (the MetricsPipeline argument)
+        while self._inflight and (
+            len(self._inflight) >= self.config.steps_in_flight
+            or not dispatched
+        ):
+            macro_idx, outputs = self._inflight.popleft()
+            guard = steady_state_guard() if self._warm else nullcontext()
+            with guard:
                 # ... and ONE explicit batched device->host read
                 host = _device_get(outputs)
-        self._warm = True
-        self.macro_steps += 1
-        completions = self._harvest(host)
+            completions.extend(self._harvest(host, macro_idx))
+            if dispatched:
+                break  # steady state: exactly one read per step
         if tracing.sampling_enabled():
             # ONE head-sampled span per macro-step/harvest — never per
             # token, never per lane; stamps are the host monotonic reads
@@ -630,10 +1039,13 @@ class ContinuousEngine(ParamSnapshotPlane):
                 "genrl.macro_step", None, t_step0, time.monotonic(),
                 kind="genrl", completed=len(completions),
                 live_lanes=self.live_lanes, occupancy=round(occ, 4),
+                in_flight=len(self._inflight),
             )
         return completions
 
-    def _harvest(self, host: Dict[str, np.ndarray]) -> List[CompletedSequence]:
+    def _harvest(
+        self, host: Dict[str, np.ndarray], macro_idx: int
+    ) -> List[CompletedSequence]:
         mask = np.asarray(host["mask"], np.float32)
         tokens = np.asarray(host["tokens"], np.int32)
         logp = np.asarray(host["logp"], np.float32)
@@ -645,6 +1057,12 @@ class ContinuousEngine(ParamSnapshotPlane):
         decode_tokens = 0
         for lane_id, lane in enumerate(self._lanes):
             if not lane.busy:
+                continue
+            if lane.admit_macro > macro_idx:
+                # this read predates the lane's current occupancy (the id
+                # was recycled while this macro was in flight): the row
+                # belongs to the finished previous occupant, already
+                # harvested — never apply it to the new one
                 continue
             count = int(mask[lane_id].sum())
             decode_tokens += count
@@ -674,9 +1092,11 @@ class ContinuousEngine(ParamSnapshotPlane):
                         tag=lane.tag,
                     )
                 )
-                # release the lane: pages + reservation return to the pool
-                # immediately (the memory-scales-with-live-tokens half)
-                self.allocator.free(lane.pages)
+                # release the lane: every page hold returns to the pool
+                # (shared prefix pages just drop one ref; exclusively
+                # owned pages go back to the free list immediately — the
+                # memory-scales-with-live-tokens half)
+                self.allocator.free(lane.pages, holder=f"lane[{lane_id}]")
                 self.allocator.release(lane.reserved)
                 self._table[lane_id] = 0
                 self._lanes[lane_id] = _Lane()
@@ -701,7 +1121,11 @@ class ContinuousEngine(ParamSnapshotPlane):
         for _ in range(max_macro_steps):
             if len(out) >= n_completions:
                 return out
-            if self.live_lanes == 0 and self.pending == 0:
+            if (
+                self.live_lanes == 0
+                and self.pending == 0
+                and not self._inflight
+            ):
                 raise RuntimeError(
                     f"engine drained at {len(out)}/{n_completions} "
                     "completions (no live lanes, empty queue)"

@@ -345,13 +345,19 @@ def record_consumption_trace(
 
 
 def scripted_sequence_payload(
-    seed: int, response_len: int, vocab: int, generation: int
+    seed: int, response_len: int, vocab: int, generation: int,
+    sample: int = 0,
 ) -> Dict[str, Any]:
     """The deterministic completion a :class:`ScriptedSequenceEngine`
-    produces for lease ``seed`` — a pure function of the lease, NEVER of
-    the host that ran it, so chaos tests can assert bit-exact payloads
-    across kills, requeues, and racing duplicate executions."""
-    rng = np.random.default_rng(int(seed))
+    produces for lease ``seed`` — a pure function of the lease (and the
+    ``sample`` index within a fanned-out group), NEVER of the host that
+    ran it, so chaos tests can assert bit-exact payloads across kills,
+    requeues, and racing duplicate executions."""
+    rng = (
+        np.random.default_rng(int(seed))
+        if sample == 0
+        else np.random.default_rng((int(seed), int(sample)))
+    )
     n = int(rng.integers(1, 5))
     r = int(rng.integers(1, response_len + 1))
     return {
@@ -401,14 +407,22 @@ class ScriptedSequenceEngine:
 
     def submit(self, lease: Dict[str, Any]) -> None:
         seed = int(lease.get("seed", 0))
-        payload = scripted_sequence_payload(
-            seed, self.response_len, self.vocab, self.generation
-        )
-        self._live[id(lease)] = {
-            "lease": lease,
-            "payload": payload,
-            "remaining": len(payload["response_tokens"]),
-        }
+        samples = int(lease.get("samples", 1))
+        # a fanned-out lease occupies one scripted lane per sample —
+        # every sample is its own deterministic payload, so kills landing
+        # between sibling completions still account exactly
+        for k in range(samples):
+            payload = scripted_sequence_payload(
+                seed, self.response_len, self.vocab, self.generation,
+                sample=k,
+            )
+            self._live[(id(lease), k)] = {
+                "lease": lease,
+                "sample": k,
+                "samples": samples,
+                "payload": payload,
+                "remaining": len(payload["response_tokens"]),
+            }
 
     def step(self) -> List[Dict[str, Any]]:
         if self.step_sleep_s:
@@ -422,13 +436,21 @@ class ScriptedSequenceEngine:
                 tid = entry["lease"].get("_task_id")
                 if tid is not None:
                     payload["_task_id"] = tid
+                if entry["samples"] > 1:
+                    payload["_sample_idx"] = entry["sample"]
+                    payload["_samples_total"] = entry["samples"]
                 _inherit_trace(payload, entry["lease"])
                 done.append(payload)
                 del self._live[key]
         return done
 
     def abandon(self) -> List[Dict[str, Any]]:
-        leases = [e["lease"] for e in self._live.values()]
+        leases: List[Dict[str, Any]] = []
+        seen: Set[int] = set()
+        for e in self._live.values():
+            if id(e["lease"]) not in seen:
+                seen.add(id(e["lease"]))
+                leases.append(e["lease"])
         self._live.clear()
         return leases
 
@@ -501,32 +523,48 @@ class CohortEngineShell:
         return len(self._pending)
 
     def submit(self, lease: Dict[str, Any]) -> None:
-        self._pending.append(lease)
+        # a fanned-out lease occupies one cohort lane per sample (the
+        # GRPO tiled layout; the prefix-CoW savings live on the
+        # continuous engine — here fan-out is a data-layout feature)
+        samples = int(lease.get("samples", 1)) if isinstance(
+            lease, dict
+        ) else 1
+        for k in range(samples):
+            self._pending.append((lease, k, samples))
 
     def abandon(self) -> List[Dict[str, Any]]:
-        leases, self._pending = self._pending, []
+        leases: List[Dict[str, Any]] = []
+        seen = set()
+        for lease, _k, _n in self._pending:
+            if id(lease) not in seen:
+                seen.add(id(lease))
+                leases.append(lease)
+        self._pending = []
         return leases
 
     def step(self) -> List[Dict[str, Any]]:
         if not self._pending:
             return []
-        batch, self._pending = self._pending, []
+        # flush at most one fixed round's worth of lanes; a group whose
+        # tail overflows the round rides the next one
+        batch = self._pending[: self.round_batch]
+        self._pending = self._pending[self.round_batch :]
         lengths = np.ones((self.round_batch,), np.int32)
-        for i, t in enumerate(batch):
+        for i, (t, _k, _n) in enumerate(batch):
             lengths[i] = int(t["length"])
         L = int(lengths.max())
         # partial rounds pad with inert lanes up to the FIXED round batch
         # (batch size is a jit shape: a ragged round would retrace), and
         # the pad lanes' outputs are simply dropped below
         prompts = np.full((self.round_batch, L), 2, np.int32)
-        for i, t in enumerate(batch):
+        for i, (t, _k, _n) in enumerate(batch):
             prompts[i, : lengths[i]] = np.asarray(
                 t["prompt"], np.int32
             )[: lengths[i]]
         result = self.engine.generate(prompts, lengths)
         wire_gen = self._gen_map.get(result.generation, result.generation)
         out = []
-        for i, t in enumerate(batch):
+        for i, (t, k, n) in enumerate(batch):
             r = max(int(result.response_len[i]), 1)
             payload = {
                 "prompt": prompts[i, : lengths[i]].copy(),
@@ -539,6 +577,9 @@ class CohortEngineShell:
             tid = t.get("_task_id")
             if tid is not None:
                 payload["_task_id"] = tid
+            if n > 1:
+                payload["_sample_idx"] = k
+                payload["_samples_total"] = n
             _inherit_trace(payload, t)
             out.append(payload)
         return out
@@ -580,9 +621,16 @@ class ContinuousEngineShell:
     def submit(self, lease: Dict[str, Any]) -> None:
         key = self._next
         self._next += 1
-        self._live[key] = lease
-        self.engine.submit(
+        samples = int(lease.get("samples", 1)) if isinstance(
+            lease, dict
+        ) else 1
+        self._live[key] = {"lease": lease, "n": samples, "arrived": 0}
+        # a fanned-out lease rides submit_group: the engine admits all
+        # n lanes over ONE shared prompt prefix (CoW fork) — the perf
+        # half of the GRPO group shape
+        self.engine.submit_group(
             np.asarray(lease["prompt"], np.int32),
+            samples,
             int(lease["length"]),
             tag=key,
         )
@@ -591,16 +639,21 @@ class ContinuousEngineShell:
         """Give up leases still in flight (their lanes cannot be evicted
         mid-decode); the learner reissues them, and the eventual straggler
         completion is absorbed by lease-level dedup."""
-        leases = list(self._live.values())
+        leases = [e["lease"] for e in self._live.values()]
         self._live.clear()
         return leases
 
     def step(self) -> List[Dict[str, Any]]:
         out = []
         for c in self.engine.step():
-            lease = self._live.pop(c.tag, None)
-            if lease is None:
+            entry = self._live.get(c.tag)
+            if entry is None:
                 continue  # abandoned during a drain: the reissue owns it
+            lease = entry["lease"]
+            sample_idx = entry["arrived"]
+            entry["arrived"] += 1
+            if entry["arrived"] >= entry["n"]:
+                self._live.pop(c.tag, None)
             payload = {
                 "prompt": np.asarray(c.prompt, np.int32),
                 "prompt_len": int(c.prompt_len),
@@ -614,6 +667,9 @@ class ContinuousEngineShell:
             tid = lease.get("_task_id")
             if tid is not None:
                 payload["_task_id"] = tid
+            if entry["n"] > 1:
+                payload["_sample_idx"] = sample_idx
+                payload["_samples_total"] = entry["n"]
             _inherit_trace(payload, lease)
             out.append(payload)
         return out
@@ -1014,6 +1070,13 @@ class SequenceLearner(ParamSnapshotPlane):
         self._conn_leases: Dict[Connection, Set[int]] = {}
         self._completed_leases: "OrderedDict[int, None]" = OrderedDict()
         self._completed_cap = 65536
+        # group fan-out (ISSUE 14): a lease issued with samples=n closes
+        # only when n distinct sample indices arrived; per-(lease, sample)
+        # dedup keeps a reissue racing its original at exactly n samples
+        self._completed_samples: "OrderedDict[Tuple[int, int], None]" = (
+            OrderedDict()
+        )
+        self._sample_counts: Dict[int, int] = {}
         # open root spans per lease (head-sampled at issue time; closed at
         # ingest); bounded like the completed-lease table so a lease the
         # fleet never completes cannot leak a span forever
@@ -1353,33 +1416,58 @@ class SequenceLearner(ParamSnapshotPlane):
                 continue
             # lease-level exactly-once: a lease orphaned by a killed host
             # was reissued and may complete TWICE — the second completion
-            # is dropped here, keeping the sequence count exact
+            # is dropped here, keeping the sequence count exact.  A
+            # fanned-out lease (samples=n) dedups per (lease, sample) and
+            # closes only once all n samples landed.
             tid = seq.pop("_task_id", None) if isinstance(seq, dict) else None
             if tid is not None:
+                k = int(seq.pop("_sample_idx", 0))
+                total = int(seq.pop("_samples_total", 1))
+                closed = False
                 with self._lease_lock:
-                    if tid in self._completed_leases:
+                    if tid in self._completed_leases or (
+                        (tid, k) in self._completed_samples
+                    ):
                         self.duplicate_leases += 1
                         dup = True
                     else:
-                        self._completed_leases[tid] = None
-                        while len(self._completed_leases) > self._completed_cap:
-                            self._completed_leases.popitem(last=False)
-                        entry = self._outstanding.pop(tid, None)
-                        if entry is not None:
-                            self._conn_leases.get(entry[0], set()).discard(
-                                tid
-                            )
                         dup = False
+                        self._completed_samples[(tid, k)] = None
+                        while (
+                            len(self._completed_samples) > self._completed_cap
+                        ):
+                            self._completed_samples.popitem(last=False)
+                        got = self._sample_counts.get(tid, 0) + 1
+                        if got >= total:
+                            closed = True
+                            self._sample_counts.pop(tid, None)
+                            self._completed_leases[tid] = None
+                            while (
+                                len(self._completed_leases)
+                                > self._completed_cap
+                            ):
+                                self._completed_leases.popitem(last=False)
+                            entry = self._outstanding.pop(tid, None)
+                            if entry is not None:
+                                self._conn_leases.get(
+                                    entry[0], set()
+                                ).discard(tid)
+                        else:
+                            self._sample_counts[tid] = got
                 if dup:
                     reg.counter("disagg.duplicate_leases").inc()
                     continue
                 seq["lease_id"] = tid
-                root = self._trace_roots.pop(tid, None)
-                if root is not None:
-                    # the root span covers lease issue -> accepted ingest;
-                    # the trainer's seq_add/learn_step edges extend the
-                    # trace afterwards (record_consumption_trace)
-                    root.end(host=seq.get("host_id"))
+                if total > 1:
+                    seq["sample_idx"] = k
+                if closed:
+                    root = self._trace_roots.pop(tid, None)
+                    if root is not None:
+                        # the root span covers lease issue -> accepted
+                        # ingest (of the LAST group sample); the trainer's
+                        # seq_add/learn_step edges extend the trace
+                        # afterwards (record_consumption_trace)
+                        root.end(host=seq.get("host_id"))
             if tracing.TRACE_KEY in seq:
                 seq["_t_q"] = time.monotonic()  # replay-wait edge opens
             self.total_sequences += 1

@@ -16,24 +16,38 @@ batched transfer per macro-step.  Two-level accounting:
   the continuous plane really uses — scales with live tokens, not with
   ``max_bucket x lanes`` (early-EOS lanes return their pages immediately).
 
+Pages are **refcounted** (ISSUE 14): a full prefix page can back several
+lanes at once (group sampling forks n lanes over one prompt's KV, and the
+prefix cache keeps hot chains alive between admissions).  :meth:`alloc`
+starts a page at refcount 1, :meth:`share` bumps it on behalf of another
+holder, and :meth:`free` decrements — the page returns to the free list
+only at zero.  Every hold is labelled with its *holder* (``"lane[3]"``,
+``"prefix-cache"``), so the double-free / foreign-free guards can name
+exactly who held what when the invariant broke.
+
 Page 0 is the **null page**: never handed out, the routing target for
 dead-lane and pad writes, never read (reads are masked by true lengths).
-Double-free and double-alloc are hard errors — the no-aliasing invariant
+Double-free and foreign-free are hard errors — the no-aliasing invariant
 the randomized admit/finish test hammers.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Set
+from typing import Callable, Dict, List, Optional
 
 
 class PageAllocator:
-    """Free-list page allocator with admission reservations.
+    """Refcounted free-list page allocator with admission reservations.
 
     ``num_pages`` includes the null page, so ``capacity = num_pages - 1``
     pages are actually allocatable.  All methods are O(1)/O(k) list ops;
     not thread-safe (the continuous engine drives it from its one host
     loop, like every other host-side queue in the codebase).
+
+    ``reclaim``: optional hook called when :meth:`alloc` finds the free
+    list short — the prefix cache registers its LRU evictor here, so
+    cached-but-unreferenced chains are reclaimed on demand instead of
+    counting against admission.
     """
 
     def __init__(self, num_pages: int, page_size: int) -> None:
@@ -50,8 +64,10 @@ class PageAllocator:
         # churny run naturally fragments lane->page maps — which is why
         # fragmentation-independence is a tested property, not an accident
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._live: Set[int] = set()
+        self._refs: Dict[int, int] = {}  # live page -> refcount
+        self._holders: Dict[int, List[str]] = {}  # live page -> holder labels
         self.reserved = 0
+        self._reclaim: Optional[Callable[[int], int]] = None
 
     # -- capacity ------------------------------------------------------
     @property
@@ -64,15 +80,39 @@ class PageAllocator:
 
     @property
     def allocated_pages(self) -> int:
-        return len(self._live)
+        return len(self._refs)
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently held by more than one holder (CoW prefixes)."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    def refcount(self, page: int) -> int:
+        """Current holder count for ``page`` (0 = not live)."""
+        return self._refs.get(page, 0)
+
+    def holders(self, page: int) -> List[str]:
+        """Holder labels currently registered on ``page`` (diagnostics)."""
+        return list(self._holders.get(page, ()))
 
     def pages_for_tokens(self, tokens: int) -> int:
         return -(-tokens // self.page_size)  # ceil div
 
+    def set_reclaim_hook(self, hook: Optional[Callable[[int], int]]) -> None:
+        """Register ``hook(n) -> freed``: asked to return up to ``n`` pages
+        to the free list (the prefix cache's LRU evictor)."""
+        self._reclaim = hook
+
     # -- reservations (admission control) ------------------------------
     def try_reserve(self, n_pages: int) -> bool:
         """Reserve worst-case capacity for a new sequence; False =
-        backpressure (the pool cannot guarantee the sequence finishes)."""
+        backpressure (the pool cannot guarantee the sequence finishes).
+
+        A lane's reservation covers EVERY page in its table — shared
+        prefix pages included — so sharing never loosens the exhaustion
+        guarantee: the win of the prefix cache is skipped prefill compute
+        and fewer *allocated* pages, not a larger admission envelope.
+        """
         if self.reserved + n_pages > self.capacity:
             return False
         self.reserved += n_pages
@@ -88,27 +128,64 @@ class PageAllocator:
         self.reserved -= n_pages
 
     # -- physical pages ------------------------------------------------
-    def alloc(self, n_pages: int) -> List[int]:
-        """Draw ``n_pages`` physical pages.  Callers alloc only within
-        their reservation, so an empty free list here is a bookkeeping bug
-        (aliasing hazard) and raises instead of corrupting."""
+    def alloc(self, n_pages: int, holder: str = "?") -> List[int]:
+        """Draw ``n_pages`` fresh physical pages at refcount 1.  Callers
+        alloc only within their reservation; when the free list is short
+        the reclaim hook (prefix-cache LRU eviction) is asked first, and
+        an empty free list after that is a bookkeeping bug (aliasing
+        hazard) and raises instead of corrupting."""
+        if n_pages > len(self._free) and self._reclaim is not None:
+            self._reclaim(n_pages - len(self._free))
         if n_pages > len(self._free):
             raise RuntimeError(
-                f"alloc({n_pages}) with only {len(self._free)} free pages "
-                f"(reserved={self.reserved}) — reservation accounting broken"
+                f"alloc({n_pages}) by {holder!r} with only "
+                f"{len(self._free)} free pages (reserved={self.reserved}) "
+                "— reservation accounting broken"
             )
         pages = [self._free.pop() for _ in range(n_pages)]
-        self._live.update(pages)
+        for p in pages:
+            self._refs[p] = 1
+            self._holders[p] = [holder]
         return pages
 
-    def free(self, pages: List[int]) -> None:
-        """Return physical pages.  Double-free (or freeing the null page)
-        raises — the invariant that no page is ever owned by two lanes."""
+    def share(self, pages: List[int], holder: str = "?") -> None:
+        """Bump the refcount of already-live pages on behalf of a new
+        holder (a forked group lane or the prefix cache).  Sharing a page
+        that is not live is a hard error — it would alias a recycled
+        page."""
         for p in pages:
-            if p == 0 or p not in self._live:
-                raise RuntimeError(f"free of page {p} not currently live")
-            self._live.remove(p)
-            self._free.append(p)
+            if p == 0 or p not in self._refs:
+                raise RuntimeError(
+                    f"share of page {p} by {holder!r}: page is not live "
+                    "(never allocated, or already fully freed)"
+                )
+        for p in pages:
+            self._refs[p] += 1
+            self._holders[p].append(holder)
+
+    def free(self, pages: List[int], holder: str = "?") -> None:
+        """Drop one hold per page; a page returns to the free list only
+        when its refcount reaches zero.  Freeing a non-live page
+        (double-free) or a page this holder never held (foreign-free)
+        raises, naming the page and the holders involved."""
+        for p in pages:
+            if p == 0 or p not in self._refs:
+                raise RuntimeError(
+                    f"free of page {p} by {holder!r}: page is not live "
+                    "(double free, or never allocated)"
+                )
+            held = self._holders[p]
+            if holder != "?" and holder not in held:
+                raise RuntimeError(
+                    f"free of page {p} by {holder!r}: foreign free — page "
+                    f"is held by {held!r}"
+                )
+            held.remove(holder if holder in held else held[-1])
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                del self._holders[p]
+                self._free.append(p)
 
     # -- telemetry -----------------------------------------------------
     def stats(self) -> Dict[str, int]:
@@ -116,5 +193,6 @@ class PageAllocator:
             "capacity": self.capacity,
             "free": self.free_pages,
             "allocated": self.allocated_pages,
+            "shared": self.shared_pages,
             "reserved": self.reserved,
         }

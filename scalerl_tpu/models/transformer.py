@@ -214,6 +214,7 @@ class _Block(nn.Module):
         page_offsets: Optional[jnp.ndarray] = None,
         page_table: Optional[jnp.ndarray] = None,
         attn_lengths: Optional[jnp.ndarray] = None,
+        prefix_starts: Optional[jnp.ndarray] = None,
     ):
         """Full forward (``layer_cache=None``) or KV-cached incremental step.
 
@@ -234,6 +235,16 @@ class _Block(nn.Module):
         *through the pool* via ``paged_attn_fn(q, k_pages, v_pages,
         page_table, attn_lengths)`` (paged single-token decode); returns
         ``(out, (k_pages, v_pages))``.  Same params on every path.
+
+        With ``page_table`` AND ``prefix_starts`` ``[B]`` this is the
+        *shared-table tail prefill* (the prefix-cache path, ISSUE 14):
+        the ``T`` tokens sit at global positions ``prefix_starts[b] + t``
+        on top of a cached prefix whose K/V already lives in pool pages
+        mapped by the table; this call's K/V is scattered first, then
+        attention gathers the WHOLE context (cached prefix + this chunk)
+        through the table under a causal-from-start mask — a plain XLA
+        gather + :func:`_masked_attention`, no kernel involvement, so
+        sharing stays purely a page-table fact.
         """
         B, T, _ = x.shape
         head_dim = self.d_model // self.num_heads
@@ -263,7 +274,26 @@ class _Block(nn.Module):
                 .set(v.astype(vp.dtype).reshape(B * T, *v.shape[2:]))
                 .reshape(vp.shape)
             )
-            if page_table is not None:
+            if page_table is not None and prefix_starts is not None:
+                # shared-table tail prefill: gather the whole context
+                # (cached prefix pages + the tail just scattered above)
+                # through the table, attend causal-from-start — the
+                # compute twin of the decode seam at T > 1, kernel-free
+                M = page_table.shape[1]
+                gidx = (
+                    page_table[:, :, None] * ps
+                    + jnp.arange(ps)[None, None, :]
+                ).reshape(B, M * ps)
+                kg = kp.reshape(N * ps, *kp.shape[2:])[gidx]
+                vg = vp.reshape(N * ps, *vp.shape[2:])[gidx]
+                pos = jnp.arange(M * ps)[None, None, :]
+                qpos = (
+                    prefix_starts[:, None] + jnp.arange(T)[None, :]
+                )[:, :, None]
+                out = _masked_attention(
+                    q, kg, vg, pos <= qpos, self.dtype
+                )
+            elif page_table is not None:
                 paged_attn = self.paged_attn_fn or paged_attention_reference
                 out = paged_attn(q, kp, vp, page_table, attn_lengths)
                 out = out.astype(self.dtype)
@@ -359,6 +389,7 @@ class TransformerPolicy(nn.Module):
         page_offsets: Optional[jnp.ndarray] = None,
         page_table: Optional[jnp.ndarray] = None,
         attn_lengths: Optional[jnp.ndarray] = None,
+        prefix_starts: Optional[jnp.ndarray] = None,
     ):
         """Full forward, masked full forward, or KV-cached incremental step.
 
@@ -380,7 +411,10 @@ class TransformerPolicy(nn.Module):
           prompts (:func:`prompt_attention_mask` — attention is local, the
           pool is write-only); with ``page_table=[B, M]`` +
           ``attn_lengths=[B]`` and ``T = 1`` it is paged *decode*
-          (attention gathers through the table).  Returns
+          (attention gathers through the table); with ``page_table`` +
+          ``prefix_starts=[B]`` it is the shared-table *tail prefill*
+          over a cached prefix (the prefix-cache path — see
+          :class:`_Block`).  Returns
           ``(TransformerOutput, new_paged_cache)``.  Same params as every
           other path.
         """
@@ -437,6 +471,7 @@ class TransformerPolicy(nn.Module):
                     page_offsets=page_offsets,
                     page_table=page_table,
                     attn_lengths=attn_lengths,
+                    prefix_starts=prefix_starts,
                 )
                 new_k.append(bk)
                 new_v.append(bv)

@@ -123,6 +123,8 @@ class SequenceRLTrainer:
                     admit_max_wait_s=args.genrl_admit_wait_ms / 1e3,
                     max_pending=args.genrl_max_pending,
                     paged_attn=args.genrl_paged_attn,
+                    steps_in_flight=args.genrl_steps_in_flight,
+                    prefix_cache=args.genrl_prefix_cache,
                     **base_cfg,
                 ),
                 iter_mode=args.genrl_iter_mode,
@@ -178,9 +180,18 @@ class SequenceRLTrainer:
         return nullcontext()
 
     def _generate_round(self):
-        prompts, lengths = self.task.sample_prompts(
-            self.args.genrl_batch, self._rng
-        )
+        B = self.args.genrl_batch
+        spp = self.args.samples_per_prompt
+        if spp > 1:
+            # group sampling on the cohort engine: tile each distinct
+            # prompt spp times — the GRPO data layout (groups contiguous);
+            # the cohort path pays full prefill per lane, the prefix-CoW
+            # savings live on the continuous engine
+            prompts, lengths = self.task.sample_prompts(B // spp, self._rng)
+            prompts = np.repeat(prompts, spp, axis=0)
+            lengths = np.repeat(lengths, spp, axis=0)
+        else:
+            prompts, lengths = self.task.sample_prompts(B, self._rng)
         result = self.engine.generate(prompts, lengths)
         rewards = self.task.score(
             prompts, lengths, result.response_tokens, result.response_len
@@ -205,6 +216,7 @@ class SequenceRLTrainer:
         ``genrl_batch`` finished sequences (macro-steps that overshoot bank
         their extras in the backlog — insert batches stay shape-stable)."""
         B = self.args.genrl_batch
+        spp = self.args.samples_per_prompt
         while len(self._completion_backlog) < B:
             deficit = (
                 B
@@ -213,11 +225,15 @@ class SequenceRLTrainer:
                 - self.engine.pending
             )
             if deficit > 0:
+                # group sampling: one submit_group per distinct prompt
+                # fans out into spp lanes sharing the prompt KV
+                # copy-on-write (overshoot banks in the backlog)
+                n_groups = -(-deficit // spp)
                 prompts, lengths = self.task.sample_prompts(
-                    deficit, self._rng
+                    n_groups, self._rng
                 )
-                for i in range(deficit):
-                    self.engine.submit(prompts[i], lengths[i])
+                for i in range(n_groups):
+                    self.engine.submit_group(prompts[i], spp, lengths[i])
             self._completion_backlog.extend(self.engine.step())
         batch = self._completion_backlog[:B]
         self._completion_backlog = self._completion_backlog[B:]
@@ -489,11 +505,19 @@ class DisaggSequenceRLTrainer:
             seq = self._lease_seq
             prompts, lengths = self.task.sample_prompts(1, self._lease_rng)
         n = int(lengths[0])
-        return {
+        lease = {
             "seed": seq,
             "prompt": prompts[0, :n].astype(np.int32),
             "length": n,
         }
+        spp = self.args.samples_per_prompt
+        if spp > 1:
+            # group sampling: this lease fans out into spp completions on
+            # the generation host (submit_group on the continuous engine,
+            # tiled lanes on the cohort engine) — the learner counts the
+            # lease complete when all spp samples arrived
+            lease["samples"] = spp
+        return lease
 
     def train_round(self) -> Dict[str, float]:
         """One disaggregated round: drain ``genrl_batch`` wire sequences

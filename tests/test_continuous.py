@@ -20,6 +20,7 @@ from scalerl_tpu.genrl.continuous import (
 from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
 from scalerl_tpu.genrl.rollout import pack_completions, sequence_field_shapes
 from scalerl_tpu.models.transformer import TransformerPolicy
+from scalerl_tpu.runtime import telemetry
 from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer
 
 V = 11
@@ -57,7 +58,7 @@ def setup():
         ContinuousConfig(
             vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
             temperature=0.0, seed=7, lanes=4, page_size=4,
-            steps_per_macro=3,
+            steps_per_macro=3, steps_in_flight=1,  # legacy sync semantics
         ),
     )
     return dict(
@@ -92,9 +93,16 @@ def test_greedy_parity_fixed_vs_continuous(setup):
         )
         np.testing.assert_allclose(c.values, ref.values[i, :n], atol=1e-5)
         assert c.generation == 0
-    # every page and reservation came back when the lanes drained
-    assert cont.allocator.allocated_pages == 0
+    # every reservation came back when the lanes drained; the only pages
+    # still allocated are the prefix-cache's chains (refcount 1 each)
     assert cont.allocator.reserved == 0
+    assert (
+        cont.allocator.allocated_pages == cont._prefix_cache.cached_pages
+    )
+    assert all(
+        cont.allocator.refcount(n.page) == 1
+        for n in cont._prefix_cache._nodes.values()
+    )
 
 
 def test_one_batched_transfer_per_macro_step(setup, monkeypatch):
@@ -227,8 +235,11 @@ def test_eos_latch_variable_lengths_and_page_return():
         if r < R_MAX:
             assert c.response_tokens[-1] == 1  # latched on sampling EOS
         assert c.finish_time >= c.admit_time >= c.submit_time
-    assert eng.allocator.allocated_pages == 0
+    # reservations fully returned; only cache-held chains stay allocated
     assert eng.allocator.reserved == 0
+    assert (
+        eng.allocator.allocated_pages == eng._prefix_cache.cached_pages
+    )
     assert eng.completed_total == 8
     assert 0.0 < eng.mean_occupancy <= 1.0
 
@@ -256,9 +267,13 @@ def test_page_exhaustion_backpressure_and_shedding():
     assert eng._batcher.shed_total == 1
     done = eng.run_until(2, max_macro_steps=100)
     assert len(done) == 2
-    # the pool never over-committed: one sequence's pages at a time
+    # the pool never over-committed: one sequence's pages at a time, and
+    # any cache-held leftovers are reclaimable (refcount 1)
     assert eng.allocator.capacity == 3
-    assert eng.allocator.allocated_pages == 0 and eng.allocator.reserved == 0
+    assert eng.allocator.reserved == 0
+    assert (
+        eng.allocator.allocated_pages == eng._prefix_cache.cached_pages
+    )
 
 
 def test_pack_completions_layout_and_fields():
@@ -398,6 +413,332 @@ def test_trainer_rides_continuous_engine():
     assert trainer.engine._decode_traces == 1  # one macro program, ever
 
 
+# ---------------------------------------------------------------------------
+# shared-prefix KV reuse + CoW group sampling + pipelining (ISSUE 14)
+
+
+def test_submit_group_cow_parity_and_prefill_savings(setup):
+    """The acceptance pin for group sampling: submit_group(prompt, 8) at
+    temperature 0 produces 8 completions TOKEN-IDENTICAL to the
+    fixed-cohort reference — 7 of them riding the leader's prompt pages
+    copy-on-write — and the prefill-savings ratio hits the bench
+    acceptance bar ((n-1)/n of full-page prefix tokens >= 0.8)."""
+    m, params = setup["model"], setup["params"]
+    ref = setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7, lanes=8, page_size=4,
+            steps_per_macro=3,
+        ),
+    )
+    shared_before = (
+        telemetry.get_registry().counter("genrl.pages_shared").value
+    )
+    assert eng.submit_group(prompts[0], 8, lengths[0], tag="grp")
+    done = eng.run_until(8, max_macro_steps=80)
+    n = int(ref.response_len[0])
+    for c in done:
+        assert c.tag == "grp"
+        np.testing.assert_array_equal(
+            c.response_tokens, ref.response_tokens[0, :n]
+        )
+        np.testing.assert_allclose(
+            c.behavior_logp, ref.behavior_logp[0, :n], atol=1e-5
+        )
+    # prompt len 6 @ page_size 4 -> 4 full-page tokens per lane; the
+    # leader prefilled them, the 7 members shared them CoW
+    assert eng.prefix_tokens_total == 8 * 4
+    assert eng.prefix_tokens_saved == 7 * 4
+    assert eng.prefix_saved_ratio >= 0.8
+    assert eng._fork_traces == 1  # one jitted fork program, one dispatch
+    after = telemetry.get_registry().counter("genrl.pages_shared").value
+    assert after - shared_before >= 7
+    assert eng.allocator.reserved == 0
+
+
+def test_prefix_cache_hit_skips_prefill_token_identical(setup):
+    """Single-prompt submits take the same cache-lookup path: the second
+    admission of a prompt shares its cached full-page prefix (saved
+    tokens grow, prefilled tokens shrink) and decodes to IDENTICAL
+    tokens/logps through the shared-table tail-prefill program."""
+    m, params = setup["model"], setup["params"]
+    ref = setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7, lanes=2, page_size=2,
+            steps_per_macro=3, steps_in_flight=1,
+        ),
+    )
+    eng.submit(prompts[0], lengths[0])
+    first = eng.run_until(1, max_macro_steps=40)[0]
+    assert eng.prefix_tokens_saved == 0
+    prefilled_cold = eng.prefill_tokens
+    assert prefilled_cold == int(lengths[0])
+    eng.submit(prompts[0], lengths[0])
+    second = eng.run_until(1, max_macro_steps=40)[0]
+    # lookup caps at prompt_len - 1 = 5 tokens -> 2 full pages = 4 tokens
+    assert eng.prefix_tokens_saved == 4
+    assert eng.prefill_tokens == prefilled_cold + int(lengths[0]) - 4
+    assert eng._prefix_cache.hits >= 1
+    n = int(ref.response_len[0])
+    for c in (first, second):
+        np.testing.assert_array_equal(
+            c.response_tokens, ref.response_tokens[0, :n]
+        )
+        np.testing.assert_allclose(
+            c.behavior_logp, ref.behavior_logp[0, :n], atol=1e-5
+        )
+        np.testing.assert_allclose(c.values, ref.values[0, :n], atol=1e-5)
+
+
+def test_pipelined_steps_in_flight_parity_and_lagged_reads(setup, monkeypatch):
+    """K=3 macro-steps in flight: reads lag dispatch by K-1 (the first
+    K-1 steps dispatch without reading), steady steps still do exactly
+    ONE upload + ONE batched read under the armed guard, and the
+    completions stay token-identical to the fixed-cohort reference."""
+    import scalerl_tpu.genrl.continuous as cont_mod
+
+    m, params = setup["model"], setup["params"]
+    ref = setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7, lanes=4, page_size=4,
+            steps_per_macro=1, steps_in_flight=3,
+        ),
+    )
+    # warm: compile decode + prefill off the counting clock, then drain
+    # the warmup's leftover in-flight macros so the counted window starts
+    # from an empty pipeline
+    eng.submit(prompts[4], lengths[4])
+    eng.run_until(1, max_macro_steps=40)
+    while eng._inflight:
+        eng.step()
+    puts, gets = [], []
+    real_put, real_get = cont_mod._device_put, cont_mod._device_get
+    monkeypatch.setattr(
+        cont_mod, "_device_put", lambda x: (puts.append(1), real_put(x))[1]
+    )
+    monkeypatch.setattr(
+        cont_mod, "_device_get", lambda x: (gets.append(1), real_get(x))[1]
+    )
+    for i in range(4):
+        eng.submit(prompts[i], lengths[i])
+    done = []
+    # warmup never leaves more than K-1 macros in flight
+    assert len(eng._inflight) <= 2
+    steps = 0
+    lagged = 0
+    steady = 0
+    while len(done) < 4 and steps < 100:
+        depth_before = len(eng._inflight)
+        was_steady = (
+            depth_before == 2 and eng.pending == 0 and eng.live_lanes > 0
+        )
+        puts.clear()
+        gets.clear()
+        got = eng.step()
+        done.extend(got)
+        steps += 1
+        if not gets and eng.live_lanes:
+            lagged += 1  # a dispatch whose read is still in flight
+        if was_steady:
+            # pipeline full, no admission: exactly ONE upload (the
+            # table) + ONE batched read per macro-step, K-1 behind
+            assert (len(puts), len(gets)) == (1, 1)
+            steady += 1
+    assert lagged >= 1  # reads genuinely lag dispatch
+    assert steady >= 1  # the (1, 1) steady state was actually exercised
+    by_prompt = _by_prompt(done)
+    for i in range(4):
+        c = by_prompt[tuple(prompts[i][: lengths[i]].tolist())]
+        n = int(ref.response_len[i])
+        np.testing.assert_array_equal(
+            c.response_tokens, ref.response_tokens[i, :n]
+        )
+        np.testing.assert_allclose(
+            c.behavior_logp, ref.behavior_logp[i, :n], atol=1e-5
+        )
+
+
+def test_push_params_flushes_prefix_cache(setup):
+    """A param push invalidates the whole prefix index (cached K/V
+    belongs to the old generation); re-admission recomputes and stays
+    token-identical when the pushed params are unchanged."""
+    m, params = setup["model"], setup["params"]
+    ref = setup["ref"]
+    prompts, lengths = setup["prompts"], setup["lengths"]
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            temperature=0.0, seed=7, lanes=2, page_size=2,
+            steps_per_macro=3, steps_in_flight=1,
+        ),
+    )
+    eng.submit(prompts[0], lengths[0])
+    eng.run_until(1, max_macro_steps=40)
+    assert eng._prefix_cache.cached_pages > 0
+    gen = eng.push_params(params)
+    assert eng._prefix_cache.cached_pages == 0
+    assert eng.allocator.allocated_pages == 0  # cache refs released
+    saved_before = eng.prefix_tokens_saved
+    eng.submit(prompts[0], lengths[0])
+    c = eng.run_until(1, max_macro_steps=40)[0]
+    assert eng.prefix_tokens_saved == saved_before  # recomputed, no hit
+    assert c.generation == gen
+    n = int(ref.response_len[0])
+    np.testing.assert_array_equal(
+        c.response_tokens, ref.response_tokens[0, :n]
+    )
+
+
+def test_churn_grouped_admits_evictions_no_aliasing_token_identity():
+    """Satellite: 300 churn steps mixing grouped admits, prefix hits,
+    mid-group EOS, param-push flushes, and LRU evictions over a tight
+    pool — the NO-ALIASING invariant (a page mapped by two live lanes is
+    a shared full-page prompt prefix whose token span AGREES between the
+    lanes, and the allocator's live/free sets always partition the pool)
+    checked at every step, and temperature-0 token-identity vs the
+    CACHE-OFF engine asserted for every completion after every phase."""
+    m = _model()
+    # init/pool seeds chosen so several pool prompts greedy-decode into an
+    # early EOS (mid-group EOS is part of the churn mix, not an accident)
+    params = m.init(jax.random.PRNGKey(7), jnp.zeros((1, 2), jnp.int32))
+    base = dict(
+        vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+        temperature=0.0, eos_token=1, seed=5, page_size=2,
+        steps_per_macro=2,
+    )
+    lanes = 6
+    worst = -(-(P_MAX + R_MAX) // 2)  # pages per worst-case sequence
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            lanes=lanes, num_pages=lanes * worst + 1, **base
+        ),
+    )
+    twin = ContinuousEngine(  # the cache-off oracle
+        m, params,
+        ContinuousConfig(
+            lanes=2, prefix_cache=False, steps_in_flight=1, **base
+        ),
+    )
+    rng = np.random.default_rng(15)
+    pool = []
+    for _ in range(6):
+        n = int(rng.integers(2, P_MAX + 1))
+        pool.append(rng.integers(2, V, size=n).astype(np.int32))
+    expected = {}
+
+    def oracle(prompt):
+        key = tuple(prompt.tolist())
+        if key not in expected:
+            twin.submit(prompt, len(prompt))
+            expected[key] = twin.run_until(1, max_macro_steps=60)[0]
+        return expected[key]
+
+    def check_no_aliasing():
+        a = eng.allocator
+        assert not set(a._refs) & set(a._free)
+        assert len(a._refs) + a.free_pages == a.capacity
+        live = [
+            (l.pages, l.prompt, l.prompt_len)
+            for l in eng._lanes
+            if l.busy
+        ]
+        for i in range(len(live)):
+            for j in range(i + 1, len(live)):
+                pi, pri, ni = live[i]
+                pj, prj, nj = live[j]
+                for p in set(pi) & set(pj):
+                    assert a.refcount(p) >= 2
+                    ki, kj = pi.index(p), pj.index(p)
+                    assert ki == kj  # same chain depth
+                    span_i = pri[ki * 2 : (ki + 1) * 2]
+                    span_j = prj[kj * 2 : (kj + 1) * 2]
+                    np.testing.assert_array_equal(span_i, span_j)
+                    # shared pages are FULL prompt pages: never in either
+                    # lane's writable region
+                    assert (ki + 1) * 2 <= ni and (kj + 1) * 2 <= nj
+
+    completions = []
+    short = 0
+    for phase in range(10):
+        for _ in range(30):
+            if eng.pending < 4:
+                prompt = pool[int(rng.integers(len(pool)))]
+                n = int(rng.integers(1, 4))
+                eng.submit_group(prompt, n, len(prompt))
+            completions.extend(eng.step())
+            check_no_aliasing()
+        # identity vs the cache-off oracle after every churn phase
+        for c in completions:
+            e = oracle(np.asarray(c.prompt))
+            np.testing.assert_array_equal(
+                c.response_tokens, e.response_tokens
+            )
+            np.testing.assert_allclose(
+                c.behavior_logp, e.behavior_logp, atol=1e-5
+            )
+            np.testing.assert_allclose(c.values, e.values, atol=1e-5)
+            if len(c.response_tokens) < R_MAX:
+                short += 1
+        completions = []
+        if phase == 4:
+            # same-weights push: flushes the cache mid-churn without
+            # changing the greedy trajectory — post-flush re-admits must
+            # recompute to the same tokens
+            eng.push_params(params)
+            assert eng._prefix_cache.cached_pages == 0
+    assert eng._decode_traces == 1  # zero retraces across all churn
+    assert eng._prefix_cache.hits > 0  # prefix hits genuinely occurred
+    assert short > 0  # some sequences latched EOS short of the budget
+    stats = eng._prefix_cache.stats()
+    assert stats["evictions"] > 0  # flush/LRU reclaim genuinely fired
+
+
+def test_trainer_group_sampling_continuous_and_cohort():
+    """samples_per_prompt on both trainers: the continuous engine admits
+    via submit_group (prefill savings accrue), the cohort engine tiles
+    prompts (GRPO layout only) — both train a finite round."""
+    base = dict(
+        seed=3, vocab_size=8, prompt_len=4, max_new_tokens=4,
+        d_model=32, n_layers=1, n_heads=2,
+        genrl_batch=8, genrl_sample_batch=8, genrl_buffer_sequences=16,
+        telemetry_interval_s=0.0, logger_backend="none",
+        samples_per_prompt=4,
+    )
+    args = GenRLArguments(
+        genrl_engine="continuous", genrl_lanes=8, genrl_page_size=2,
+        genrl_macro_steps=2, **base,
+    )
+    trainer = SequenceRLTrainer(args)
+    metrics = trainer.train_round()
+    assert np.isfinite(metrics["total_loss"])
+    # 2 groups of 4: each group's 3 followers shared the leader's full
+    # prompt pages
+    assert trainer.engine.prefix_tokens_saved > 0
+    assert trainer.engine.prefix_saved_ratio >= 0.5
+    cohort = SequenceRLTrainer(GenRLArguments(**base))
+    result, rewards = cohort._generate_round()
+    assert len(rewards) == 8
+    # tiled layout: prompts within each group of 4 are identical
+    pl = result.prompt_len
+    for g in range(2):
+        rows = result.sequences[4 * g : 4 * (g + 1), : result.prompt_pad]
+        assert (rows == rows[0]).all()
+        assert (pl[4 * g : 4 * (g + 1)] == pl[4 * g]).all()
+
+
 def test_continuous_config_and_args_validation():
     base = dict(vocab_size=8, max_prompt_len=4, max_new_tokens=4)
     with pytest.raises(ValueError):
@@ -410,6 +751,8 @@ def test_continuous_config_and_args_validation():
         ContinuousConfig(min_free_lanes=0, **base).validate()
     with pytest.raises(ValueError):
         ContinuousConfig(temperature=-0.1, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(steps_in_flight=0, **base).validate()
     ContinuousConfig(temperature=0.0, **base).validate()  # greedy is legal
     argbase = dict(
         vocab_size=8, prompt_len=4, max_new_tokens=4,
@@ -423,4 +766,24 @@ def test_continuous_config_and_args_validation():
         GenRLArguments(genrl_macro_steps=0, **argbase).validate()
     with pytest.raises(ValueError):
         GenRLArguments(genrl_paged_attn="cuda", **argbase).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(samples_per_prompt=0, **argbase).validate()
+    with pytest.raises(ValueError):
+        # genrl_batch (default 32) must hold whole groups
+        GenRLArguments(samples_per_prompt=3, **argbase).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(genrl_steps_in_flight=0, **argbase).validate()
+    GenRLArguments(samples_per_prompt=4, **argbase).validate()
     GenRLArguments(genrl_engine="continuous", **argbase).validate()
+    # submit_group rejects groups wider than the lane pool
+    m = _model()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    eng = ContinuousEngine(
+        m, params,
+        ContinuousConfig(
+            vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=R_MAX,
+            lanes=2, temperature=0.0,
+        ),
+    )
+    with pytest.raises(ValueError):
+        eng.submit_group(np.asarray([3, 4], np.int32), 3)

@@ -208,6 +208,81 @@ def test_duplicate_uploads_and_raced_lease_completions_count_once():
     assert learner.duplicate_leases == 1
 
 
+def test_lease_group_fanout_exact_sample_accounting():
+    """ISSUE 14: a lease issued with samples=n fans out into n sequences
+    on the generation host.  Exactly n samples per lease are accepted —
+    byte-identical to the per-(seed, sample) deterministic expectation —
+    and redelivered or reissue-raced samples dedup per (lease, sample)."""
+    n_leases, spp = 12, 3
+
+    def _group_source():
+        base = _lease_source(n_leases)
+
+        def source():
+            lease = base()
+            if lease is not None:
+                lease["samples"] = spp
+            return lease
+
+        return source
+
+    cfg = DisaggConfig(
+        num_hosts=2, lanes_per_host=6, upload_batch=2,
+        heartbeat_interval_s=0.5,
+    )
+    learner = SequenceLearner(cfg, _group_source())
+    learner.start()
+    learner.publish(_weights(), learner_step=0)
+    fleet = LocalGenerationFleet(
+        learner, cfg,
+        ScriptedEngineFactory(lanes=6, response_len=6, tokens_per_step=2),
+        use_threads=True,
+    )
+    fleet.start()
+    try:
+        seqs = _collect(learner, n_leases * spp)
+        assert len(seqs) == n_leases * spp
+        assert learner.duplicate_sequences == 0
+        assert learner.duplicate_leases == 0
+        # exactly spp distinct samples per lease, every byte scripted
+        groups = {}
+        for s in seqs:
+            groups.setdefault(s["lease_id"], set()).add(s["sample_idx"])
+            expect = scripted_sequence_payload(
+                s["seed"], 6, 32, 1, sample=s["sample_idx"]
+            )
+            for key in (
+                "prompt", "response_tokens", "behavior_logp", "values",
+            ):
+                np.testing.assert_array_equal(s[key], expect[key])
+        assert len(groups) == n_leases
+        assert all(v == set(range(spp)) for v in groups.values())
+    finally:
+        learner.stop()
+        fleet.join()
+    # unit: a straggler duplicate of an accepted (lease, sample) drops,
+    # and the lease closes only once all samples landed
+    learner2 = SequenceLearner(
+        DisaggConfig(num_hosts=1, heartbeat_interval_s=0.0),
+        _lease_source(1),
+    )
+    mk = lambda k, sid: dict(  # noqa: E731
+        scripted_sequence_payload(1, 4, 16, 0, sample=k),
+        host_id=1, host_epoch=5, seq_id=sid, _task_id=50,
+        _sample_idx=k, _samples_total=2,
+    )
+    learner2._ingest([mk(0, 0)])
+    assert 50 not in learner2._completed_leases  # half-complete group
+    race = mk(0, 7)
+    race["host_id"] = 2  # reissue race: fresh upload key, same sample
+    learner2._ingest([race])
+    assert learner2.duplicate_leases == 1
+    learner2._ingest([mk(1, 1)])
+    assert 50 in learner2._completed_leases
+    assert learner2.total_sequences == 2
+    learner2.stop()
+
+
 def test_lease_requeue_on_host_disconnect():
     """A dead host link requeues its outstanding leases; the next lease
     request serves the requeues first."""

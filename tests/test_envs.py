@@ -290,10 +290,15 @@ def test_jax_breakout_mechanics():
     assert bool(s2.bricks.all())
 
 
+@pytest.mark.slow
 def test_jax_breakout_tracker_beats_random():
     """A hand-coded ball-tracking policy far outscores random play — the
     env rewards *control*, which is what makes it the flagship stand-in
-    for the ALE row (VERDICT r3 missing #3)."""
+    for the ALE row (VERDICT r3 missing #3).
+
+    ~20 s of pure env rollouts: rides ``-m slow`` (ISSUE 14 tier-1
+    budget trim); env mechanics stay tier-1-covered by the step/reset
+    unit tests above."""
     from scalerl_tpu.envs import JaxBreakout, JaxVecEnv
 
     # wider field than default: random's fluke catches get rarer, so the

@@ -844,6 +844,66 @@ def test_jg001_continuous_per_lane_eos_read_flags():
     assert "device_get" in findings[0].message
 
 
+# prefix-cache admission fixtures (ISSUE 14): the cache-lookup admission
+# loop is pure host bookkeeping — page tables are host numpy, chain
+# lookups are hash-map walks, and the device sees ONE batched upload of
+# the assembled prefill inputs.  Pulling a lane's page table back from
+# the device to "check the prefix" is a per-lane transfer storm inside
+# the hottest host loop in the plane.
+
+GOOD_PREFIX_ADMISSION_HOST_TABLE_MATH = """
+    import numpy as np
+    import jax
+
+    def admit(batch, cache, allocator, table, prefill, state, upload):
+        rows = []
+        for lane_id, prompt in batch:
+            # cache lookup + page-table assembly are HOST-side numpy/dict
+            # work: no device value is ever touched per lane
+            cached = cache.lookup(prompt, len(prompt) - 1)
+            pages = cached + allocator.alloc(
+                allocator.pages_for_tokens(len(prompt)) - len(cached)
+            )
+            table[lane_id, : len(pages)] = pages
+            rows.append((lane_id, prompt, pages))
+        # ... and the device sees ONE batched upload of the assembled rows
+        state = prefill(state, upload(np.asarray(table)))
+        return state
+"""
+
+BAD_PREFIX_ADMISSION_PER_LANE_TABLE_READ = """
+    import numpy as np
+    import jax
+
+    def admit(batch, cache, device_tables, prefill, state, upload):
+        rows = []
+        for lane_id, prompt in batch:
+            # per-lane device_get of the lane's page table just to run the
+            # host-side cache lookup: one blocking round trip per admitted
+            # lane, inside the admission loop the decode overlap exists to
+            # hide
+            lane_table = jax.device_get(device_tables[lane_id])
+            cached = cache.lookup(prompt, len(prompt) - 1)
+            rows.append((lane_id, prompt, lane_table, cached))
+        state = prefill(state, upload(rows))
+        return state
+"""
+
+
+def test_jg001_prefix_admission_host_table_math_is_clean():
+    """The sanctioned cache-lookup admission shape — host-side table
+    math, one batched upload — lints clean in the genrl package."""
+    assert lint(GOOD_PREFIX_ADMISSION_HOST_TABLE_MATH, relpath=GENRL) == []
+
+
+def test_jg001_prefix_admission_per_lane_table_read_flags():
+    """Per-lane device_get of page tables inside the cache-lookup
+    admission loop is the ISSUE 14 JG001 violation."""
+    findings = lint(BAD_PREFIX_ADMISSION_PER_LANE_TABLE_READ, relpath=GENRL)
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # distributed-tracing fixtures (ISSUE 13): scalerl_tpu/runtime is a HOT
 # package and the tracer lives there — spans must be stamped from HOST

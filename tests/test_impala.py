@@ -103,8 +103,13 @@ def test_impala_lstm_agent_pixels():
     assert a.shape == (B,) and logits.shape == (B, 6)
 
 
+@pytest.mark.slow
 def test_device_loop_cartpole_learns():
-    """The fused device loop must run and improve returns on CartPole."""
+    """The fused device loop must run and improve returns on CartPole.
+
+    ~35 s of learning wall-clock: rides ``-m slow`` (ISSUE 14 tier-1
+    budget trim); the fused driver's mechanics stay covered in tier-1 by
+    the dispatch/parity suite and the smoke tests here."""
     args = _args(
         rollout_length=16, gamma=0.99, entropy_cost=0.01,
         learning_rate=1e-2, hidden_size=64,

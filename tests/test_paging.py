@@ -83,13 +83,166 @@ def test_allocator_no_aliasing_under_randomized_schedule():
                 live[step] = (a.alloc(int(rng.integers(1, want + 1))), want)
         owned = [p for pages, _ in live.values() for p in pages]
         assert len(owned) == len(set(owned)), "page aliased to two lanes"
-        assert set(owned) == set(a._live)
+        assert set(owned) == set(a._refs)
         assert not set(owned) & set(a._free)
         assert len(owned) + a.free_pages == a.capacity
     for pages, reserved in live.values():
         a.free(pages)
         a.release(reserved)
     assert a.free_pages == a.capacity and a.reserved == 0
+
+
+def test_allocator_refcount_share_and_free_to_zero():
+    """ISSUE 14: share() bumps per-page refcounts on behalf of a second
+    holder; free() decrements, and the page returns to the free list only
+    at zero — the CoW prefix rule."""
+    a = PageAllocator(num_pages=9, page_size=4)
+    assert a.try_reserve(4)
+    pages = a.alloc(2, holder="lane[0]")
+    a.share(pages, holder="lane[1]")
+    a.share(pages[:1], holder="prefix-cache")
+    assert a.refcount(pages[0]) == 3 and a.refcount(pages[1]) == 2
+    assert a.shared_pages == 2
+    assert a.stats()["shared"] == 2
+    free_before = a.free_pages
+    a.free(pages, holder="lane[0]")
+    assert a.free_pages == free_before  # still held: nothing recycled
+    a.free(pages, holder="lane[1]")
+    assert a.free_pages == free_before + 1  # pages[1] hit zero
+    assert a.refcount(pages[0]) == 1
+    assert a.holders(pages[0]) == ["prefix-cache"]
+    a.free(pages[:1], holder="prefix-cache")
+    assert a.free_pages == free_before + 2
+    a.release(4)
+
+
+def test_allocator_error_paths_name_page_and_holder():
+    """Double-free and foreign-free raise with the offending page id and
+    the holder(s) involved — the diagnosable half of the no-aliasing
+    invariant."""
+    a = PageAllocator(num_pages=6, page_size=2)
+    assert a.try_reserve(2)
+    pages = a.alloc(2, holder="lane[3]")
+    a.free(pages, holder="lane[3]")
+    with pytest.raises(RuntimeError) as e:
+        a.free(pages[:1], holder="lane[3]")  # double free
+    assert str(pages[0]) in str(e.value) and "lane[3]" in str(e.value)
+    pages = a.alloc(1, holder="lane[1]")
+    with pytest.raises(RuntimeError) as e:
+        a.free(pages, holder="lane[2]")  # foreign free
+    assert str(pages[0]) in str(e.value)
+    assert "lane[2]" in str(e.value) and "lane[1]" in str(e.value)
+    with pytest.raises(RuntimeError) as e:
+        a.share([5], holder="lane[9]")  # sharing a never-allocated page
+    assert "5" in str(e.value) and "lane[9]" in str(e.value)
+    with pytest.raises(RuntimeError):
+        a.share([0], holder="lane[0]")  # the null page is never shareable
+
+
+def test_allocator_reclaim_hook_fires_when_free_list_short():
+    calls = []
+    a = PageAllocator(num_pages=5, page_size=2)  # capacity 4
+    held = a.alloc(4, holder="x")
+
+    def reclaim(n):
+        calls.append(n)
+        a.free(held[:n], holder="x")
+        del held[:n]
+        return n
+
+    a.set_reclaim_hook(reclaim)
+    got = a.alloc(2, holder="y")
+    assert calls == [2] and len(got) == 2
+
+
+# ---------------------------------------------------------------------------
+# prefix cache (jax-free; ISSUE 14)
+
+
+def _cache(num_pages=33, ps=4):
+    from scalerl_tpu.genrl.prefix_cache import PrefixCache
+
+    a = PageAllocator(num_pages=num_pages, page_size=ps)
+    return a, PrefixCache(a, ps)
+
+
+def test_prefix_cache_lookup_longest_full_page_chain():
+    a, c = _cache()
+    prompt = np.arange(1, 14, dtype=np.int32)  # 13 tokens, ps=4
+    pages = a.alloc(3, holder="lane[0]")  # 3 full pages (12 tokens)
+    assert c.insert(prompt, 13, pages) == 3
+    assert a.refcount(pages[0]) == 2  # cache holds its own ref
+    # full prefix hit, capped at prompt_len - 1 so a tail always remains
+    assert c.lookup(prompt, 12) == pages
+    assert c.lookup(prompt, 11) == pages[:2]  # 11 tokens -> 2 full blocks
+    # a different third block diverges after two pages
+    other = prompt.copy()
+    other[9] = 99
+    assert c.lookup(other, 12) == pages[:2]
+    # nothing cached for a cold prompt, and sub-page prompts never match
+    assert c.lookup(np.asarray([7, 7, 7], np.int32), 2) == []
+    assert c.hits >= 2 and c.misses >= 1
+
+
+def test_prefix_cache_lru_evicts_only_refcount_free_leaves():
+    a, c = _cache(num_pages=9)
+    p1 = np.arange(1, 9, dtype=np.int32)  # 8 tokens = 2 pages
+    pages1 = a.alloc(2, holder="lane[0]")
+    c.insert(p1, 8, pages1)
+    p2 = np.asarray([9, 9, 9, 9, 8, 8, 8, 8], np.int32)
+    pages2 = a.alloc(2, holder="lane[1]")
+    c.insert(p2, 8, pages2)
+    # lane[1] still maps chain 2; lane[0] released chain 1's lane refs
+    a.free(pages1, holder="lane[0]")
+    assert c.cached_pages == 4
+    # evict 1: the LRU evictable LEAF is chain 1's tail (cache-only)
+    assert c.evict(1) == 1
+    assert a.refcount(pages1[1]) == 0
+    assert c.lookup(p1, 8) == pages1[:1]  # head of chain 1 still cached
+    # chain 2's pages are pinned by lane[1]: nothing more to evict after
+    # chain 1 is gone
+    assert c.evict(10) == 1  # only chain 1's head was still evictable
+    assert c.lookup(p2, 8) == pages2  # untouched
+    a.free(pages2, holder="lane[1]")
+
+
+def test_prefix_cache_flush_releases_cache_refs_only():
+    a, c = _cache()
+    prompt = np.arange(1, 9, dtype=np.int32)
+    pages = a.alloc(2, holder="lane[0]")
+    c.insert(prompt, 8, pages)
+    assert a.refcount(pages[0]) == 2
+    dropped = c.flush()
+    assert dropped == 2 and c.cached_pages == 0
+    # the live lane's refs survive the flush
+    assert a.refcount(pages[0]) == 1
+    assert c.lookup(prompt, 8) == []
+    a.free(pages, holder="lane[0]")
+    assert a.free_pages == a.capacity
+
+
+def test_paged_reference_shared_table_layouts():
+    """The parity oracle's shared-layout cases (ISSUE 14): the SAME
+    physical pages appearing in several lanes' tables (a CoW-forked
+    group) attend identically to a private-copy layout — in the XLA
+    reference AND the Pallas kernel."""
+    rng = np.random.default_rng(6)
+    kp, vp = _pools(rng)
+    B = 3
+    q = jnp.asarray(rng.normal(size=(B, 1, 2, 8)), jnp.float32)
+    # lanes 0..2 share prefix pages (1, 2); private tails 4 / 5 / 6
+    shared = jnp.asarray([[1, 2, 4], [1, 2, 5], [1, 2, 6]], jnp.int32)
+    ln = jnp.asarray([10, 11, 9], jnp.int32)
+    ref = paged_attention_reference(q, kp, vp, shared, ln)
+    # private-copy twin: prefix content duplicated into pages (7, 8) for
+    # lane 1 — same logical context, different physical layout
+    kp2 = kp.at[7].set(kp[1]).at[8].set(kp[2])
+    vp2 = vp.at[7].set(vp[1]).at[8].set(vp[2])
+    private = jnp.asarray([[1, 2, 4], [7, 8, 5], [1, 2, 6]], jnp.int32)
+    ref2 = paged_attention_reference(q, kp2, vp2, private, ln)
+    np.testing.assert_allclose(np.asarray(ref2), np.asarray(ref), atol=1e-6)
+    ker = paged_decode_attention(q, kp, vp, shared, ln, interpret=True)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref), atol=1e-5)
 
 
 # ---------------------------------------------------------------------------

@@ -133,6 +133,34 @@ def test_bench_history_values_like_for_like(tmp_path, monkeypatch):
     ) == [77.0]
 
 
+def test_bench_history_values_group_shape(tmp_path, monkeypatch):
+    """ISSUE 14: the grouped continuous workload (BENCH_GENRL_GROUP) keys
+    its own history — a group=8 decode rate never gates the ungrouped
+    run, and vice versa."""
+    from tools.tpu_watch import _bench_history_values
+
+    rows = [
+        {"metric": "genrl_decode_tokens_per_sec_per_chip",
+         "mode": "genrl-continuous", "value": 20000.0},
+        {"metric": "genrl_decode_tokens_per_sec_per_chip",
+         "mode": "genrl-continuous", "group": 8, "value": 55000.0},
+    ]
+    artifact = tmp_path / "BENCH_r09.json"
+    artifact.write_text(
+        "".join(json.dumps({"n": i, "parsed": r}) for i, r in enumerate(rows))
+    )
+    import tools.tpu_watch as tw
+
+    monkeypatch.setattr(tw, "REPO", str(tmp_path))
+    assert _bench_history_values(
+        "genrl_decode_tokens_per_sec_per_chip", "genrl-continuous"
+    ) == [20000.0]
+    assert _bench_history_values(
+        "genrl_decode_tokens_per_sec_per_chip", "genrl-continuous",
+        None, 8,
+    ) == [55000.0]
+
+
 def test_sharded_bench_artifact_schema():
     """bench --mode sharded artifacts carry the like-for-like comparison
     keys the gate needs: mode, mesh, params_total, params_per_chip."""
@@ -236,8 +264,8 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     ``bench-genrl-cont`` step."""
     import importlib.util
 
-    monkeypatch.setenv("BENCH_GENRL_TARGET_S", "0.4")
-    monkeypatch.setenv("BENCH_GENRL_LANES", "16")
+    monkeypatch.setenv("BENCH_GENRL_TARGET_S", "0.3")
+    monkeypatch.setenv("BENCH_GENRL_LANES", "8")
     monkeypatch.setenv("BENCH_GENRL_RESPONSE", "16")
     spec = importlib.util.spec_from_file_location(
         "bench_genrl_cont_mod", REPO / "bench.py"
@@ -269,6 +297,43 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     assert result["pages_capacity"] > 0
     assert result["completed_sequences"] >= 2
     assert result["iter_mode"] in ("scan", "unroll")
+    # shared-prefix reuse observables (ISSUE 14) ride every artifact; the
+    # ungrouped workload carries NO group key (its own gate history)
+    assert 0.0 <= result["prefill_tokens_saved_ratio"] <= 1.0
+    assert 0.0 <= result["prefix_hit_rate"] <= 1.0
+    assert result["steps_in_flight"] >= 1
+    assert "group" not in result
+
+
+def test_genrl_continuous_group_bench_artifact_schema(capsys, monkeypatch):
+    """The BENCH_GENRL_GROUP shape (ISSUE 14): every arrival fans into
+    n=4 lanes via submit_group, the artifact carries group=n for the
+    like-for-like gate, and the prefill-savings ratio clears the
+    full-page acceptance bar ((n-1)/n of full-page prefix tokens)."""
+    import importlib.util
+
+    monkeypatch.setenv("BENCH_GENRL_TARGET_S", "0.3")
+    monkeypatch.setenv("BENCH_GENRL_LANES", "8")
+    monkeypatch.setenv("BENCH_GENRL_RESPONSE", "8")
+    monkeypatch.setenv("BENCH_GENRL_GROUP", "4")
+    spec = importlib.util.spec_from_file_location(
+        "bench_genrl_group_mod", REPO / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_genrl_continuous_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ]
+    result = json.loads(lines[-1])
+    assert result["mode"] == "genrl-continuous"
+    assert result["group"] == 4
+    assert result["value"] > 0
+    # group fan-out alone guarantees (n-1)/n of full-page prefix tokens
+    # are shared CoW; cross-round cache hits only add to it
+    assert result["prefill_tokens_saved_ratio"] >= 0.75
+    assert result["prefix_hit_rate"] >= 0.0
 
 
 def test_disagg_bench_artifact_schema(capsys, monkeypatch):

@@ -44,9 +44,14 @@ def test_process_actor_learner_smoke(tmp_path):
     assert all(not p.is_alive() for p in trainer.procs)
 
 
+@pytest.mark.slow
 def test_process_actor_kill_and_resume(tmp_path):
     """--resume restores learner state and the frame counter (parity with
-    the thread plane's try_resume)."""
+    the thread plane's try_resume).
+
+    Two full process-plane spin-ups (~20 s): rides ``-m slow`` (ISSUE 14
+    tier-1 budget trim); resume semantics stay tier-1-covered by the
+    thread-plane and DQN kill/resume tests."""
     args_a = _args(
         tmp_path, save_model=True, save_frequency=128, logger_backend="tensorboard"
     )

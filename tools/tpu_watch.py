@@ -300,13 +300,15 @@ def perf_gate_verdict(
     return new_value >= (1.0 - threshold) * median, median
 
 
-def _bench_history_values(metric: str, mode=None, mesh=None):
+def _bench_history_values(metric: str, mode=None, mesh=None, group=None):
     """fps values from the committed bench history, LIKE-FOR-LIKE: only
     rows with the same metric AND the same ``mode`` (anakin/sharded vs
-    default) AND the same ``mesh`` shape gate each other — a dp=8 number
-    must never fail a dp=4,mp=2 run (params-per-chip and collective mix
-    differ by design; the artifact schema records both so the comparison
-    stays honest)."""
+    default) AND the same ``mesh`` shape AND the same ``group`` shape
+    (BENCH_GENRL_GROUP fan-out; absent = ungrouped) gate each other — a
+    dp=8 number must never fail a dp=4,mp=2 run, and a grouped n=8 decode
+    rate must never gate the ungrouped workload (prefix sharing changes
+    the prefill mix by design; the artifact schema records all three so
+    the comparison stays honest)."""
     sys.path.insert(0, REPO)
     try:
         from bench import load_bench_history
@@ -318,6 +320,7 @@ def _bench_history_values(metric: str, mode=None, mesh=None):
         if h.get("metric") == metric
         and h.get("mode") == mode
         and h.get("mesh") == mesh
+        and h.get("group") == group
     ]
 
 
@@ -360,7 +363,8 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
             # like-for-like: same metric, same mode (anakin/sharded/default),
             # same mesh shape — cross-shape comparisons never gate
             _bench_history_values(
-                result["metric"], result.get("mode"), result.get("mesh")
+                result["metric"], result.get("mode"), result.get("mesh"),
+                result.get("group"),
             ),
         )
         if ok or median is None:
@@ -507,6 +511,16 @@ def run_payload(n_devices: int = 1) -> None:
         ("bench-genrl-cont",
          [sys.executable, "bench.py", "--mode", "genrl", "--continuous"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # the same continuous plane at GROUP shape n=8 (ISSUE 14: GRPO
+        # group sampling through submit_group — shared-prefix CoW fork +
+        # pipelined admission).  The artifact carries group=8, so the
+        # perf gate compares like-for-like at the same group shape and
+        # never cross-gates the ungrouped bench-genrl-cont history; its
+        # prefill_tokens_saved_ratio field is the ISSUE 14 acceptance
+        # number (>= 0.8 of full-page prefix tokens at n=8)
+        ("bench-genrl-group",
+         [sys.executable, "bench.py", "--mode", "genrl", "--continuous"],
+         1500, dict(env, BENCH_SKIP_MICRO="1", BENCH_GENRL_GROUP="8")),
         # disaggregated dataflow: end-to-end sequences/s through the full
         # generation-host -> wire -> learner path plus snapshot-push
         # latency for the int8 wire format; perf-gated like-for-like
