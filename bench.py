@@ -764,6 +764,14 @@ def _run_genrl_continuous_measurement() -> None:
     if group > 1:
         # the group shape keys its own like-for-like perf-gate history
         result_obj["group"] = group
+    # packed-learner A/B fields (ISSUE 15) ride this artifact too — the
+    # continuous plane feeds the same learner, so its artifact reports
+    # the learn-side pad economics alongside the decode ones (the
+    # token_ppo_learn_tokens_per_sec_per_chip field is gated in
+    # tpu_watch).  BENCH_SKIP_LEARN_AB=1 drops the phase for callers that
+    # only exercise the decode planes (the group-shape schema test).
+    if not os.environ.get("BENCH_SKIP_LEARN_AB"):
+        result_obj.update(_packed_learn_phase(on_accel))
     print(json.dumps(result_obj))
 
 
@@ -935,6 +943,123 @@ def _run_disagg_measurement() -> None:
     print(json.dumps(result_obj))
 
 
+def _packed_learn_phase(on_accel: bool) -> dict:
+    """Packed-vs-padded token-PPO learn A/B (ISSUE 15) on a MIXED-length
+    workload (mean true length <= half the bucket — the regime the
+    bin-packer exists for).
+
+    The same agent runs both layouts: its learn fn dispatches on the
+    batch's ``segment_ids`` key, so the A/B holds params, optimizer, and
+    metric discipline constant and varies ONLY the input layout.  Both
+    rates count REAL (response, mask=1) tokens over wall clock — the
+    padded path is penalized exactly by the pad FLOPs it burns, which is
+    the honest comparison.  Returns the artifact fields; the headline
+    ``token_ppo_learn_tokens_per_sec_per_chip`` (the PACKED rate) also
+    rides its own metric line gated like-for-like in tpu_watch.
+    """
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.agents.token_ppo import TokenPPOAgent
+    from scalerl_tpu.config import GenRLArguments
+    from scalerl_tpu.genrl.rollout import pack_learner_batch
+    from scalerl_tpu.runtime.dispatch import MetricsPipeline
+    from scalerl_tpu.trainer.sequence_rl import build_genrl_model
+    from scalerl_tpu.utils.buckets import bucket_for, default_buckets
+
+    if on_accel:
+        V, d_model, n_layers, n_heads = 1024, 256, 4, 8
+        P = R = 128
+        B = 64
+        target_s = 5.0
+    else:
+        V, d_model, n_layers, n_heads = 32, 32, 1, 4
+        P = R = 32
+        B = 16
+        target_s = 0.75
+    target_s = float(os.environ.get("BENCH_LEARN_TARGET_S", target_s))
+
+    args = GenRLArguments(
+        vocab_size=V, prompt_len=P, max_new_tokens=R,
+        d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+        genrl_batch=B, genrl_sample_batch=B,
+        genrl_buffer_sequences=2 * B, learner_packing=True,
+        telemetry_interval_s=0.0, logger_backend="none",
+    )
+    agent = TokenPPOAgent(args, build_genrl_model(args))
+    S = P + R
+    rng = np.random.default_rng(0)
+    # mixed lengths, mean <= half the bucket on both axes
+    plens = rng.integers(1, P // 2 + 1, B)
+    rlens = rng.integers(1, R // 2 + 1, B)
+    prompts = [rng.integers(1, V, n).astype(np.int32) for n in plens]
+    resps = [rng.integers(1, V, n).astype(np.int32) for n in rlens]
+    logps = [
+        np.log(rng.uniform(0.05, 0.5, n)).astype(np.float32)
+        for n in rlens
+    ]
+    vals = [rng.normal(0, 0.1, n).astype(np.float32) for n in rlens]
+    rewards = rng.uniform(0, 1, B).astype(np.float32)
+    gens = np.zeros(B, np.int32)
+
+    # padded bucket-pair layout (the parity twin)
+    tokens = np.zeros((B, S), np.int32)
+    blogp = np.zeros((B, R), np.float32)
+    bval = np.zeros((B, R), np.float32)
+    mask = np.zeros((B, R), np.float32)
+    for i in range(B):
+        n, r = int(plens[i]), int(rlens[i])
+        tokens[i, P - n : P] = prompts[i]
+        tokens[i, P : P + r] = resps[i]
+        blogp[i, :r] = logps[i]
+        bval[i, :r] = vals[i]
+        mask[i, :r] = 1.0
+    padded = jax.device_put({
+        "tokens": tokens, "behavior_logp": blogp, "value": bval,
+        "mask": mask, "reward": rewards,
+        "prompt_len": plens.astype(np.int32), "generation": gens,
+    })
+    pk = pack_learner_batch(
+        prompts, resps, logps, vals, rewards, gens, pack_len=S
+    )
+    pk = pk.bucketed(bucket_for(max(pk.rows, 1), default_buckets(B)))
+    fields, _prio = pk.fields()
+    packed = jax.device_put(fields)
+    real_tokens = int(mask.sum())
+
+    def _measure(batch):
+        m = agent.learn_device(batch)
+        float(jax.device_get(m["total_loss"]))  # compile + sync
+        pipe = MetricsPipeline(depth=2)
+        t0 = time.perf_counter()
+        steps = 0
+        while time.perf_counter() - t0 < target_s or steps < 2:
+            m = agent.learn_device(batch)
+            steps += 1
+            pipe.push(steps, m)
+        pipe.drain()
+        return steps * real_tokens / (time.perf_counter() - t0)
+
+    padded_tps = _measure(padded)
+    packed_tps = _measure(packed)
+    return {
+        "token_ppo_learn_tokens_per_sec_per_chip": round(packed_tps, 1),
+        "padded_learn_tokens_per_sec": round(padded_tps, 1),
+        "learn_speedup_vs_padded": round(
+            packed_tps / max(padded_tps, 1e-9), 3
+        ),
+        # pad fraction of the PADDED layout on this workload — what the
+        # packed path stops paying for (the OBSERVABILITY.md math)
+        "learn_pad_ratio": round(
+            1.0 - (int(plens.sum()) + real_tokens) / (B * S), 4
+        ),
+        "learn_packed_pad_ratio": round(pk.pad_ratio, 4),
+        "learn_packed_rows": pk.rows,
+        "learn_pack_len": S,
+        "learn_batch_sequences": B,
+    }
+
+
 def _run_genrl_measurement() -> None:
     """``--mode genrl``: the token-level sequence-RL plane's headline
     numbers — prefill tokens/s/chip and decode tokens/s/chip through the
@@ -1060,6 +1185,11 @@ def _run_genrl_measurement() -> None:
         "device_kind": device_kind,
         "measured_s": round(pre_elapsed + gen_elapsed + learn_elapsed, 1),
     }
+    # phase 4 (ISSUE 15): packed-vs-padded learn A/B on a mixed-length
+    # workload — the token_ppo_learn_tokens_per_sec_per_chip field is
+    # perf-gated like-for-like in tpu_watch alongside the headline value
+    # (the artifact stays ONE json line, the orchestrator's contract)
+    result_obj.update(_packed_learn_phase(on_accel))
     print(json.dumps(result_obj))
 
 

@@ -33,6 +33,14 @@ prompt prefill; steps-in-flight pipelines admission under decode::
     python examples/train_sequence_rl.py --genrl-engine continuous \
         --genrl-lanes 32 --samples-per-prompt 8 \
         --genrl-steps-in-flight 2
+
+Pad-free packed learner (ISSUE 15, docs/SEQUENCE_RL.md "Packed
+learner") — completed sequences bin-pack into fixed rows with per-token
+segment ids, the learn step runs segment-blocked causal attention (the
+Pallas flash kernel on TPU), and no learn FLOP is spent on pad::
+
+    python examples/train_sequence_rl.py --learner-packing \
+        --genrl-engine continuous --genrl-lanes 32
 """
 
 import os

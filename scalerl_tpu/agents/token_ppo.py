@@ -16,6 +16,12 @@ token is one action —
   (prompt bucket + response bucket) shapes; every loss/metric term is
   masked by the real-token mask and normalized by real token count, so
   bucket padding is numerically invisible;
+- **pad-free packed rows** (ISSUE 15): with ``learner_packing`` the batch
+  instead carries ``genrl/rollout.py``'s bin-packed ``[rows, S]`` layout
+  (``segment_ids`` present) and :func:`token_ppo_packed_loss` runs
+  segment-blocked causal attention — same loss and gradients to 1e-5,
+  none of the pad FLOPs; the learn fn dispatches on the batch layout at
+  trace time, so the padded path stays the packed path's parity twin;
 - the whole update is ONE pure jitted ``(state, batch) -> (state,
   metrics)`` function riding the existing machinery: the nonfinite guard
   (``maybe_guard_nonfinite``), the dp×mp sharded learn step
@@ -159,15 +165,142 @@ def token_ppo_loss(
     return total, metrics
 
 
+def token_ppo_packed_loss(
+    params,
+    ref_params,
+    model: TransformerPolicy,
+    batch: Dict[str, jnp.ndarray],
+    clip_range: float,
+    value_cost: float,
+    entropy_cost: float,
+    kl_cost: float,
+    adv_norm: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """PPO-clip over PACKED learner rows — the pad-free twin of
+    :func:`token_ppo_loss`.
+
+    ``batch`` carries the ``genrl/rollout.py`` packed-row fields, all
+    ``[N, S]`` per-token: ``tokens`` (compact prompt+response segments),
+    ``segment_ids`` (0 = pad), ``positions`` (reset per segment),
+    ``behavior_logp``/``value``/``reward``/``generation`` aligned at each
+    response token's own offset, and ``mask`` = the loss mask (1 exactly
+    on response tokens).  Token ``t`` is predicted by the model output at
+    ``t - 1`` — always in-segment, because every segment starts with at
+    least one prompt token — so all per-token terms shift by one and the
+    math is the padded loss over the identical token multiset: the two
+    paths agree to float tolerance on loss AND gradients (the parity
+    contract the tests pin at 1e-5).  An optional ``is_weight [N]`` (PER
+    weights, per ROW — the replay unit) scales the loss mask exactly like
+    the padded path's per-sequence weight.
+    """
+    tokens = batch["tokens"]
+    seg = batch["segment_ids"]
+    positions = batch["positions"]
+    seq_w = batch.get("is_weight")
+    w_full = (
+        batch["mask"] if seq_w is None else batch["mask"] * seq_w[:, None]
+    )
+
+    out = model.apply(
+        params, tokens, positions=positions, segment_ids=seg
+    )
+    # output at row offset t-1 predicts the token at offset t
+    pred_logits = out.policy_logits[:, :-1]  # [N, S-1, V]
+    values = out.baseline[:, :-1]
+    tgt = tokens[:, 1:]
+    mask = batch["mask"][:, 1:]
+    w_mask = w_full[:, 1:]
+    behavior_logp = batch["behavior_logp"][:, 1:]
+    behavior_value = batch["value"][:, 1:]
+    reward = batch["reward"][:, 1:]
+    logp_all = jax.nn.log_softmax(pred_logits, axis=-1)
+    new_logp = jnp.take_along_axis(logp_all, tgt[..., None], axis=-1)[
+        ..., 0
+    ]
+
+    adv = reward - behavior_value
+    if adv_norm:
+        mu = masked_mean(adv, mask)
+        var = masked_mean(jnp.square(adv - mu), mask)
+        adv = (adv - mu) * jax.lax.rsqrt(var + 1e-8)
+    adv = jax.lax.stop_gradient(adv * mask)
+
+    log_ratio = new_logp - jax.lax.stop_gradient(behavior_logp)
+    ratio = jnp.exp(log_ratio)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - clip_range, 1.0 + clip_range) * adv
+    pg_loss = -masked_mean(jnp.minimum(unclipped, clipped), w_mask)
+
+    value_loss = value_cost * 0.5 * masked_mean(
+        jnp.square(values - reward), w_mask
+    )
+    ent = jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+    entropy_term = entropy_cost * masked_mean(ent, w_mask)
+
+    total = pg_loss + value_loss + entropy_term
+    # rows hold several sequences: sequence counts come from the max
+    # segment id per row, reward/generation means are token-weighted
+    # (the padded metrics are sequence-weighted — loss terms, not these
+    # diagnostics, carry the parity contract)
+    num_seqs = jnp.sum(jnp.max(seg, axis=1).astype(jnp.float32))
+    metrics = {
+        "pg_loss": pg_loss,
+        "value_loss": value_loss,
+        "entropy": -masked_mean(ent, mask),
+        "mean_ratio": masked_mean(ratio, mask),
+        "mean_approx_kl": masked_mean((ratio - 1.0) - log_ratio, mask),
+        "mean_clip_frac": masked_mean(
+            (jnp.abs(ratio - 1.0) > clip_range).astype(jnp.float32), mask
+        ),
+        "mean_reward": masked_mean(reward, mask),
+        "mean_value": masked_mean(values, mask),
+        "mean_generation": masked_mean(
+            batch["generation"][:, 1:].astype(jnp.float32), mask
+        ),
+        "mean_response_len": jnp.sum(batch["mask"])
+        / jnp.maximum(num_seqs, 1.0),
+        "real_token_frac": jnp.mean((seg > 0).astype(jnp.float32)),
+    }
+    if kl_cost > 0.0:
+        ref_out = model.apply(
+            ref_params, tokens, positions=positions, segment_ids=seg
+        )
+        ref_logp = jax.lax.stop_gradient(
+            jax.nn.log_softmax(ref_out.policy_logits[:, :-1], axis=-1)
+        )
+        kl = jnp.sum(jnp.exp(logp_all) * (logp_all - ref_logp), axis=-1)
+        kl_term = kl_cost * masked_mean(kl, w_mask)
+        total = total + kl_term
+        metrics["kl_ref"] = masked_mean(kl, mask)
+    metrics["total_loss"] = total
+    metrics = {
+        k: v if k == "total_loss" else jax.lax.stop_gradient(v)
+        for k, v in metrics.items()
+    }
+    return total, metrics
+
+
 def make_token_ppo_learn_fn(
     model: TransformerPolicy, optimizer: optax.GradientTransformation, args
 ) -> Callable:
     """Build the pure ``(state, batch) -> (state, metrics)`` update,
-    wrapped in the all-finite guard like every other learn-fn factory."""
+    wrapped in the all-finite guard like every other learn-fn factory.
+
+    Dispatches per batch LAYOUT at trace time: a batch carrying
+    ``segment_ids`` takes the packed-row loss, anything else the padded
+    bucket-pair loss — dict structure is static under jit, so one learn
+    fn serves both paths (the padded path stays the packed path's parity
+    twin) and each layout compiles exactly once.
+    """
 
     def learn(state: TokenPPOTrainState, batch: Dict[str, jnp.ndarray]):
+        loss_fn = (
+            token_ppo_packed_loss
+            if "segment_ids" in batch
+            else token_ppo_loss
+        )
         (loss, metrics), grads = jax.value_and_grad(
-            token_ppo_loss, has_aux=True
+            loss_fn, has_aux=True
         )(
             state.params,
             state.ref_params,

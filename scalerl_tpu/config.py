@@ -782,6 +782,22 @@ class GenRLArguments(RLArguments):
     # every param push; off = always prefill from scratch).
     genrl_prefix_cache: bool = True
 
+    # Pad-free packed learner (ISSUE 15): bin-pack completed sequences
+    # (compact prompt+response, no intra-sequence pad) into fixed
+    # [rows, learner_pack_len] rows with per-token segment ids and
+    # per-segment position reset; the learn step runs segment-blocked
+    # causal attention so tokens never see their row-mates.  Off (the
+    # default) keeps the padded bucket-pair layout — the packed path's
+    # parity twin (loss/grads agree to 1e-5 on the same sequences).
+    learner_packing: bool = False
+    # Packed row length; 0 derives the engine bucket pair (prompt bucket
+    # + response bucket), so one row fits the longest possible sequence.
+    learner_pack_len: int = 0
+    # Segment attention impl for the packed forward: pallas = the flash
+    # training kernel (fwd + custom_vjp bwd, cross-segment/pad blocks
+    # skipped), xla = dense packed mask, auto = pallas on TPU else xla.
+    learner_packed_attn: str = "auto"
+
     # Disaggregated dataflow (genrl/disagg.py, ISSUE 12): N generation
     # hosts behind jax-free shells stream completed sequences over the
     # fleet wire into this learner's sequence replay, with quantized
@@ -874,6 +890,25 @@ class GenRLArguments(RLArguments):
             raise ValueError(
                 f"genrl_steps_in_flight must be >= 1, got "
                 f"{self.genrl_steps_in_flight}"
+            )
+        if self.learner_packed_attn not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                "learner_packed_attn must be auto | pallas | xla, got "
+                f"{self.learner_packed_attn!r}"
+            )
+        if self.learner_pack_len < 0:
+            raise ValueError(
+                f"learner_pack_len must be >= 0, got "
+                f"{self.learner_pack_len}"
+            )
+        if self.learner_pack_len and (
+            self.learner_pack_len < self.prompt_len + self.max_new_tokens
+        ):
+            raise ValueError(
+                f"learner_pack_len ({self.learner_pack_len}) must fit one "
+                "maximum-length sequence (prompt_len + max_new_tokens = "
+                f"{self.prompt_len + self.max_new_tokens}) or every "
+                "full-length completion would be shed"
             )
         if self.disagg_hosts < 1:
             raise ValueError(

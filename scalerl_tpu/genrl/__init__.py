@@ -29,6 +29,13 @@ _EXPORTS = {
     "pack_completions": "scalerl_tpu.genrl.rollout",
     "pack_sequences": "scalerl_tpu.genrl.rollout",
     "sequence_field_shapes": "scalerl_tpu.genrl.rollout",
+    # pad-free packed learner layout (ISSUE 15)
+    "PackedLearnerBatch": "scalerl_tpu.genrl.rollout",
+    "greedy_pack": "scalerl_tpu.genrl.rollout",
+    "pack_learner_batch": "scalerl_tpu.genrl.rollout",
+    "packed_field_shapes": "scalerl_tpu.genrl.rollout",
+    "packed_rows_from_completions": "scalerl_tpu.genrl.rollout",
+    "packed_rows_from_result": "scalerl_tpu.genrl.rollout",
     "TokenRecallTask": "scalerl_tpu.genrl.task",
     # the disaggregated dataflow (jax-free shells)
     "CohortEngineShell": "scalerl_tpu.genrl.disagg",

@@ -165,6 +165,24 @@ def sequence_positions(
     return jnp.clip(jnp.arange(total_len)[None, :] - pad, 0, total_len - 1)
 
 
+def packed_attention_mask(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """``[B, S, S]`` segment-blocked causal mask over PACKED rows (the
+    pad-free learner layout, ``genrl/rollout.py``): token ``i`` attends to
+    ``j <= i`` iff both carry the same nonzero segment id.  Pad tokens
+    (id 0) attend nowhere — their rows degrade to uniform under
+    :func:`_masked_attention` (finite, outputs unused) and to exact zeros
+    under the Pallas segment kernel; the loss mask excludes them either
+    way."""
+    seg = segment_ids.astype(jnp.int32)
+    S = seg.shape[1]
+    causal = jnp.arange(S)[None, :, None] >= jnp.arange(S)[None, None, :]
+    return (
+        causal
+        & (seg[:, :, None] == seg[:, None, :])
+        & (seg[:, :, None] > 0)
+    )
+
+
 def _masked_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -201,6 +219,7 @@ class _Block(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     paged_attn_fn: Optional[Callable] = None
+    segment_attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -215,6 +234,7 @@ class _Block(nn.Module):
         page_table: Optional[jnp.ndarray] = None,
         attn_lengths: Optional[jnp.ndarray] = None,
         prefix_starts: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
         """Full forward (``layer_cache=None``) or KV-cached incremental step.
 
@@ -312,6 +332,12 @@ class _Block(nn.Module):
             )
             out = _masked_attention(q, ck, cv, attn_mask, self.dtype)
             new_cache = (ck, cv)
+        elif segment_ids is not None and self.segment_attn_fn is not None:
+            # packed-row training attention through the flash seam: the
+            # kernel enforces the segment-blocked causal rule and skips
+            # fully-masked (cross-segment / pad) blocks entirely
+            out = self.segment_attn_fn(q, k, v, segment_ids)
+            out = out.astype(self.dtype)
         elif attn_mask is not None:
             out = _masked_attention(q, k, v, attn_mask, self.dtype)
         else:
@@ -375,6 +401,12 @@ class TransformerPolicy(nn.Module):
     # .make_paged_attn_fn`` resolves Pallas-on-TPU / XLA-gather-elsewhere;
     # None defaults to the XLA reference.
     paged_attn_fn: Optional[Callable] = None
+    # Packed-learner seam (the pad-free training plane, ISSUE 15): the
+    # segment-blocked causal self-attention used when ``segment_ids`` is
+    # passed — ``ops.pallas_attention.make_segment_attn_fn`` resolves
+    # Pallas-flash-on-TPU / None-elsewhere; None builds the dense
+    # :func:`packed_attention_mask` and rides ``_masked_attention``.
+    segment_attn_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -390,6 +422,7 @@ class TransformerPolicy(nn.Module):
         page_table: Optional[jnp.ndarray] = None,
         attn_lengths: Optional[jnp.ndarray] = None,
         prefix_starts: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
     ):
         """Full forward, masked full forward, or KV-cached incremental step.
 
@@ -417,6 +450,15 @@ class TransformerPolicy(nn.Module):
           :class:`_Block`).  Returns
           ``(TransformerOutput, new_paged_cache)``.  Same params as every
           other path.
+        - ``segment_ids=[B, S]`` (the pad-free packed learner, ISSUE 15):
+          full forward over PACKED rows holding several independent
+          sequences — tokens attend causally WITHIN their own nonzero
+          segment only.  Callers pass per-segment ``positions`` (reset to
+          0 at every segment start, ``genrl/rollout.py``).  With
+          ``segment_attn_fn`` set the blocks ride the Pallas segment
+          flash kernel; otherwise the dense
+          :func:`packed_attention_mask` feeds the existing masked path.
+          Same params as every other path.
         """
         B, T = obs.shape[:2]
         if T > self.max_len:
@@ -429,6 +471,11 @@ class TransformerPolicy(nn.Module):
         if attn is None:
             base = flash_attention if self.use_flash else full_attention
             attn = lambda q, k, v: base(q, k, v, causal=True)  # noqa: E731
+        if segment_ids is not None and self.segment_attn_fn is None:
+            # dense packed fallback: ONE [B, S, S] mask shared by every
+            # block — the XLA reference path and the off-TPU shape
+            attn_mask = packed_attention_mask(segment_ids)
+            segment_ids = None
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         c = self.constrain if self.constrain is not None else (lambda x: x)
@@ -460,6 +507,7 @@ class TransformerPolicy(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 paged_attn_fn=self.paged_attn_fn,
+                segment_attn_fn=self.segment_attn_fn,
                 name=f"block_{i}",
             )
             if paged_cache is not None:
@@ -484,6 +532,8 @@ class TransformerPolicy(nn.Module):
                 )
                 new_k.append(bk)
                 new_v.append(bv)
+            elif segment_ids is not None:
+                x = block(x, segment_ids=segment_ids)
             else:
                 x = block(x, attn_mask=attn_mask)
             x = c(x)

@@ -32,14 +32,17 @@ of input dtype (bf16 inputs feed the MXU directly).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import os
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
+_SEG_BIG = 2**30  # sentinel above any real segment id (pad id is 0)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -370,3 +373,446 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ======================================================================
+# segment-packed flash attention (the pad-free packed-learner kernel)
+#
+# Self-attention over rows that PACK several independent sequences (the
+# ``genrl/rollout.py`` bin-packer's layout): ``segment_ids [B, T]`` give
+# every token its sequence id within the row (0 = pad), and a token
+# attends only causally WITHIN its own segment.  The kernel is the
+# training-grade twin of :func:`flash_attention` — same tiling, same
+# online-softmax accumulators, same FlashAttention-2 backward split —
+# plus segment-id block masking: each (q block, k block) grid step first
+# reduces the two id vectors to their live ranges (segments are
+# contiguous and ascending inside a row, pad is a zero tail, so the
+# nonzero ids in any block form one integer interval) and SKIPS the
+# matmuls entirely when the intervals cannot intersect — cross-segment
+# and pad-only blocks cost two [block] reductions, never a [bq, bk]
+# score tile.  That block skip is where the packed learner's FLOPs go
+# from O(rows * T^2) to O(sum of per-segment len^2).
+# ======================================================================
+
+
+def _seg_ranges(seg_vec):
+    """(min nonzero id, max id) of one block's id vector (pad = 0)."""
+    hi = jnp.max(seg_vec)
+    lo = jnp.min(jnp.where(seg_vec > 0, seg_vec, jnp.int32(_SEG_BIG)))
+    return lo, hi
+
+
+def _seg_block_live(i, j, q_seg, k_seg, block_q: int, block_k: int):
+    """Whether any (q, k) pair in tile (i, j) shares a live segment."""
+    q_lo, q_hi = _seg_ranges(q_seg)
+    k_lo, k_hi = _seg_ranges(k_seg)
+    return (
+        _causal_live(i, j, block_q, block_k)
+        & (q_hi > 0)
+        & (k_hi > 0)
+        & (q_lo <= k_hi)
+        & (k_lo <= q_hi)
+    )
+
+
+def _seg_mask_block(
+    i, j, q_seg, k_seg, q_len: int, block_q: int, block_k: int
+):
+    """[bq, bk] validity: in-bounds, causal, same nonzero segment."""
+    mask = _mask_block(i, j, q_len, q_len, block_q, block_k, causal=True)
+    return mask & (q_seg[:, None] == k_seg[None, :]) & (q_seg[:, None] > 0)
+
+
+def _seg_fwd_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+    acc_sc, m_sc, l_sc,
+    *, scale, q_len, block_q, block_k, nk,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    q_seg = qseg_ref[0, :]
+    k_seg = kseg_ref[0, :]
+    live = _seg_block_live(i, j, q_seg, k_seg, block_q, block_k)
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        mask = _seg_mask_block(i, j, q_seg, k_seg, q_len, block_q, block_k)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_sc[:]
+        l = l_sc[:]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), _NEG_INF, m) - safe_m)
+        l_sc[:] = l * corr + p.sum(axis=-1, keepdims=True)
+        m_sc[:] = m_new
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_sc[:]
+        m = m_sc[:]
+        # fully-masked rows (pad queries) emit exact zeros, matching the
+        # reference — their outputs are unused but must stay finite
+        o_ref[0, :, 0, :] = (
+            acc_sc[:] / jnp.maximum(l, 1e-30)
+        ).astype(o_ref.dtype)
+        lse = jnp.where(
+            l[:, 0] > 0.0,
+            m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
+            _NEG_INF,
+        )
+        lse_ref[0, 0, :] = lse
+
+
+def _pad_seg(seg: jnp.ndarray, t_pad: int) -> jnp.ndarray:
+    T = seg.shape[1]
+    if T == t_pad:
+        return seg
+    # pad tail rides segment id 0 -> masked everywhere by construction
+    return jnp.pad(seg, ((0, 0), (0, t_pad - T)))
+
+
+def _seg_fwd(q, k, v, seg, scale, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    bq, bk, T_p, _ = _blocks(T, T, block_q, block_k)
+    nq, nk = T_p // bq, T_p // bk
+    qp, kp, vp = _pad_t(q, T_p), _pad_t(k, T_p), _pad_t(v, T_p)
+    segp = _pad_seg(seg.astype(jnp.int32), T_p)
+
+    kernel = functools.partial(
+        _seg_fwd_kernel, scale=scale, q_len=T,
+        block_q=bq, block_k=bk, nk=nk,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bq), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, bk), lambda b, h, i, j: (b, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T_p, H, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T_p), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, segp, segp)
+    return o[:, :T], lse
+
+
+def _seg_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_sc,
+    *, scale, q_len, block_q, block_k, nk,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    q_seg = qseg_ref[0, :]
+    k_seg = kseg_ref[0, :]
+    live = _seg_block_live(i, j, q_seg, k_seg, block_q, block_k)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        mask = _seg_mask_block(i, j, q_seg, k_seg, q_len, block_q, block_k)
+        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, :, 0, :] = (dq_sc[:] * scale).astype(dq_ref.dtype)
+
+
+def _seg_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_sc, dv_sc,
+    *, scale, q_len, block_q, block_k, nq,
+):
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_seg = qseg_ref[0, :]
+    k_seg = kseg_ref[0, :]
+    live = _seg_block_live(i, j, q_seg, k_seg, block_q, block_k)
+
+    @pl.when(live)
+    def _accumulate():
+        k_blk = k_ref[0, :, 0, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, 0, :].astype(jnp.float32)
+        q = q_ref[0, :, 0, :].astype(jnp.float32) * scale
+        do = do_ref[0, :, 0, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        mask = _seg_mask_block(i, j, q_seg, k_seg, q_len, block_q, block_k)
+        p = jnp.where(mask, jnp.exp(s - safe_lse), 0.0)
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        # q carries one factor of `scale` already (same split as the
+        # causal kernel): the remaining factor belongs to dq only
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, :, 0, :] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, :, 0, :] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _seg_bwd(scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, seg, o, lse = residuals
+    B, T, H, D = q.shape
+    bq, bk, T_p, _ = _blocks(T, T, block_q, block_k)
+    nq, nk = T_p // bq, T_p // bk
+    qp, kp, vp = _pad_t(q, T_p), _pad_t(k, T_p), _pad_t(v, T_p)
+    segp = _pad_seg(seg.astype(jnp.int32), T_p)
+    dop, op = _pad_t(g, T_p), _pad_t(o, T_p)
+    lse_p = jnp.pad(lse, ((0, 0), (0, 0), (0, T_p - T)))
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", dop.astype(jnp.float32), op.astype(jnp.float32)
+    )
+
+    dq_kernel = functools.partial(
+        _seg_bwd_dq_kernel, scale=scale, q_len=T,
+        block_q=bq, block_k=bk, nk=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, i, j: (b, j, h, 0)),
+            pl.BlockSpec((1, bq), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, bk), lambda b, h, i, j: (b, j)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bq, 1, D), lambda b, h, i, j: (b, i, h, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, T_p, H, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, segp, segp, dop, lse_p, delta)
+
+    dkv_kernel = functools.partial(
+        _seg_bwd_dkv_kernel, scale=scale, q_len=T,
+        block_q=bq, block_k=bk, nq=nq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, j, i: (b, i, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bq), lambda b, h, j, i: (b, i)),
+            pl.BlockSpec((1, bk), lambda b, h, j, i: (b, j)),
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, j, i: (b, i, h, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, j, i: (b, h, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+            pl.BlockSpec((1, bk, 1, D), lambda b, h, j, i: (b, j, h, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T_p, H, D), k.dtype),
+            jax.ShapeDtypeStruct((B, T_p, H, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, segp, segp, dop, lse_p, delta)
+    return dq[:, :T], dk[:, :T], dv[:, :T]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def segment_flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Segment-packed causal self-attention, forward AND backward.
+
+    ``q/k/v``: ``[B, T, H, D]`` with T shared (self-attention over packed
+    rows).  ``segment_ids``: ``[B, T]`` int32, contiguous ascending ids
+    starting at 1 with a zero pad tail (the ``genrl/rollout.py`` packer's
+    contract).  Token ``i`` attends to ``j <= i`` iff
+    ``segment_ids[i] == segment_ids[j] != 0``.  Fully-masked rows (pad
+    queries) emit exact zeros.  ``interpret=None`` auto-selects Pallas
+    interpret mode off-TPU.
+    """
+    out, _ = _segment_flash_fwd(
+        q, k, v, segment_ids, scale, block_q, block_k, interpret
+    )
+    return out
+
+
+def _segment_flash_fwd(q, k, v, seg, scale, block_q, block_k, interpret):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    o, lse = _seg_fwd(q, k, v, seg, scale, block_q, block_k, interpret)
+    return o, (q, k, v, seg, o, lse)
+
+
+def _segment_flash_bwd(scale, block_q, block_k, interpret, residuals, g):
+    if scale is None:
+        scale = 1.0 / (residuals[0].shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    dq, dk, dv = _seg_bwd(scale, block_q, block_k, interpret, residuals, g)
+    # int segment ids are non-differentiable: their cotangent is float0
+    dseg = np.zeros(residuals[3].shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseg
+
+
+segment_flash_attention.defvjp(_segment_flash_fwd, _segment_flash_bwd)
+
+
+def segment_attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Dense XLA oracle for :func:`segment_flash_attention` — values AND
+    gradients, including the exact-zero output at fully-masked (pad)
+    rows.  Materializes the ``[T, T]`` scores: the parity reference and
+    the off-TPU fallback shape, never the TPU hot path."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    seg = segment_ids.astype(jnp.int32)
+    T = q.shape[1]
+    causal = jnp.arange(T)[None, :, None] >= jnp.arange(T)[None, None, :]
+    mask = (
+        causal
+        & (seg[:, :, None] == seg[:, None, :])
+        & (seg[:, :, None] > 0)
+    )  # [B, T, T]
+    scores = (
+        jnp.einsum(
+            "bthd,bshd->bhts",
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+        )
+        * scale
+    )
+    scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    # zero (not uniform) on fully-masked rows, matching the kernel
+    probs = jnp.where(
+        jnp.any(mask, axis=-1)[:, None, :, None], probs, 0.0
+    )
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def resolve_segment_attn(impl: str = "auto") -> str:
+    """``pallas`` on TPU, ``xla`` elsewhere; ``SCALERL_SEGMENT_ATTN``
+    overrides what ``auto`` resolves to (the ``SCALERL_PAGED_ATTN`` /
+    ``SCALERL_ITER_MODE`` escape-hatch pattern)."""
+    impls = ("pallas", "xla")
+    if impl == "auto":
+        impl = os.environ.get("SCALERL_SEGMENT_ATTN", "") or (
+            "pallas" if jax.default_backend() == "tpu" else "xla"
+        )
+    if impl not in impls:
+        raise ValueError(
+            f"segment attention impl must be auto | pallas | xla, got "
+            f"{impl!r}"
+        )
+    return impl
+
+
+def make_segment_attn_fn(impl: str = "auto") -> Optional[Callable]:
+    """The ``TransformerPolicy.segment_attn_fn`` seam: resolve once,
+    close over the choice.  Returns ``None`` for ``xla`` — the model then
+    builds the dense packed mask and rides its existing
+    ``_masked_attention`` path, which XLA fuses better than an
+    interpret-mode kernel off-TPU."""
+    if resolve_segment_attn(impl) == "pallas":
+        return segment_flash_attention
+    return None

@@ -39,6 +39,9 @@ from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
 from scalerl_tpu.genrl.rollout import (
     pack_completions,
     pack_sequences,
+    packed_field_shapes,
+    packed_rows_from_completions,
+    packed_rows_from_result,
     sequence_field_shapes,
 )
 from scalerl_tpu.genrl.task import TokenRecallTask
@@ -54,11 +57,19 @@ logger = get_logger(__name__)
 
 def build_genrl_model(args: GenRLArguments) -> TransformerPolicy:
     """Token-mode transformer sized off the shared policy fields, with
-    ``max_len`` covering the largest (prompt, response) bucket pair."""
+    ``max_len`` covering the largest (prompt, response) bucket pair (and
+    the packed row length when the pad-free learner is on)."""
     max_p = bucket_for(args.prompt_len, default_buckets(args.prompt_len))
     max_r = bucket_for(
         args.max_new_tokens, default_buckets(args.max_new_tokens)
     )
+    max_len = max_p + max_r
+    seg_fn = None
+    if getattr(args, "learner_packing", False):
+        from scalerl_tpu.ops.pallas_attention import make_segment_attn_fn
+
+        seg_fn = make_segment_attn_fn(args.learner_packed_attn)
+        max_len = max(max_len, args.learner_pack_len or 0)
     bf16 = bool(getattr(args, "bf16_params", False))
     import jax.numpy as jnp
 
@@ -68,10 +79,22 @@ def build_genrl_model(args: GenRLArguments) -> TransformerPolicy:
         d_model=args.d_model,
         num_heads=args.n_heads,
         num_layers=args.n_layers,
-        max_len=max_p + max_r,
+        max_len=max_len,
         dtype=jnp.bfloat16 if bf16 else jnp.float32,
         param_dtype=jnp.bfloat16 if bf16 else jnp.float32,
+        segment_attn_fn=seg_fn,
     )
+
+
+def _bucketed_rows(pk, row_buckets, pad_gauge):
+    """Bucket a :class:`PackedLearnerBatch`'s row count up the pow2
+    ladder (shape-stable ``seq_add``), publish the batch pad ratio, and
+    return ``(fields, priorities, decode_tokens)`` — the insert triple
+    both trainers feed the replay."""
+    pk = pk.bucketed(bucket_for(max(pk.rows, 1), row_buckets))
+    pad_gauge.set(pk.pad_ratio)
+    fields, priorities = pk.fields()
+    return fields, priorities, pk.decode_tokens
 
 
 class SequenceRLTrainer:
@@ -152,8 +175,20 @@ class SequenceRLTrainer:
             args.max_new_tokens,
             self.engine.config.resolved_response_buckets(),
         )
+        # pad-free packed learner (ISSUE 15): the replay unit becomes a
+        # packed ROW of several compact sequences; insert row counts pad
+        # up a pow2 ladder so seq_add compiles once per bucket
+        self.packing = bool(args.learner_packing)
+        self._pack_len = args.learner_pack_len or (
+            self._prompt_pad + self._response_pad
+        )
+        self._row_buckets = default_buckets(args.genrl_batch)
         self.replay = seq_init(
-            sequence_field_shapes(self._prompt_pad, self._response_pad),
+            packed_field_shapes(self._pack_len)
+            if self.packing
+            else sequence_field_shapes(
+                self._prompt_pad, self._response_pad
+            ),
             (),  # no recurrent core: attention over the cache is the memory
             args.genrl_buffer_sequences,
         )
@@ -166,6 +201,7 @@ class SequenceRLTrainer:
         self._reward_gauge = reg.gauge("genrl.mean_reward")
         self._stale_gauge = reg.gauge("genrl.staleness")
         self._kl_gauge = reg.gauge("genrl.kl_ref")
+        self._pad_gauge = reg.gauge("genrl.pad_ratio")
         self.reward_history: List[float] = []
 
     def _dispatch_guard(self):
@@ -208,6 +244,17 @@ class SequenceRLTrainer:
                 f"({result.prompt_pad}x{result.response_pad} vs "
                 f"{self._prompt_pad}x{self._response_pad})"
             )
+        if self.packing:
+            pk = packed_rows_from_result(result, rewards, self._pack_len)
+            fields, priorities, decode = _bucketed_rows(
+                pk, self._row_buckets, self._pad_gauge
+            )
+            return fields, priorities, rewards, decode
+        self._pad_gauge.set(
+            1.0
+            - (result.prompt_tokens + result.decode_tokens)
+            / max(result.sequences.size, 1)
+        )
         fields, priorities = pack_sequences(result, rewards)
         return fields, priorities, rewards, result.decode_tokens
 
@@ -245,6 +292,19 @@ class SequenceRLTrainer:
             packed.prompt_len,
             packed.response_tokens,
             packed.response_len,
+        )
+        if self.packing:
+            pk = packed_rows_from_completions(
+                packed, rewards, self._pack_len
+            )
+            fields, priorities, decode = _bucketed_rows(
+                pk, self._row_buckets, self._pad_gauge
+            )
+            return fields, priorities, rewards, decode
+        self._pad_gauge.set(
+            1.0
+            - (packed.prompt_len.sum() + packed.mask.sum())
+            / max(packed.sequences.size, 1)
         )
         fields, priorities = packed.fields(rewards)
         return fields, priorities, rewards, packed.decode_tokens
@@ -445,8 +505,19 @@ class DisaggSequenceRLTrainer:
         self._response_pad = bucket_for(
             args.max_new_tokens, default_buckets(args.max_new_tokens)
         )
+        # disaggregation changes WHERE sequences are born, not how they
+        # are learned from: the packed learner rides identically here
+        self.packing = bool(args.learner_packing)
+        self._pack_len = args.learner_pack_len or (
+            self._prompt_pad + self._response_pad
+        )
+        self._row_buckets = default_buckets(args.genrl_batch)
         self.replay = seq_init(
-            sequence_field_shapes(self._prompt_pad, self._response_pad),
+            packed_field_shapes(self._pack_len)
+            if self.packing
+            else sequence_field_shapes(
+                self._prompt_pad, self._response_pad
+            ),
             (),
             args.genrl_buffer_sequences,
         )
@@ -485,6 +556,7 @@ class DisaggSequenceRLTrainer:
         reg = telemetry.get_registry()
         self._learn_meter = reg.meter("genrl.learn_steps_per_s")
         self._reward_gauge = reg.gauge("genrl.mean_reward")
+        self._pad_gauge = reg.gauge("genrl.pad_ratio")
         self.reward_history: List[float] = []
 
     def _dispatch_guard(self):
@@ -548,7 +620,20 @@ class DisaggSequenceRLTrainer:
             packed.response_tokens,
             packed.response_len,
         )
-        fields, priorities = packed.fields(rewards)
+        if self.packing:
+            pk = packed_rows_from_completions(
+                packed, rewards, self._pack_len
+            )
+            fields, priorities, _decode = _bucketed_rows(
+                pk, self._row_buckets, self._pad_gauge
+            )
+        else:
+            self._pad_gauge.set(
+                1.0
+                - (packed.prompt_len.sum() + packed.mask.sum())
+                / max(packed.sequences.size, 1)
+            )
+            fields, priorities = packed.fields(rewards)
         t_add0 = time.monotonic()
         with self._dispatch_guard():
             self.replay = seq_add(self.replay, fields, (), priorities)

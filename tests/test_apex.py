@@ -213,7 +213,17 @@ def test_apex_sharded_replay_mesh_e2e(tmp_path):
         trainer.close()
 
 
-@pytest.mark.parametrize("mesh_spec", [None, "dp=4,fsdp=2"])
+@pytest.mark.parametrize(
+    "mesh_spec",
+    [
+        None,
+        # the sharded variant costs ~6.5 s of pjit compiles; sharded
+        # save->restore->resume layout preservation stays tier-1-covered
+        # by test_sharded_checkpoint_save_restore_resume (ISSUE 15
+        # tier-1 budget buy-back)
+        pytest.param("dp=4,fsdp=2", marks=pytest.mark.slow),
+    ],
+)
 def test_apex_resume_roundtrip(tmp_path, mesh_spec):
     """Kill-and-resume for Ape-X: learner state, the FULL prioritized
     replay (storage + priorities + cursors), and counters survive a

@@ -319,6 +319,21 @@ def test_pack_completions_zero_round_packs_empty():
     assert set(fields) == set(sequence_field_shapes(4, 4))
     assert all(v.shape[0] == 0 for v in fields.values())
     assert prios.shape == (0,)
+    # the packed-learner layout (ISSUE 15) handles the same edge: zero
+    # completions pack to zero ROWS with intact trailing geometry
+    from scalerl_tpu.genrl.rollout import (
+        packed_field_shapes,
+        packed_rows_from_completions,
+    )
+
+    pk = packed_rows_from_completions(
+        packed, np.zeros(0, np.float32), pack_len=8
+    )
+    assert pk.rows == 0 and pk.tokens.shape == (0, 8)
+    pfields, pprios = pk.fields()
+    assert set(pfields) == set(packed_field_shapes(8))
+    assert all(v.shape[0] == 0 for v in pfields.values())
+    assert pprios.shape == (0,)
 
 
 def _completion(prompt_len, resp_len, generation, token=3):
@@ -360,6 +375,15 @@ def test_pack_completions_oversize_sheds_with_counter():
     np.testing.assert_array_equal(packed.generations, [1])
     after = telemetry.get_registry().counter("genrl.oversize_shed").value
     assert after - before == 2
+    # the survivor re-packs into the learner-row layout cleanly too: the
+    # shed already happened upstream, so no pack_oversize_shed fires
+    from scalerl_tpu.genrl.rollout import packed_rows_from_completions
+
+    pk = packed_rows_from_completions(
+        packed, np.zeros(1, np.float32), pack_len=8
+    )
+    assert pk.rows == 1 and pk.sequences_shed == 0
+    assert pk.decode_tokens == 2
     # an all-oversize batch degrades to the empty pack, still no crash
     packed = pack_completions([_completion(6, 9, 1)], 4, 4)
     assert packed.sequences.shape[0] == 0

@@ -452,3 +452,10 @@ def test_genrl_args_validation():
         _genrl_args(genrl_buffer_sequences=4, genrl_batch=16).validate()
     with pytest.raises(ValueError):
         _genrl_args(genrl_iter_mode="vectorize").validate()
+    # packed-learner knobs (ISSUE 15)
+    with pytest.raises(ValueError):
+        _genrl_args(learner_packed_attn="dense").validate()
+    with pytest.raises(ValueError):
+        # a row must fit one maximum-length sequence
+        _genrl_args(learner_packing=True, learner_pack_len=4).validate()
+    _genrl_args(learner_packing=True).validate()

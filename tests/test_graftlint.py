@@ -959,3 +959,78 @@ def test_jg001_tracer_per_iteration_device_timestamp_flags():
     )
     assert rules_of(findings) == ["JG001"]
     assert "device_get" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# packed-learner fixtures (ISSUE 15): the bin-packing loop that lays
+# completed sequences into learner rows is pure host numpy — lengths and
+# tokens are already host-side when sequences complete, and the device
+# sees ONE batched seq_add upload of the assembled rows.  Pulling each
+# sequence's length back from a device value inside the packing loop is a
+# per-sequence transfer storm on the learner's ingest path.
+
+GOOD_PACKING_HOST_NUMPY_ROWS = """
+    import numpy as np
+    import jax
+
+    def pack_round(completions, pack_len, seq_add, replay, upload):
+        lengths = [len(c.prompt) + len(c.response) for c in completions]
+        order = sorted(range(len(lengths)), key=lambda i: -lengths[i])
+        rows, free = [], []
+        for i in order:
+            # first-fit-decreasing over python ints: the whole packing
+            # loop is host arithmetic, no device value anywhere
+            for r, cap in enumerate(free):
+                if lengths[i] <= cap:
+                    rows[r].append(i)
+                    free[r] = cap - lengths[i]
+                    break
+            else:
+                rows.append([i])
+                free.append(pack_len - lengths[i])
+        tokens = np.zeros((len(rows), pack_len), np.int32)
+        for r, members in enumerate(rows):
+            off = 0
+            for i in members:
+                seq = completions[i].tokens
+                tokens[r, off : off + len(seq)] = seq
+                off += len(seq)
+        # ... and ONE batched upload when the rows enter the replay
+        return seq_add(replay, upload(tokens))
+"""
+
+BAD_PACKING_PER_SEQUENCE_LENGTH_READ = """
+    import numpy as np
+    import jax
+
+    def pack_round(completions, dev_lengths, pack_len, seq_add, replay, upload):
+        rows, free = [], []
+        for i, c in enumerate(completions):
+            # per-sequence device_get of the length just to run host-side
+            # bin packing: one blocking round trip per completed sequence,
+            # every learn round
+            n = int(jax.device_get(dev_lengths[i]))
+            for r, cap in enumerate(free):
+                if n <= cap:
+                    rows[r].append(i)
+                    free[r] = cap - n
+                    break
+            else:
+                rows.append([i])
+                free.append(pack_len - n)
+        return seq_add(replay, upload(rows))
+"""
+
+
+def test_jg001_packing_host_numpy_rows_is_clean():
+    """The sanctioned packing shape — host numpy bin packing, one batched
+    seq_add upload — lints clean in the genrl package."""
+    assert lint(GOOD_PACKING_HOST_NUMPY_ROWS, relpath=GENRL) == []
+
+
+def test_jg001_packing_per_sequence_length_read_flags():
+    """Per-sequence device_get of lengths inside the packing loop is the
+    ISSUE 15 JG001 violation."""
+    findings = lint(BAD_PACKING_PER_SEQUENCE_LENGTH_READ, relpath=GENRL)
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message

@@ -1,0 +1,488 @@
+"""Pad-free packed-sequence learner (ISSUE 15).
+
+Covers the whole packed path: the jax-free greedy bin-packer and its
+row layout, segment isolation inside the packed forward (a sequence's
+logits cannot depend on its row-mates), packed-vs-padded token-PPO
+loss/gradient parity at 1e-5 across ragged length mixes, the learn-fn
+layout dispatch with the one-batched-transfer discipline intact, and
+both trainers riding ``learner_packing``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from scalerl_tpu.agents.token_ppo import (
+    TokenPPOAgent,
+    token_ppo_loss,
+    token_ppo_packed_loss,
+)
+from scalerl_tpu.config import GenRLArguments
+from scalerl_tpu.genrl.rollout import (
+    PackedLearnerBatch,
+    greedy_pack,
+    pack_learner_batch,
+    packed_field_shapes,
+    packed_rows_from_result,
+)
+from scalerl_tpu.models.transformer import (
+    TransformerPolicy,
+    packed_attention_mask,
+)
+from scalerl_tpu.runtime import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the greedy bin-packer (pure host arithmetic)
+
+
+def test_greedy_pack_first_fit_decreasing():
+    rows, shed = greedy_pack([3, 5, 2, 4, 1], pack_len=8)
+    assert shed == []
+    # FFD: 5 opens row 0, 4 opens row 1, 3 joins 5, 2+1 join 4
+    assert rows == [[1, 0], [3, 2, 4]]
+    total = sum(len(r) for r in rows)
+    assert total == 5
+    for r in rows:
+        assert sum([3, 5, 2, 4, 1][i] for i in r) <= 8
+
+
+def test_greedy_pack_is_deterministic_and_sheds_oversize():
+    lengths = [9, 3, 3, 9, 2]
+    rows1, shed1 = greedy_pack(lengths, pack_len=8)
+    rows2, shed2 = greedy_pack(lengths, pack_len=8)
+    assert rows1 == rows2 and shed1 == shed2
+    assert shed1 == [0, 3]  # longer than the row, dropped not crashed
+    assert sorted(i for r in rows1 for i in r) == [1, 2, 4]
+
+
+def test_greedy_pack_zero_input():
+    rows, shed = greedy_pack([], pack_len=8)
+    assert rows == [] and shed == []
+
+
+def test_pack_learner_batch_row_layout():
+    """Hand example: two sequences in one row — compact tokens, 1-based
+    ascending segment ids, per-segment position reset, response-aligned
+    loss fields."""
+    prompts = [np.array([7, 8], np.int32), np.array([5], np.int32)]
+    resps = [np.array([1, 2], np.int32), np.array([3], np.int32)]
+    logps = [np.array([-0.5, -0.7], np.float32), np.array([-0.2], np.float32)]
+    vals = [np.array([0.1, 0.2], np.float32), np.array([0.3], np.float32)]
+    pk = pack_learner_batch(
+        prompts, resps, logps, vals,
+        rewards=np.array([1.0, 0.5], np.float32),
+        generations=np.array([4, 6], np.int32), pack_len=8,
+    )
+    assert pk.rows == 1 and pk.sequences_packed == 2
+    # FFD places the len-4 sequence first, then the len-2 one
+    np.testing.assert_array_equal(
+        pk.tokens[0], [7, 8, 1, 2, 5, 3, 0, 0]
+    )
+    np.testing.assert_array_equal(
+        pk.segment_ids[0], [1, 1, 1, 1, 2, 2, 0, 0]
+    )
+    np.testing.assert_array_equal(
+        pk.positions[0], [0, 1, 2, 3, 0, 1, 0, 0]
+    )
+    np.testing.assert_array_equal(
+        pk.mask[0], [0, 0, 1, 1, 0, 1, 0, 0]
+    )
+    np.testing.assert_allclose(
+        pk.behavior_logp[0], [0, 0, -0.5, -0.7, 0, -0.2, 0, 0]
+    )
+    np.testing.assert_allclose(
+        pk.reward[0], [0, 0, 1.0, 1.0, 0, 0.5, 0, 0]
+    )
+    np.testing.assert_array_equal(
+        pk.generation[0], [4, 4, 4, 4, 6, 6, 0, 0]
+    )
+    assert pk.decode_tokens == 3
+    assert pk.real_tokens == 6
+    assert pk.pad_ratio == pytest.approx(2 / 8)
+    fields, prios = pk.fields()
+    assert set(fields) == set(packed_field_shapes(8))
+    np.testing.assert_array_equal(prios, [1.0])
+
+
+def test_pack_learner_batch_zero_and_bucketed():
+    """A zero-completion round packs to 0 rows with intact trailing
+    geometry; bucketing pads all-pad rows at priority 0 (the replay's
+    empty-slot sentinel)."""
+    pk = pack_learner_batch(
+        [], [], [], [], np.zeros(0, np.float32),
+        np.zeros(0, np.int32), pack_len=8,
+    )
+    assert pk.rows == 0 and pk.tokens.shape == (0, 8)
+    assert pk.pad_ratio == 0.0 and pk.decode_tokens == 0
+    pk2 = pack_learner_batch(
+        [np.array([1], np.int32)], [np.array([2], np.int32)],
+        [np.array([-0.1], np.float32)], [np.array([0.0], np.float32)],
+        np.array([1.0], np.float32), np.array([0], np.int32), pack_len=8,
+    )
+    b = pk2.bucketed(4)
+    assert b.rows == 4
+    np.testing.assert_array_equal(b.segment_ids[1:], 0)
+    np.testing.assert_array_equal(b.priorities, [1.0, 0.0, 0.0, 0.0])
+    with pytest.raises(ValueError):
+        pk2.bucketed(0)
+
+
+def test_pack_learner_batch_oversize_shed_counter():
+    reg = telemetry.get_registry()
+    before = reg.counter("genrl.pack_oversize_shed").value
+    pk = pack_learner_batch(
+        [np.arange(6, dtype=np.int32), np.array([1], np.int32)],
+        [np.arange(6, dtype=np.int32), np.array([2], np.int32)],
+        [np.zeros(6, np.float32), np.zeros(1, np.float32)],
+        [np.zeros(6, np.float32), np.zeros(1, np.float32)],
+        np.array([1.0, 0.5], np.float32), np.zeros(2, np.int32),
+        pack_len=8,
+    )
+    assert pk.sequences_shed == 1 and pk.sequences_packed == 1
+    assert reg.counter("genrl.pack_oversize_shed").value == before + 1
+    # the surviving sequence kept ITS reward, not the shed one's
+    assert pk.reward[pk.mask > 0].max() == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# packed forward: segment isolation
+
+
+def _model(V=12, S=24, seg_fn=None):
+    return TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=16, num_heads=2,
+        num_layers=2, max_len=S, segment_attn_fn=seg_fn,
+    )
+
+
+def test_packed_attention_mask_rule():
+    seg = jnp.asarray([[1, 1, 2, 2, 0]])
+    m = np.asarray(packed_attention_mask(seg))[0]
+    assert m[1, 0] and m[0, 0]  # causal within segment
+    assert not m[0, 1]  # never acausal
+    assert not m[2, 1] and not m[3, 0]  # never cross-segment
+    assert not m[4].any() and not m[:, 4].any()  # pad attends/attracts nothing
+
+
+def test_segment_isolation_bit_comparable():
+    """Logits for a sequence packed WITH row-mates are bit-identical to
+    the same sequence packed alone (dense path): attention masking plus
+    per-segment position reset make row placement invisible."""
+    V, S = 12, 24
+    m = _model(V, S)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    rng = np.random.default_rng(1)
+    a = rng.integers(1, V, 7).astype(np.int32)  # the probe sequence
+    b = rng.integers(1, V, 9).astype(np.int32)  # a row-mate
+
+    def row(tokens_list):
+        tok = np.zeros((1, S), np.int32)
+        seg = np.zeros((1, S), np.int32)
+        pos = np.zeros((1, S), np.int32)
+        off = 0
+        for s_idx, t in enumerate(tokens_list, start=1):
+            tok[0, off : off + len(t)] = t
+            seg[0, off : off + len(t)] = s_idx
+            pos[0, off : off + len(t)] = np.arange(len(t))
+            off += len(t)
+        return jnp.asarray(tok), jnp.asarray(seg), jnp.asarray(pos)
+
+    tok1, seg1, pos1 = row([a, b])
+    tok2, seg2, pos2 = row([b, a])  # a at a DIFFERENT row offset
+    out1 = m.apply(params, tok1, positions=pos1, segment_ids=seg1)
+    out2 = m.apply(params, tok2, positions=pos2, segment_ids=seg2)
+    tok3, seg3, pos3 = row([a])  # a alone
+    out3 = m.apply(params, tok3, positions=pos3, segment_ids=seg3)
+    la1 = np.asarray(out1.policy_logits[0, : len(a)])
+    la2 = np.asarray(out2.policy_logits[0, len(b) : len(b) + len(a)])
+    la3 = np.asarray(out3.policy_logits[0, : len(a)])
+    np.testing.assert_array_equal(la1, la3)
+    np.testing.assert_array_equal(la2, la3)
+
+
+def test_packed_forward_flash_matches_dense():
+    """The Pallas segment kernel and the dense packed mask produce the
+    same model logits at real positions — the training-grade parity that
+    lets ``learner_packed_attn`` swap impls without retraining."""
+    from scalerl_tpu.ops.pallas_attention import segment_flash_attention
+
+    V, S = 12, 24
+    dense = _model(V, S)
+    flash = _model(V, S, seg_fn=segment_flash_attention)
+    params = dense.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    rng = np.random.default_rng(2)
+    tok = jnp.asarray(rng.integers(0, V, (2, S)), jnp.int32)
+    seg = np.zeros((2, S), np.int32)
+    seg[0, :6], seg[0, 6:15], seg[0, 15:20] = 1, 2, 3
+    seg[1, :18] = 1
+    pos = np.zeros((2, S), np.int32)
+    pos[0, :6], pos[0, 6:15], pos[0, 15:20] = (
+        np.arange(6), np.arange(9), np.arange(5),
+    )
+    pos[1, :18] = np.arange(18)
+    seg, pos = jnp.asarray(seg), jnp.asarray(pos)
+    out_d = dense.apply(params, tok, positions=pos, segment_ids=seg)
+    out_f = flash.apply(params, tok, positions=pos, segment_ids=seg)
+    real = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out_f.policy_logits)[real],
+        np.asarray(out_d.policy_logits)[real],
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# packed-vs-padded loss/grad parity
+
+
+def _ragged_batches(seed, V=12, P=8, R=8, B=6, kl=False):
+    """The SAME ragged sequences in both layouts."""
+    del kl
+    rng = np.random.default_rng(seed)
+    S = P + R
+    plens = rng.integers(1, P + 1, B)
+    rlens = rng.integers(1, R + 1, B)
+    # mixed-length regime: at least one short and one full-length
+    plens[0], rlens[0] = 1, 1
+    plens[1], rlens[1] = P, R
+    prompts = [rng.integers(1, V, n).astype(np.int32) for n in plens]
+    resps = [rng.integers(1, V, n).astype(np.int32) for n in rlens]
+    logps = [
+        np.log(rng.uniform(0.05, 0.5, n)).astype(np.float32) for n in rlens
+    ]
+    vals = [rng.normal(0, 0.1, n).astype(np.float32) for n in rlens]
+    rewards = rng.uniform(0, 1, B).astype(np.float32)
+    gens = rng.integers(0, 3, B).astype(np.int32)
+    tokens = np.zeros((B, S), np.int32)
+    blogp = np.zeros((B, R), np.float32)
+    bval = np.zeros((B, R), np.float32)
+    mask = np.zeros((B, R), np.float32)
+    for i in range(B):
+        n, r = int(plens[i]), int(rlens[i])
+        tokens[i, P - n : P] = prompts[i]
+        tokens[i, P : P + r] = resps[i]
+        blogp[i, :r] = logps[i]
+        bval[i, :r] = vals[i]
+        mask[i, :r] = 1.0
+    padded = {
+        "tokens": jnp.asarray(tokens),
+        "behavior_logp": jnp.asarray(blogp),
+        "value": jnp.asarray(bval),
+        "mask": jnp.asarray(mask),
+        "reward": jnp.asarray(rewards),
+        "prompt_len": jnp.asarray(plens.astype(np.int32)),
+        "generation": jnp.asarray(gens),
+    }
+    pk = pack_learner_batch(
+        prompts, resps, logps, vals, rewards, gens, pack_len=S
+    )
+    fields, _ = pk.fields()
+    packed = {k: jnp.asarray(v) for k, v in fields.items()}
+    return padded, packed, pk
+
+
+def test_packed_vs_padded_loss_and_grad_parity():
+    """Token-PPO loss AND parameter gradients agree to 1e-5 across ragged
+    length mixes — the packed path learns exactly what the padded path
+    learns, minus the pad FLOPs (the ISSUE 15 acceptance bar).  Gradients
+    are checked with the KL anchor compiled IN, so BOTH forwards (policy
+    and reference) are exercised through the packed attention path."""
+    V, P, R = 12, 8, 8
+    m = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=16, num_heads=2,
+        num_layers=1, max_len=P + R,
+    )
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    padded, packed, pk = _ragged_batches(11, V=V, P=P, R=R)
+    assert pk.rows < padded["tokens"].shape[0]  # packing actually packed
+    kw = dict(
+        clip_range=0.2, value_cost=0.5, entropy_cost=0.01,
+        kl_cost=0.1, adv_norm=True,
+    )
+    l1, m1 = token_ppo_loss(params, params, m, padded, **kw)
+    l2, m2 = token_ppo_packed_loss(params, params, m, packed, **kw)
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
+    g1 = jax.grad(lambda p: token_ppo_loss(p, params, m, padded, **kw)[0])(
+        params
+    )
+    g2 = jax.grad(
+        lambda p: token_ppo_packed_loss(p, params, m, packed, **kw)[0]
+    )(params)
+    f1, _ = ravel_pytree(g1)
+    f2, _ = ravel_pytree(g2)
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f2), atol=1e-5, rtol=1e-4
+    )
+    # loss-term metrics carry the same parity; diagnostics may be
+    # token-weighted, but the KL anchor is a loss term
+    for key in ("pg_loss", "value_loss", "total_loss"):
+        np.testing.assert_allclose(
+            float(m1[key]), float(m2[key]), atol=1e-5
+        )
+    np.testing.assert_allclose(
+        float(m1["kl_ref"]), float(m2["kl_ref"]), atol=1e-6
+    )
+    # (the kl=0 branch is the same code minus the reference forward; it
+    # is exercised by the poison/agent/trainer tests at kl_cost=0)
+
+
+def test_packed_loss_ignores_pad_poison():
+    """Corrupting every per-token field under a zero loss mask (pad and
+    prompt positions) leaves the packed loss unchanged — pad is
+    numerically invisible, the padded-path contract carried over."""
+    V, P, R = 12, 6, 6
+    m = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=16, num_heads=2,
+        num_layers=1, max_len=P + R,
+    )
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    _, packed, _ = _ragged_batches(7, V=V, P=P, R=R)
+    kw = dict(
+        clip_range=0.2, value_cost=0.5, entropy_cost=0.01,
+        kl_cost=0.0, adv_norm=True,
+    )
+    l1, _ = token_ppo_packed_loss(params, params, m, packed, **kw)
+    pad = 1.0 - packed["mask"]
+    poisoned = dict(packed)
+    poisoned["behavior_logp"] = packed["behavior_logp"] - 9.0 * pad
+    poisoned["value"] = packed["value"] + 50.0 * pad
+    poisoned["reward"] = packed["reward"] + 3.0 * pad
+    l2, _ = token_ppo_packed_loss(params, params, m, poisoned, **kw)
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# agent + trainer wiring
+
+
+def _args(**kw):
+    base = dict(
+        vocab_size=16, prompt_len=4, max_new_tokens=4, d_model=16,
+        n_layers=1, n_heads=2, genrl_batch=8, genrl_sample_batch=8,
+        genrl_buffer_sequences=16, learner_packing=True,
+        telemetry_interval_s=0.0, logger_backend="none",
+    )
+    base.update(kw)
+    return GenRLArguments(**base)
+
+
+def test_agent_learn_dispatches_on_layout_one_batched_transfer(monkeypatch):
+    """One agent serves BOTH layouts (trace-time dispatch on the
+    ``segment_ids`` key) and the packed learn step still reads metrics
+    with ONE batched device_get."""
+    import scalerl_tpu.runtime.dispatch as dispatch_mod
+
+    from scalerl_tpu.trainer.sequence_rl import build_genrl_model
+
+    args = _args()
+    agent = TokenPPOAgent(args, build_genrl_model(args))
+    padded, packed, _ = _ragged_batches(
+        5, V=args.vocab_size, P=4, R=4, B=4
+    )
+    gets = []
+    real = dispatch_mod._device_get
+    monkeypatch.setattr(
+        dispatch_mod, "_device_get",
+        lambda x: (gets.append(1), real(x))[1],
+    )
+    m_pack = agent.learn(packed)
+    assert len(gets) == 1
+    assert np.isfinite(m_pack["total_loss"])
+    assert "real_token_frac" in m_pack
+    m_pad = agent.learn(padded)
+    assert len(gets) == 2
+    assert np.isfinite(m_pad["total_loss"])
+
+
+def test_trainer_packed_e2e_improves_reward_and_pad_gauge():
+    """SequenceRLTrainer with learner_packing LEARNS: recall reward
+    climbs well off random over a short run (the padded e2e's packed
+    twin — parity pins the math, this pins the WIRING, so it runs 40
+    rounds not 60), with packed replay fields, staleness plumbed, and
+    the pad-ratio gauge published."""
+    from scalerl_tpu.trainer.sequence_rl import SequenceRLTrainer
+
+    t = SequenceRLTrainer(
+        _args(seed=3, vocab_size=8, d_model=32, n_layers=2,
+              genrl_batch=64, genrl_sample_batch=64,
+              genrl_buffer_sequences=128, learning_rate=3e-3)
+    )
+    assert "segment_ids" in t.replay.storage
+    m = t.train_round()
+    assert np.isfinite(m["total_loss"]) and m["staleness"] >= 0
+    gauge = telemetry.get_registry().gauge("genrl.pad_ratio")
+    assert 0.0 <= gauge.value < 1.0
+    t.train(39)
+    h = t.reward_history
+    first, last = float(np.mean(h[:10])), float(np.mean(h[-10:]))
+    assert last >= 0.4, (first, last)  # random recall scores ~1/8
+    assert last > first + 0.2, (first, last)
+
+
+def test_disagg_trainer_packed_round():
+    """DisaggSequenceRLTrainer rides learner_packing identically: wire
+    layouts unchanged, learner consumes packed rows."""
+    from scalerl_tpu.trainer.sequence_rl import DisaggSequenceRLTrainer
+
+    t = DisaggSequenceRLTrainer(
+        _args(genrl_batch=2, genrl_sample_batch=2, max_new_tokens=2,
+              genrl_buffer_sequences=4, disagg_hosts=1)
+    )
+    try:
+        assert "segment_ids" in t.replay.storage
+        m = t.train_round()
+        assert np.isfinite(m["total_loss"])
+    finally:
+        t.close()
+
+
+def test_packed_args_validation():
+    with pytest.raises(ValueError, match="learner_packed_attn"):
+        _args(learner_packed_attn="mosaic").validate()
+    with pytest.raises(ValueError, match="learner_pack_len"):
+        _args(learner_pack_len=-1).validate()
+    with pytest.raises(ValueError, match="fit one"):
+        _args(learner_pack_len=4).validate()  # < prompt_len+max_new_tokens
+    _args(learner_pack_len=16).validate()
+
+
+def test_packed_rows_from_result_roundtrip():
+    """Cohort bridge: unpadding a GenerationResult and bin-packing keeps
+    every token/logp/value at its sequence's offsets."""
+    from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
+
+    V = 16
+    model = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=16, num_heads=2,
+        num_layers=1, max_len=16,
+    )
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    eng = GenerationEngine(
+        model, params,
+        GenerationConfig(vocab_size=V, max_prompt_len=4, max_new_tokens=4),
+    )
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, V, (4, 4)).astype(np.int32)
+    lengths = np.array([2, 4, 3, 1], np.int32)
+    r = eng.generate(prompts, lengths)
+    rewards = np.arange(4, dtype=np.float32)
+    pk = packed_rows_from_result(r, rewards, pack_len=8)
+    assert isinstance(pk, PackedLearnerBatch)
+    assert pk.sequences_packed == 4
+    assert pk.decode_tokens == r.decode_tokens
+    assert pk.real_tokens == int(lengths.sum()) + r.decode_tokens
+    # every sequence's response logps survive packing, wherever it landed
+    packed_logps = np.sort(pk.behavior_logp[pk.mask > 0])
+    np.testing.assert_allclose(
+        packed_logps, np.sort(r.behavior_logp[r.mask > 0]), atol=0
+    )

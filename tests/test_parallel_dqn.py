@@ -34,6 +34,11 @@ def test_np_forward_rejects_noisy():
         mlp_qnet_forward(jax.tree_util.tree_map(np.asarray, params), np.zeros((1, 4)))
 
 
+@pytest.mark.slow  # ~7 s learning curve — the single-process cartpole
+# solve (test_dqn_learns_cartpole) is already slow-marked by the same
+# convention; the parallel plane's mechanics stay tier-1-covered by the
+# np-forward parity units here plus the shm-ring and process-actor
+# suites (ISSUE 15 tier-1 budget buy-back)
 def test_parallel_dqn_trains_cartpole():
     gym = pytest.importorskip("gymnasium")
     del gym

@@ -220,7 +220,7 @@ def test_serving_bench_artifact_schema(capsys, monkeypatch):
     assert result["flushes"] > 0
 
 
-def test_genrl_bench_artifact_schema(capsys):
+def test_genrl_bench_artifact_schema(capsys, monkeypatch):
     """bench --mode genrl artifacts carry the three headline numbers
     (prefill/decode tokens/s + learn steps/s) and the like-for-like gate
     keys (metric + mode) so genrl history only gates genrl runs.  Runs the
@@ -228,6 +228,7 @@ def test_genrl_bench_artifact_schema(capsys):
     import on the tier-1 clock."""
     import importlib.util
 
+    monkeypatch.setenv("BENCH_LEARN_TARGET_S", "0.2")
     spec = importlib.util.spec_from_file_location(
         "bench_genrl_mod", REPO / "bench.py"
     )
@@ -247,11 +248,80 @@ def test_genrl_bench_artifact_schema(capsys):
     assert result["learn_steps_per_sec"] > 0
     assert result["prompt_bucket"] > 0 and result["response_bucket"] > 0
     assert result["iter_mode"] in ("scan", "unroll")
+    # packed-learner A/B fields (ISSUE 15): the gated packed rate, its
+    # padded twin, and the pad economics that explain the gap
+    assert result["token_ppo_learn_tokens_per_sec_per_chip"] > 0
+    assert result["padded_learn_tokens_per_sec"] > 0
+    assert result["learn_speedup_vs_padded"] > 0
+    assert 0.0 < result["learn_pad_ratio"] < 1.0
+    assert 0.0 <= result["learn_packed_pad_ratio"] < result["learn_pad_ratio"]
+    assert 0 < result["learn_packed_rows"] <= result["learn_batch_sequences"]
+    assert result["learn_pack_len"] > 0
     # the gate filter treats mode rows like the other modes
     from tools.tpu_watch import perf_gate_verdict
 
     ok, median = perf_gate_verdict(result["value"], [result["value"]])
     assert ok and median == result["value"]
+
+
+def test_perf_gate_gated_fields_like_for_like(tmp_path, monkeypatch):
+    """ISSUE 15: token_ppo_learn_tokens_per_sec_per_chip rides the genrl
+    artifacts as a FIELD (the orchestrator's one-json-line contract) and
+    the gate checks it against the same field's like-for-like history —
+    a learn-rate regression fails the step even when decode held."""
+    import tools.tpu_watch as tw
+    from tools.tpu_watch import GATED_FIELDS, _perf_gate_marker
+
+    assert "token_ppo_learn_tokens_per_sec_per_chip" in GATED_FIELDS[
+        "genrl_decode_tokens_per_sec_per_chip"
+    ]
+    history = [
+        {"metric": "genrl_decode_tokens_per_sec_per_chip",
+         "mode": "genrl", "value": 15000.0,
+         "token_ppo_learn_tokens_per_sec_per_chip": 20000.0},
+        {"metric": "genrl_decode_tokens_per_sec_per_chip",
+         "mode": "genrl", "value": 15000.0,
+         "token_ppo_learn_tokens_per_sec_per_chip": 21000.0},
+        # a different mode never gates this one
+        {"metric": "genrl_decode_tokens_per_sec_per_chip",
+         "mode": "genrl-continuous", "value": 15000.0,
+         "token_ppo_learn_tokens_per_sec_per_chip": 90000.0},
+    ]
+    (tmp_path / "BENCH_r09.json").write_text(
+        "".join(
+            json.dumps({"n": i, "parsed": r})
+            for i, r in enumerate(history)
+        )
+    )
+    monkeypatch.setattr(tw, "REPO", str(tmp_path))
+
+    def marker_for(result):
+        log = tmp_path / "step.log"
+        log.write_text(json.dumps(result) + "\n")
+        with open(log, "a+") as bl:
+            return _perf_gate_marker(bl, 0)
+
+    # decode holds, learn regressed >20% below the 20500 median -> marker
+    m = marker_for({
+        "metric": "genrl_decode_tokens_per_sec_per_chip", "mode": "genrl",
+        "value": 15100.0,
+        "token_ppo_learn_tokens_per_sec_per_chip": 9000.0,
+    })
+    assert "token_ppo_learn_tokens_per_sec_per_chip" in m
+    assert "+perf-drop" in m
+    # both within 20% -> clean
+    m = marker_for({
+        "metric": "genrl_decode_tokens_per_sec_per_chip", "mode": "genrl",
+        "value": 14000.0,
+        "token_ppo_learn_tokens_per_sec_per_chip": 19000.0,
+    })
+    assert m == ""
+    # a result without the field (old artifact) only gates the headline
+    m = marker_for({
+        "metric": "genrl_decode_tokens_per_sec_per_chip", "mode": "genrl",
+        "value": 14000.0,
+    })
+    assert m == ""
 
 
 def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
@@ -267,6 +337,7 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_GENRL_TARGET_S", "0.3")
     monkeypatch.setenv("BENCH_GENRL_LANES", "8")
     monkeypatch.setenv("BENCH_GENRL_RESPONSE", "16")
+    monkeypatch.setenv("BENCH_LEARN_TARGET_S", "0.2")
     spec = importlib.util.spec_from_file_location(
         "bench_genrl_cont_mod", REPO / "bench.py"
     )
@@ -303,6 +374,9 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     assert 0.0 <= result["prefix_hit_rate"] <= 1.0
     assert result["steps_in_flight"] >= 1
     assert "group" not in result
+    # packed-learner fields (ISSUE 15) ride the continuous artifact too
+    assert result["token_ppo_learn_tokens_per_sec_per_chip"] > 0
+    assert 0.0 < result["learn_pad_ratio"] < 1.0
 
 
 def test_genrl_continuous_group_bench_artifact_schema(capsys, monkeypatch):
@@ -316,6 +390,9 @@ def test_genrl_continuous_group_bench_artifact_schema(capsys, monkeypatch):
     monkeypatch.setenv("BENCH_GENRL_LANES", "8")
     monkeypatch.setenv("BENCH_GENRL_RESPONSE", "8")
     monkeypatch.setenv("BENCH_GENRL_GROUP", "4")
+    # the learn A/B fields are asserted by the ungrouped schema tests;
+    # this one exercises the GROUP decode shape only
+    monkeypatch.setenv("BENCH_SKIP_LEARN_AB", "1")
     spec = importlib.util.spec_from_file_location(
         "bench_genrl_group_mod", REPO / "bench.py"
     )

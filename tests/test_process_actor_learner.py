@@ -77,6 +77,9 @@ def test_process_actor_kill_and_resume(tmp_path):
     tr_b.close()
 
 
+@pytest.mark.slow  # ~9 s of process spin-up; restart/respawn mechanics
+# stay tier-1-covered by the fleet dedup/requeue units and the elastic
+# soak payload step (ISSUE 15 tier-1 budget buy-back)
 def test_process_actor_elastic_restart(tmp_path, monkeypatch):
     """Elastic actors: an actor whose env faults (clean failure through the
     error funnel) is respawned and training completes instead of failing.

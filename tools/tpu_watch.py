@@ -300,7 +300,9 @@ def perf_gate_verdict(
     return new_value >= (1.0 - threshold) * median, median
 
 
-def _bench_history_values(metric: str, mode=None, mesh=None, group=None):
+def _bench_history_values(
+    metric: str, mode=None, mesh=None, group=None, field: str = "value"
+):
     """fps values from the committed bench history, LIKE-FOR-LIKE: only
     rows with the same metric AND the same ``mode`` (anakin/sharded vs
     default) AND the same ``mesh`` shape AND the same ``group`` shape
@@ -315,13 +317,25 @@ def _bench_history_values(metric: str, mode=None, mesh=None, group=None):
     finally:
         sys.path.remove(REPO)
     return [
-        float(h.get("value") or 0.0)
+        float(h.get(field) or 0.0)
         for h in load_bench_history(REPO)
         if h.get("metric") == metric
         and h.get("mode") == mode
         and h.get("mesh") == mesh
         and h.get("group") == group
     ]
+
+
+# sub-metrics gated off artifact FIELDS (the bench orchestrator's
+# one-json-line contract keeps them from being their own metric lines):
+# per headline metric, the extra fields whose like-for-like history must
+# not regress >20% either.  token_ppo_learn_tokens_per_sec_per_chip is
+# the ISSUE 15 packed-learner rate (real, non-pad tokens/s).
+GATED_FIELDS = {
+    "genrl_decode_tokens_per_sec_per_chip": (
+        "token_ppo_learn_tokens_per_sec_per_chip",
+    ),
+}
 
 
 def _perf_gate_marker(bl, start_offset: int) -> str:
@@ -358,23 +372,32 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
                 result = obj
         if not result or not result.get("value"):
             return ""
-        ok, median = perf_gate_verdict(
-            float(result["value"]),
-            # like-for-like: same metric, same mode (anakin/sharded/default),
-            # same mesh shape — cross-shape comparisons never gate
-            _bench_history_values(
-                result["metric"], result.get("mode"), result.get("mesh"),
-                result.get("group"),
-            ),
-        )
-        if ok or median is None:
-            return ""
-        bl.write(
-            f"[watcher] PERF GATE: {result['value']} fps is >20% below "
-            f"the committed like-for-like history median {median} — "
-            "failing the step\n"
-        )
-        return f"+perf-drop({result['value']}<0.8x{median})"
+        markers = []
+        checks = [("value", float(result["value"]))]
+        for field in GATED_FIELDS.get(result["metric"], ()):
+            if result.get(field):
+                checks.append((field, float(result[field])))
+        for field, value in checks:
+            ok, median = perf_gate_verdict(
+                value,
+                # like-for-like: same metric, same mode (anakin/sharded/
+                # default), same mesh shape, same gated field — cross-shape
+                # comparisons never gate
+                _bench_history_values(
+                    result["metric"], result.get("mode"),
+                    result.get("mesh"), result.get("group"), field=field,
+                ),
+            )
+            if ok or median is None:
+                continue
+            label = "" if field == "value" else f"{field}:"
+            bl.write(
+                f"[watcher] PERF GATE: {label}{value} is >20% below "
+                f"the committed like-for-like history median {median} — "
+                "failing the step\n"
+            )
+            markers.append(f"+perf-drop({label}{value}<0.8x{median})")
+        return "".join(markers)
     except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
         bl.write(f"[watcher] perf gate failed: {e}\n")
         return ""
