@@ -1060,6 +1060,127 @@ def _packed_learn_phase(on_accel: bool) -> dict:
     }
 
 
+def _spec_decode_phase(on_accel: bool) -> dict:
+    """Speculative-decode A/B (ISSUE 16): the continuous engine at the
+    SAME shape/model/params/prompt distribution, speculation off vs on,
+    in one artifact.
+
+    Workload: token-recall prompts decoded greedily with a fixed response
+    budget, so both engines emit the SAME tokens per round (greedy is
+    deterministic and both see identical prompts) and the rate ratio is a
+    pure speed ratio.  Greedy decode of the bench policy settles into
+    repetitive continuations — exactly the structure the n-gram
+    self-drafter exploits — so the reported ``spec_acceptance_rate``
+    shows the regime where speculation pays; on incompressible output it
+    degrades toward 1 token/pass (the docs/SEQUENCE_RL.md
+    acceptance-rate table).
+
+    Measurement design, tuned for a noisy CPU substrate:
+
+    - **interleaved rounds** — each measured round runs through the OFF
+      engine then the ON engine back-to-back, so host-load drift hits
+      both sides equally instead of whichever phase ran second;
+    - **long responses** — every lane occupancy re-pays the drafter's
+      cold ramp (the AIMD cap regrows 1 -> 2 -> 4 -> ... -> k through
+      the verify ladder's narrow buckets), a fixed per-occupancy cost
+      that only amortizes when the steady full-``k`` stretch dominates.
+      At the default response budget the spec side clears >1.2x on CPU;
+      at short budgets the ramp eats the win — which is itself the
+      honest answer the A/B exists to report.
+
+    The headline ``genrl_spec_accepted_tokens_per_sec`` counts accepted
+    (real) tokens over whole-round wall clock and is perf-gated
+    like-for-like in tpu_watch alongside the decode headline."""
+    import jax
+    import numpy as np
+
+    from scalerl_tpu.genrl.continuous import (
+        ContinuousConfig,
+        ContinuousEngine,
+    )
+    from scalerl_tpu.genrl.task import TokenRecallTask
+    from scalerl_tpu.models.transformer import TransformerPolicy
+
+    R = int(os.environ.get("BENCH_SPEC_RESPONSE", "512"))
+    k = int(os.environ.get("BENCH_SPEC_K", "24"))
+    if on_accel:
+        V, d_model, n_layers, n_heads = 64, 256, 4, 8
+        P, lanes, ps = 32, 64, 16
+        target_s = 8.0
+    else:
+        V, d_model, n_layers, n_heads = 8, 32, 1, 4
+        P, lanes, ps = 8, 8, 8
+        target_s = float(os.environ.get("BENCH_SPEC_TARGET_S", "2.0"))
+    task = TokenRecallTask(vocab_size=V, prompt_len=P, response_len=R)
+    model = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=d_model, num_heads=n_heads,
+        num_layers=n_layers, max_len=2 * (P + R),
+    )
+    params = model.init(
+        jax.random.PRNGKey(2),
+        jax.numpy.zeros((1, 2), jax.numpy.int32),
+    )
+    base = dict(
+        vocab_size=V, max_prompt_len=P, max_new_tokens=R,
+        temperature=0.0, eos_token=-1, seed=0,
+        lanes=lanes, page_size=ps, steps_per_macro=8,
+        prompt_buckets=(P,),
+    )
+
+    def make(spec_k):
+        return ContinuousEngine(
+            model, params, ContinuousConfig(spec_k=spec_k, **base)
+        )
+
+    def round_once(engine, prompts, lengths):
+        for i in range(lanes):
+            engine.submit(prompts[i], int(lengths[i]))
+        done = tokens = 0
+        while done < lanes:
+            cs = engine.step()
+            done += len(cs)
+            tokens += sum(len(c.response_tokens) for c in cs)
+        return tokens
+
+    engines = (make(0), make(k))
+    rng = np.random.default_rng(0)
+    # warm until the verify ladder stops compiling new buckets for TWO
+    # consecutive round pairs: a first pass through an unseen
+    # draft-length bucket traces (~1s on CPU), and one stray compile
+    # inside a measured round would swamp the signal the interleaving
+    # exists to protect.  Rare buckets (a pass whose longest draft is 0
+    # or 1 tokens) can surface several rounds in, hence the hysteresis.
+    stable = 0
+    while stable < 2:
+        traces = engines[1]._verify_traces
+        warm = task.sample_prompts(lanes, rng)
+        for engine in engines:
+            round_once(engine, *warm)
+        stable = stable + 1 if engines[1]._verify_traces == traces else 0
+    times = [0.0, 0.0]
+    toks = [0, 0]
+    rounds = 0
+    while sum(times) < target_s or rounds < 2:
+        prompts, lengths = task.sample_prompts(lanes, rng)
+        for i, engine in enumerate(engines):
+            t0 = time.perf_counter()
+            toks[i] += round_once(engine, prompts, lengths)
+            times[i] += time.perf_counter() - t0
+        rounds += 1
+    off_tps = toks[0] / times[0]
+    on_tps = toks[1] / times[1]
+    eng = engines[1]
+    return {
+        "genrl_spec_accepted_tokens_per_sec": round(on_tps, 1),
+        "spec_off_tokens_per_sec": round(off_tps, 1),
+        "spec_speedup": round(on_tps / max(off_tps, 1e-9), 3),
+        "spec_acceptance_rate": round(eng.spec_acceptance_rate, 4),
+        "spec_k": k,
+        "spec_response_budget": R,
+        "spec_rollback_pages": eng.spec_rollback_pages_total,
+    }
+
+
 def _run_genrl_measurement() -> None:
     """``--mode genrl``: the token-level sequence-RL plane's headline
     numbers — prefill tokens/s/chip and decode tokens/s/chip through the
@@ -1190,6 +1311,10 @@ def _run_genrl_measurement() -> None:
     # perf-gated like-for-like in tpu_watch alongside the headline value
     # (the artifact stays ONE json line, the orchestrator's contract)
     result_obj.update(_packed_learn_phase(on_accel))
+    # phase 5 (ISSUE 16): speculative-decode A/B on the continuous engine
+    # at one shape — spec off vs on in the same artifact, with the
+    # accepted-tokens/s headline gated like-for-like in tpu_watch
+    result_obj.update(_spec_decode_phase(on_accel))
     print(json.dumps(result_obj))
 
 

@@ -781,6 +781,16 @@ class GenRLArguments(RLArguments):
     # copy-on-write into later admissions of the same prefix (flushed on
     # every param push; off = always prefill from scratch).
     genrl_prefix_cache: bool = True
+    # Speculative decoding (ISSUE 16, continuous engine only): each pass,
+    # lanes self-draft up to spec_k tokens from their own n-gram table
+    # (no draft model — nothing extra on the snapshot plane) and ONE
+    # batched verify pass accepts/rejects them under the exact
+    # speculative-sampling rule, so the output distribution is unchanged.
+    # Off by default: the win depends on the task's draft acceptance rate
+    # (see docs/SEQUENCE_RL.md "Speculative decoding").
+    spec_enable: bool = False
+    spec_k: int = 4  # draft tokens per pass when spec_enable (>= 1)
+    spec_ngram: int = 3  # n-gram width the self-drafter matches
 
     # Pad-free packed learner (ISSUE 15): bin-pack completed sequences
     # (compact prompt+response, no intra-sequence pad) into fixed
@@ -890,6 +900,20 @@ class GenRLArguments(RLArguments):
             raise ValueError(
                 f"genrl_steps_in_flight must be >= 1, got "
                 f"{self.genrl_steps_in_flight}"
+            )
+        if self.spec_enable and self.genrl_engine != "continuous":
+            raise ValueError(
+                "spec_enable requires genrl_engine='continuous' (the "
+                "cohort engine's fused round has no verify pass), got "
+                f"{self.genrl_engine!r}"
+            )
+        if self.spec_enable and self.spec_k < 1:
+            raise ValueError(
+                f"spec_k must be >= 1 when spec_enable, got {self.spec_k}"
+            )
+        if self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {self.spec_ngram}"
             )
         if self.learner_packed_attn not in ("auto", "pallas", "xla"):
             raise ValueError(

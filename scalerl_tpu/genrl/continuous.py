@@ -45,10 +45,31 @@ the host swaps *sequences* through them —
   EVERY holder's reservation, so sharing never loosens the guarantee)
   while physical pages are drawn lazily as contexts grow.
 
+- **speculative decoding** (ISSUE 16, ``spec_k > 0``) — the sequential-
+  depth lever: each pass, every live lane proposes up to ``spec_k``
+  continuation tokens from its own jax-free n-gram table
+  (:class:`~scalerl_tpu.genrl.drafter.NgramDrafter` — no second model,
+  nothing extra rides the snapshot plane), and ONE batched verify program
+  scores all proposed tokens through the shared-table tail-prefill path:
+  it samples the bonus token from the carried logits in-program, feeds
+  ``[t0, d1..dk]`` at positions ``cl..cl+k``, accepts the longest draft
+  prefix under the exact speculative-sampling rule (greedy match at
+  temperature 0; accept-with-prob ``pi(d)`` plus a carried banned-token
+  residual resample at temperature > 0 — the output distribution is
+  UNCHANGED either way), and advances each lane ``1..k+1`` tokens.
+  Rejected tails roll back host-side via page-cursor rewind
+  (:func:`~scalerl_tpu.genrl.paging.rewind_pages` — a refcount
+  decrement, never a mutation, so CoW-shared pages are untouched); the
+  device needs no rollback at all because attention never reads past a
+  lane's cursor and the next pass's writes overwrite the rejected slots.
+  Spec mode is inherently synchronous (drafting pass ``m+1`` needs pass
+  ``m``'s emitted tokens), so it runs at ``steps_in_flight = 1``
+  semantics regardless of the configured depth.
+
 Sampling math is shared with the fixed-cohort engine (``engine.py``'s
 ``adjust_logits``/``sample_tokens``), so at temperature 0 the two engines
 are token-identical on the same params — the parity the acceptance tests
-pin, with the prefix cache on or off.  A sequence is tagged with the param
+pin, with the prefix cache on or off, speculation on or off.  A sequence is tagged with the param
 generation that admitted it; a ``push_params`` mid-flight rotates the
 policy under lanes already decoding (inherent to continuous batching; the
 token-PPO ratios absorb it exactly like actor lag) and FLUSHES the prefix
@@ -82,7 +103,8 @@ from scalerl_tpu.genrl.engine import (
     adjust_logits,
     sample_tokens,
 )
-from scalerl_tpu.genrl.paging import PageAllocator
+from scalerl_tpu.genrl.drafter import NgramDrafter
+from scalerl_tpu.genrl.paging import PageAllocator, rewind_pages
 from scalerl_tpu.genrl.prefix_cache import PrefixCache
 from scalerl_tpu.models.transformer import (
     PagedKVCache,
@@ -143,6 +165,15 @@ class ContinuousConfig(GenerationConfig):
     # of the same prefix.  Off = every admission prefills from scratch
     # (the cache-off twin the token-identity tests compare against).
     prefix_cache: bool = True
+    # Speculative decoding (ISSUE 16): 0 compiles speculation out entirely
+    # (the plain macro-step engine, parity-pinned); k > 0 drafts up to k
+    # tokens per lane per pass from the lane's own n-gram table and
+    # verifies them in ONE batched pass.  Wins when the workload's
+    # acceptance rate clears ~1/(k+1); pure-noise text degrades toward
+    # one token per pass (see docs/SEQUENCE_RL.md "Speculative decoding").
+    spec_k: int = 0
+    # n-gram width the self-drafter matches against the context tail.
+    spec_ngram: int = 3
 
     def validate(self) -> None:
         super().validate()
@@ -168,6 +199,15 @@ class ContinuousConfig(GenerationConfig):
         if self.steps_in_flight < 1:
             raise ValueError(
                 f"steps_in_flight must be >= 1, got {self.steps_in_flight}"
+            )
+        if self.spec_k < 0:
+            raise ValueError(
+                f"spec_k must be >= 0 (0 = speculation off), got "
+                f"{self.spec_k}"
+            )
+        if self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {self.spec_ngram}"
             )
 
 
@@ -310,10 +350,48 @@ class ContinuousEngine(ParamSnapshotPlane):
         self._decode_traces = 0
         self._prefill_traces = 0
         self._fork_traces = 0
+        self._verify_traces = 0
         self._warm = False
         self.macro_steps = 0
         self.completed_total = 0
         self._occupancy_sum = 0.0
+        # speculative decode (ISSUE 16): compiled out entirely at k = 0 —
+        # the plain macro-step path never pays a branch, a wider program,
+        # or drafter bookkeeping
+        self._spec_k = config.spec_k
+        self._drafter: Optional[NgramDrafter] = None
+        # verify programs keyed by effective draft width: a pow2 ladder
+        # over the pass's max draft length (0, 1, 2, 4, ..., k), mirroring
+        # the admit path's prompt buckets.  A ramping fleet whose drafts
+        # are still short verifies through a narrow program instead of
+        # paying k wasted positions per lane per pass — and each bucket
+        # compiles exactly once (the ladder is finite and shape-static),
+        # so the retrace pin holds at <= len(buckets) forever
+        self._verify_fns: Dict[int, Callable] = {}
+        self._spec_buckets: Tuple[int, ...] = ()
+        self._spec_warm: set = set()
+        # banned-token carry for the temperature>0 residual resample: the
+        # token rejected by last pass's accept-test, masked out of the
+        # NEXT pass's bonus-token sampling (exact residual for a
+        # point-mass drafter).  Host-side because spec mode reads every
+        # pass synchronously anyway — it rides the one batched upload.
+        self._banned = np.full((L,), -1, np.int32)
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_rollback_pages_total = 0
+        self._spec_draft_s = 0.0
+        self._spec_verify_s = 0.0
+        if self._spec_k:
+            self._drafter = NgramDrafter(
+                n=config.spec_ngram, k=config.spec_k
+            )
+            ladder = [0]
+            b = 1
+            while b < config.spec_k:
+                ladder.append(b)
+                b *= 2
+            ladder.append(config.spec_k)
+            self._spec_buckets = tuple(ladder)
         # prefill-savings accounting (the bench's saved-ratio numerator /
         # denominator): full-page prefix tokens admitted vs those skipped
         # via cache hits and CoW group shares
@@ -328,6 +406,12 @@ class ContinuousEngine(ParamSnapshotPlane):
         self._completed_counter = reg.counter("genrl.completed")
         self._shared_counter = reg.counter("genrl.pages_shared")
         self._admit_hist = reg.histogram("genrl.admission_latency_s")
+        self._spec_proposed_counter = reg.counter("genrl.spec_proposed")
+        self._spec_accepted_counter = reg.counter("genrl.spec_accepted")
+        self._spec_rollback_counter = reg.counter(
+            "genrl.spec_rollback_pages"
+        )
+        self._spec_accept_gauge = reg.gauge("genrl.spec_acceptance_rate")
         reg.bind("genrl.pages", self.allocator.stats)
         if self._prefix_cache is not None:
             reg.bind("genrl.prefix", self._prefix_cache.stats)
@@ -342,6 +426,7 @@ class ContinuousEngine(ParamSnapshotPlane):
                 "in_flight": len(self._inflight),
                 "shed_total": self._batcher.shed_total,
                 "iter_mode": self.iter_mode,
+                "spec_k": self._spec_k,
             },
         )
 
@@ -561,6 +646,12 @@ class ContinuousEngine(ParamSnapshotPlane):
         lane.admit_macro = self.macro_steps
         self._table[lane_id] = 0
         self._table[lane_id, : len(pages)] = pages
+        if self._drafter is not None:
+            # a recycled lane id starts a fresh draft table over the new
+            # prompt, and any banned-token carry from the previous
+            # occupant dies with it
+            self._drafter.start(lane_id, prompt[:m])
+            self._banned[lane_id] = -1
 
     # -- prefill dispatch ------------------------------------------------
     def _dispatch_local_prefill(
@@ -920,6 +1011,196 @@ class ContinuousEngine(ParamSnapshotPlane):
 
         return jax.jit(decode, donate_argnums=(1, 2, 3, 4, 5, 6))
 
+    def _build_verify(self, k_eff: int) -> Callable:
+        """One speculative verify program at draft width ``k_eff``
+        (ISSUE 16): sample the bonus token from the carried logits, feed
+        ``[t0, d1..dk]`` through the shared-table tail-prefill path in a
+        single forward, accept the longest draft prefix, and carry the
+        state at the last accepted position.
+
+        Lane count and ``k_eff`` are both static, so each ladder bucket
+        compiles exactly once (``_verify_traces`` pins the total at
+        <= len(buckets)); ``_spec_step`` routes every pass to the
+        smallest bucket that fits its longest draft, so short-draft
+        passes — the ramp, and lanes the AIMD cap has clamped — never
+        pay ``spec_k`` computed positions.  ``k_eff`` may be 0: the
+        bonus-only program, one position per lane, the spec-mode twin of
+        a single decode substep.  The carried-logits
+        invariant survives untouched: ``logits_st`` is always the
+        distribution for the token at cursor ``cl``, computed from an
+        all-accepted context — output slot ``a`` qualifies because slots
+        ``0..a`` fed exactly the emitted tokens.  K/V written for
+        rejected slots is garbage past the cursor: never attended (the
+        tail path masks ``pos <= qpos``) and overwritten by the next
+        pass's writes, so the device needs no rollback — rollback is
+        purely the host-side page rewind.
+
+        Distribution correctness at temperature > 0 is the standard
+        speculative-sampling argument for a point-mass (deterministic)
+        drafter: draft ``d_j`` is accepted with probability
+        ``pi_j(d_j)``; on an accept-test rejection the replacement token
+        must come from the residual ``pi(x) / (1 - pi(d))`` over
+        ``x != d``, which is exactly next pass's bonus sampling with
+        ``d`` masked out (the ``banned`` carry).  The STORED behavior
+        logp is always from the unmasked distribution — marginally the
+        output token is ``pi``-distributed, which is what the learner's
+        ratios need.  At temperature 0 both rules collapse to greedy
+        argmax equality and ``banned`` stays -1.
+        """
+        model = self.model
+        cfg = self.config
+        k = k_eff
+        T = k + 1
+        V = cfg.vocab_size
+        budget = self._response_budget
+        greedy = cfg.temperature == 0.0
+        pad = jnp.int32(max(cfg.eos_token, cfg.pad_token))
+
+        def verify(
+            params, pools, logits_st, value_st, cl, done, resp,
+            drafts, draft_len, page_ids, page_offsets, table, banned, key,
+        ):
+            self._verify_traces += 1
+            L = cl.shape[0]
+            rows = jnp.arange(L)
+            alive = jnp.logical_not(done)
+            k0, kacc = jax.random.split(key)
+            # bonus token: sampled from the carried logits exactly like a
+            # decode substep — except at temperature > 0 a banned token
+            # (last pass's accept-test rejection) is masked from the
+            # SAMPLING distribution only (the residual rule)
+            adj0 = adjust_logits(
+                logits_st, cfg.temperature, cfg.top_k, V
+            )
+            if greedy:
+                samp0 = adj0
+            else:
+                ban_pen = jnp.zeros((L, V), jnp.float32)
+                ban_pen = ban_pen.at[rows, jnp.clip(banned, 0, V - 1)].set(
+                    jnp.where(banned >= 0, -1e9, 0.0)
+                )
+                samp0 = adj0 + ban_pen
+            t0 = sample_tokens(k0, samp0, cfg.temperature)
+            logp0 = jnp.take_along_axis(
+                jax.nn.log_softmax(adj0, axis=-1), t0[:, None], axis=-1
+            )[:, 0]
+            # one forward over [t0, d1..dk] at positions cl..cl+k through
+            # the shared-table tail path; slot j's output is the policy
+            # distribution for position cl+j+1
+            X = jnp.concatenate([t0[:, None], drafts], axis=1)
+            positions = jnp.clip(
+                cl[:, None] + jnp.arange(T)[None, :], 0, model.max_len - 1
+            )
+            out, pools = model.apply(
+                params,
+                X,
+                positions=positions,
+                paged_cache=pools,
+                page_ids=page_ids,
+                page_offsets=page_offsets,
+                page_table=table,
+                prefix_starts=cl,
+            )
+            o_logits = out.policy_logits  # [L, T, V]
+            o_value = out.baseline  # [L, T]
+            adj = adjust_logits(
+                o_logits.reshape(L * T, V), cfg.temperature, cfg.top_k, V
+            ).reshape(L, T, V)
+            # accept test per draft j (against the distribution AFTER slot
+            # j-1): greedy equality at temperature 0, accept-with-prob
+            # pi(d) otherwise; gated on the host's draft_len clamp and on
+            # no EOS having been emitted earlier in this pass
+            prev = adj[:, :k]
+            logp_d = jnp.take_along_axis(
+                jax.nn.log_softmax(prev, axis=-1),
+                drafts[:, :, None], axis=-1,
+            )[:, :, 0]
+            if greedy:
+                accept = drafts == jnp.argmax(prev, axis=-1)
+            else:
+                u = jax.random.uniform(
+                    kacc, (L, k), minval=1e-20, maxval=1.0
+                )
+                accept = jnp.log(u) < logp_d
+            valid = jnp.arange(1, k + 1)[None, :] <= draft_len[:, None]
+            ok = accept & valid
+            if cfg.eos_token >= 0:
+                ok = ok & (X[:, :k] != cfg.eos_token)
+            chain = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+            a = chain.sum(axis=1)  # accepted drafts per lane, in [0, k]
+            # emitted stream: t0 plus the accepted prefix — the outputs
+            # mirror the decode macro's (prefix-contiguous mask), so the
+            # host harvest path is shared verbatim
+            slot = jnp.arange(T)[None, :]
+            mask = (slot <= a[:, None]) & alive[:, None]
+            emit = jnp.where(mask, X, pad).astype(jnp.int32)
+            logps = jnp.concatenate([logp0[:, None], logp_d], axis=1)
+            values = jnp.concatenate(
+                [value_st[:, None], o_value[:, :k]], axis=1
+            )
+            n_emit = (1 + a) * alive.astype(jnp.int32)
+            resp2 = resp + n_emit
+            cl2 = cl + n_emit
+            last_tok = jnp.take_along_axis(X, a[:, None], axis=1)[:, 0]
+            finished = resp2 >= budget
+            if cfg.eos_token >= 0:
+                finished = jnp.logical_or(
+                    finished, last_tok == cfg.eos_token
+                )
+            done2 = jnp.logical_or(done, alive & finished)
+            # carry the state at the LAST ACCEPTED slot: its output is the
+            # distribution for the token at the new cursor
+            new_logits = jnp.take_along_axis(
+                o_logits, a[:, None, None], axis=1
+            )[:, 0]
+            new_value = jnp.take_along_axis(o_value, a[:, None], axis=1)[
+                :, 0
+            ]
+            logits_st2 = jnp.where(alive[:, None], new_logits, logits_st)
+            value_st2 = jnp.where(alive, new_value, value_st)
+            if greedy or k == 0:
+                # no draft positions -> nothing the accept test could
+                # have rejected; the residual carry stays clear
+                banned2 = jnp.full((L,), -1, jnp.int32)
+            else:
+                # ban only on a genuine accept-test rejection (not mere
+                # draft/budget exhaustion) of a still-live lane
+                j1 = jnp.clip(a, 0, k - 1)
+                hit = jnp.take_along_axis(accept, j1[:, None], axis=1)[
+                    :, 0
+                ]
+                d1 = jnp.take_along_axis(drafts, j1[:, None], axis=1)[
+                    :, 0
+                ]
+                rej = (
+                    (a < k)
+                    & jnp.take_along_axis(valid, j1[:, None], axis=1)[:, 0]
+                    & jnp.logical_not(hit)
+                    & alive
+                    & jnp.logical_not(done2)
+                )
+                if cfg.eos_token >= 0:
+                    no_eos = jnp.take_along_axis(
+                        X[:, :k] != cfg.eos_token, j1[:, None], axis=1
+                    )[:, 0]
+                    rej = rej & no_eos
+                banned2 = jnp.where(rej, d1, -1).astype(jnp.int32)
+            outputs = {
+                "tokens": emit,
+                "logp": logps.astype(jnp.float32),
+                "value": values.astype(jnp.float32),
+                "mask": mask.astype(jnp.float32),
+                "cl": cl2,
+                "done": done2,
+                "resp": resp2,
+                "banned": banned2,
+            }
+            return (
+                pools, logits_st2, value_st2, cl2, done2, resp2, outputs
+            )
+
+        return jax.jit(verify, donate_argnums=(1, 2, 3, 4, 5, 6))
+
     # -- param plane -----------------------------------------------------
     def push_params(
         self,
@@ -946,7 +1227,12 @@ class ContinuousEngine(ParamSnapshotPlane):
         is stale by up to K-1 macros, so the horizon covers the pending
         dispatches plus the one about to go out."""
         ps = self.config.page_size
-        steps = self.config.steps_per_macro * (len(self._inflight) + 1)
+        if self._spec_k:
+            # spec mode is synchronous: the horizon is one verify pass's
+            # worst case — the bonus token plus k accepted drafts
+            steps = self._spec_k + 1
+        else:
+            steps = self.config.steps_per_macro * (len(self._inflight) + 1)
         for lane_id, lane in enumerate(self._lanes):
             if not lane.busy:
                 continue
@@ -973,7 +1259,14 @@ class ContinuousEngine(ParamSnapshotPlane):
         (ONE upload) -> read the OLDEST in-flight macro once
         ``steps_in_flight`` are pending (ONE batched read, lagging
         dispatch by K-1) -> harvest.  Returns the sequences that
-        completed in the macro(s) read this cycle."""
+        completed in the macro(s) read this cycle.
+
+        With ``spec_k > 0`` the cycle is the draft -> verify -> rewind
+        loop instead (:meth:`_spec_step`) — same admission, same harvest,
+        same one-upload-one-read transfer discipline, but synchronous by
+        construction (next pass's drafts need this pass's tokens)."""
+        if self._spec_k:
+            return self._spec_step()
         t_step0 = time.monotonic()
         self._admit()
         dispatched = False
@@ -1042,6 +1335,242 @@ class ContinuousEngine(ParamSnapshotPlane):
                 in_flight=len(self._inflight),
             )
         return completions
+
+    def _spec_step(self) -> List[CompletedSequence]:
+        """One speculative cycle (ISSUE 16): admit -> draft (host-side
+        n-gram lookups, jax-free) -> ONE batched upload + verify dispatch
+        -> ONE batched read -> feed the drafter, harvest, and rewind the
+        page cursor of every rejected tail.
+
+        The transfer shape matches the plain macro-step exactly — one
+        upload, one read — so graftlint's decode discipline holds; the
+        read is synchronous (``steps_in_flight`` is ignored here) because
+        pass ``m+1``'s drafts are functions of pass ``m``'s emitted
+        tokens."""
+        t_step0 = time.monotonic()
+        self._admit()
+        completions: List[CompletedSequence] = []
+        occ = 0.0
+        draft_s = verify_s = 0.0
+        if self.live_lanes > 0:
+            self._ensure_pages()
+            params, _gen = self._snapshot_params()
+            occ = self.live_lanes / self.config.lanes
+            self._occupancy_gauge.set(occ)
+            self._occupancy_sum += occ
+            cfg = self.config
+            ps = cfg.page_size
+            k = self._spec_k
+            L = cfg.lanes
+            # -- draft: per-lane n-gram proposals + page routing, all
+            # host numpy (the gap between read and dispatch the device
+            # decodes through in plain mode is spent drafting here)
+            t_draft0 = time.monotonic()
+            drafts = np.zeros((L, k), np.int32)
+            draft_len = np.zeros((L,), np.int32)
+            busy = np.zeros((L,), bool)
+            cl_host = np.zeros((L,), np.int64)
+            proposed = 0
+            for lane_id, lane in enumerate(self._lanes):
+                if not lane.busy:
+                    continue
+                busy[lane_id] = True
+                cl_host[lane_id] = lane.context_len
+                # the bonus token always fits (a live lane has budget
+                # room by the done latch); drafts are clamped so the
+                # whole accepted run stays within the response budget
+                room = (
+                    lane.prompt_len
+                    + self._response_budget
+                    - lane.context_len
+                    - 1
+                )
+                if room > 0:
+                    d = self._drafter.propose(lane_id)
+                    if d is not None:
+                        dl = min(len(d), room, k)
+                        if dl:
+                            drafts[lane_id, :dl] = d[:dl]
+                            draft_len[lane_id] = dl
+                            proposed += dl
+            # bucket the pass to the smallest ladder width that fits its
+            # longest draft: a ramp pass whose best proposal is 1 token
+            # verifies through the 2-wide program, not the k-wide one —
+            # on a compute-bound substrate the unused slots of a too-wide
+            # program are pure wall-clock waste.  Each bucket is its own
+            # compiled program (shape-static), so this never retraces
+            dmax = int(draft_len.max())
+            kb = next(b for b in self._spec_buckets if b >= dmax)
+            fn = self._verify_fns.get(kb)
+            if fn is None:
+                fn = self._verify_fns[kb] = self._build_verify(kb)
+            T = kb + 1
+            drafts = drafts[:, :kb]
+            # slot j writes K/V at flat position cl + j; slots past the
+            # draft length (and the whole row of a dead lane) route to
+            # the null page.  ``self._table[lane, pos // ps]`` already
+            # IS the padded page matrix (0 where unheld), so routing is
+            # one vectorized [L, T] gather — no per-lane numpy traffic
+            # in the host gap the device sits idle through
+            slot = np.arange(T)
+            gpos = cl_host[:, None] + slot[None, :]
+            page_idx = np.minimum(gpos // ps, self._table.shape[1] - 1)
+            writable = (slot[None, :] <= draft_len[:, None]) & busy[:, None]
+            rows = np.arange(L)[:, None]
+            page_ids = np.where(
+                writable, self._table[rows, page_idx], 0
+            ).astype(np.int32)
+            offsets = np.where(writable, gpos % ps, 0).astype(np.int32)
+            draft_s = time.monotonic() - t_draft0
+            # -- verify: ONE batched upload, ONE dispatch, ONE read
+            t_verify0 = time.monotonic()
+            # per-BUCKET warmth: a first dispatch at a new ladder width
+            # compiles (materializing host constants), which the
+            # steady-state transfer guard would flag — every later pass
+            # through that bucket runs guarded
+            guard = (
+                steady_state_guard()
+                if kb in self._spec_warm
+                else nullcontext()
+            )
+            with guard:
+                with self._dispatch_guard():
+                    self._key, sub = jax.random.split(self._key)
+                    up = _device_put(
+                        (drafts, draft_len, page_ids, offsets,
+                         self._table, self._banned)
+                    )
+                    (
+                        self._pools,
+                        self._logits_st,
+                        self._value_st,
+                        self._cl,
+                        self._done,
+                        self._resp,
+                        outputs,
+                    ) = fn(
+                        params,
+                        self._pools,
+                        self._logits_st,
+                        self._value_st,
+                        self._cl,
+                        self._done,
+                        self._resp,
+                        *up,
+                        sub,
+                    )
+                host = _device_get(outputs)
+            verify_s = time.monotonic() - t_verify0
+            macro_idx = self.macro_steps
+            self.macro_steps += 1
+            self._warm = True
+            self._spec_warm.add(kb)
+            self._banned = np.array(host["banned"], np.int32)  # writable copy
+            # -- drafter maintenance from the already-read outputs (no
+            # extra transfer): live lanes learn their emitted tokens,
+            # finished lanes drop their tables before the id recycles
+            mask = np.asarray(host["mask"], np.float32)
+            tokens = np.asarray(host["tokens"], np.int32)
+            done = np.asarray(host["done"], bool)
+            accepted = 0
+            for lane_id, lane in enumerate(self._lanes):
+                if not lane.busy:
+                    continue
+                count = int(mask[lane_id].sum())
+                accepted += max(count - 1, 0)
+                self._drafter.observe(
+                    lane_id, int(draft_len[lane_id]), max(count - 1, 0)
+                )
+                if count:
+                    self._drafter.extend(
+                        lane_id, tokens[lane_id, :count]
+                    )
+                if done[lane_id]:
+                    self._drafter.release(lane_id)
+            completions = self._harvest(host, macro_idx)
+            # -- page-cursor rewind: every live lane frees the whole
+            # pages past its post-verify cursor (the rejected tail's
+            # pre-extension) — refcount decrements only, so CoW-shared
+            # pages another holder still needs are never touched
+            freed = 0
+            for lane_id, lane in enumerate(self._lanes):
+                if not lane.busy:
+                    continue
+                keep = self.allocator.pages_for_tokens(lane.context_len)
+                n = rewind_pages(
+                    self.allocator, lane.pages, keep,
+                    holder=f"lane[{lane_id}]",
+                )
+                if n:
+                    self._table[lane_id, keep : keep + n] = 0
+                    freed += n
+            self.spec_proposed_total += proposed
+            self.spec_accepted_total += accepted
+            self.spec_rollback_pages_total += freed
+            self._spec_draft_s += draft_s
+            self._spec_verify_s += verify_s
+            if proposed:
+                self._spec_proposed_counter.inc(proposed)
+            if accepted:
+                self._spec_accepted_counter.inc(accepted)
+            if freed:
+                self._spec_rollback_counter.inc(freed)
+            self._spec_accept_gauge.set(self.spec_acceptance_rate)
+        if tracing.sampling_enabled():
+            # ONE head-sampled span per pass with draft/verify child
+            # spans — never per token, never per lane (stamps are host
+            # monotonic reads this method already pays)
+            t_end = time.monotonic()
+            ctx = tracing.record_span(
+                "genrl.macro_step", None, t_step0, t_end,
+                kind="genrl-spec", completed=len(completions),
+                live_lanes=self.live_lanes, occupancy=round(occ, 4),
+                acceptance_rate=round(self.spec_acceptance_rate, 4),
+            )
+            if draft_s or verify_s:
+                t_d0 = t_step0
+                tracing.record_span(
+                    "seq.draft", ctx, t_d0, t_d0 + draft_s,
+                    kind="genrl-spec",
+                )
+                tracing.record_span(
+                    "seq.verify", ctx, t_d0 + draft_s,
+                    t_d0 + draft_s + verify_s, kind="genrl-spec",
+                )
+        return completions
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify pass accepted."""
+        return self.spec_accepted_total / max(self.spec_proposed_total, 1)
+
+    def spec_timers(self) -> Optional[Tuple[float, float]]:
+        """Cumulative host (draft_s, verify_s) across all spec passes, or
+        None with speculation compiled out — the disagg host's seq.draft /
+        seq.verify trace edges are deltas of this."""
+        if not self._spec_k:
+            return None
+        return (self._spec_draft_s, self._spec_verify_s)
+
+    def stats(self) -> Dict[str, Any]:
+        """Engine-lifetime counters, batched from host state that already
+        crossed the device boundary — reading this never adds a
+        transfer."""
+        return {
+            "macro_steps": self.macro_steps,
+            "completed": self.completed_total,
+            "live_lanes": self.live_lanes,
+            "mean_occupancy": self.mean_occupancy,
+            "prefill_tokens": self.prefill_tokens,
+            "prefix_saved_ratio": self.prefix_saved_ratio,
+            "spec_k": self._spec_k,
+            "spec_proposed": self.spec_proposed_total,
+            "spec_accepted": self.spec_accepted_total,
+            "spec_rollback_pages": self.spec_rollback_pages_total,
+            "spec_acceptance_rate": self.spec_acceptance_rate,
+            "spec_draft_s": self._spec_draft_s,
+            "spec_verify_s": self._spec_verify_s,
+        }
 
     def _harvest(
         self, host: Dict[str, np.ndarray], macro_idx: int

@@ -279,6 +279,10 @@ def _device_ready(params: Any) -> Any:
 # stripped before a payload goes on the wire
 _T_SUBMIT = "_t_submit"
 _T_RECV = "_t_recv"
+# speculative-decode timer mark at submit (cumulative engine draft/verify
+# seconds): lets the harvest edge apportion draft vs verify time into
+# child spans under seq.decode (ISSUE 16)
+_T_SPEC = "_t_spec"
 
 
 def _inherit_trace(payload: Dict[str, Any], lease: Mapping[str, Any]) -> None:
@@ -291,6 +295,9 @@ def _inherit_trace(payload: Dict[str, Any], lease: Mapping[str, Any]) -> None:
         t_sub = lease.get(_T_SUBMIT)
         if t_sub is not None:
             payload[_T_SUBMIT] = t_sub
+        spec = lease.get(_T_SPEC)
+        if spec is not None:
+            payload[_T_SPEC] = spec
 
 
 def record_consumption_trace(
@@ -618,6 +625,13 @@ class ContinuousEngineShell:
     def live(self) -> int:
         return len(self._live)
 
+    def spec_timers(self) -> Optional[Tuple[float, float]]:
+        """Cumulative (draft_s, verify_s) when the wrapped engine decodes
+        speculatively, else None — the host's trace edges use deltas of
+        this to attribute draft vs verify time under seq.decode."""
+        timers = getattr(self.engine, "spec_timers", None)
+        return timers() if timers is not None else None
+
     def submit(self, lease: Dict[str, Any]) -> None:
         key = self._next
         self._next += 1
@@ -864,21 +878,52 @@ class GenerationHost:
                     kind="disagg", host=self.host_id,
                 )
                 lease[_T_SUBMIT] = now
+                spec = getattr(self.engine, "spec_timers", None)
+                if spec is not None:
+                    mark = spec()
+                    if mark is not None:
+                        lease[_T_SPEC] = mark
         return lease
 
     def _trace_harvest(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """Close the decode edge (engine submit -> harvested completion;
-        one span per harvested sequence, never per token)."""
+        one span per harvested sequence, never per token).  When the
+        engine decodes speculatively, two child spans under seq.decode
+        apportion the engine's draft vs verify seconds that elapsed over
+        this sequence's decode window (engine-wide aggregates — the
+        per-pass truth lives in the engine's own genrl.macro_step spans;
+        this gives the critical-path analyzer named draft/verify edges on
+        the SEQUENCE trace without any per-token work)."""
         ctx = tracing.extract(payload)
         if ctx is not None:
             t_sub = payload.pop(_T_SUBMIT, None)
+            mark = payload.pop(_T_SPEC, None)
             if t_sub is not None:
-                tracing.record_span(
-                    "seq.decode", parent=ctx, t_start=float(t_sub),
+                t_sub = float(t_sub)
+                span = tracing.record_span(
+                    "seq.decode", parent=ctx, t_start=t_sub,
                     t_end=time.monotonic(), kind="disagg",
                     host=self.host_id,
                     tokens=int(np.size(payload.get("response_tokens", ()))),
                 )
+                spec = getattr(self.engine, "spec_timers", None)
+                if mark is not None and spec is not None:
+                    now_mark = spec()
+                    if now_mark is not None:
+                        dd = max(now_mark[0] - float(mark[0]), 0.0)
+                        dv = max(now_mark[1] - float(mark[1]), 0.0)
+                        if dd > 0.0:
+                            tracing.record_span(
+                                "seq.draft", parent=span, t_start=t_sub,
+                                t_end=t_sub + dd, kind="disagg",
+                                host=self.host_id,
+                            )
+                        if dv > 0.0:
+                            tracing.record_span(
+                                "seq.verify", parent=span,
+                                t_start=t_sub + dd, t_end=t_sub + dd + dv,
+                                kind="disagg", host=self.host_id,
+                            )
         return payload
 
     def _flush(self, force: bool = False) -> None:

@@ -196,3 +196,33 @@ class PageAllocator:
             "shared": self.shared_pages,
             "reserved": self.reserved,
         }
+
+
+def rewind_pages(
+    allocator: PageAllocator,
+    pages: List[int],
+    keep_pages: int,
+    holder: str = "?",
+) -> int:
+    """Page-cursor rewind (ISSUE 16): drop ``holder``'s hold on every page
+    of ``pages`` past the first ``keep_pages`` entries, truncating the list
+    in place.  Returns the number of tail pages rewound.
+
+    This is how a speculative-decode rejection rolls back: the verify pass
+    advanced the lane cursor by fewer tokens than the pages pre-extended
+    for the draft horizon, so the whole pages past
+    ``pages_for_tokens(new_cursor)`` go back through :meth:`PageAllocator
+    .free` — a refcount decrement, NEVER a mutation, so a rewound page that
+    another lane or the prefix cache still holds stays live for them and
+    only this holder's ref drops.  The kept partial page's garbage beyond
+    the cursor is harmless by the engine's masking invariant (attention
+    never reads past a lane's cursor, and the next accepted tokens
+    overwrite those slots).
+    """
+    if keep_pages < 0:
+        raise ValueError(f"keep_pages must be >= 0, got {keep_pages}")
+    tail = pages[keep_pages:]
+    if tail:
+        allocator.free(tail, holder=holder)
+        del pages[keep_pages:]
+    return len(tail)

@@ -298,7 +298,12 @@ class _Block(nn.Module):
                 # shared-table tail prefill: gather the whole context
                 # (cached prefix pages + the tail just scattered above)
                 # through the table, attend causal-from-start — the
-                # compute twin of the decode seam at T > 1, kernel-free
+                # compute twin of the decode seam at T > 1, kernel-free.
+                # The speculative verify pass (genrl/continuous.py) rides
+                # this exact path with T = draft bucket + 1: slot j is
+                # position prefix_starts + j, the pos <= qpos mask keeps
+                # rejected slots' K/V (garbage past the cursor) out of
+                # every query, so draft rollback never touches the device
                 M = page_table.shape[1]
                 gidx = (
                     page_table[:, :, None] * ps

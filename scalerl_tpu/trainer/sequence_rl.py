@@ -148,6 +148,8 @@ class SequenceRLTrainer:
                     paged_attn=args.genrl_paged_attn,
                     steps_in_flight=args.genrl_steps_in_flight,
                     prefix_cache=args.genrl_prefix_cache,
+                    spec_k=args.spec_k if args.spec_enable else 0,
+                    spec_ngram=args.spec_ngram,
                     **base_cfg,
                 ),
                 iter_mode=args.genrl_iter_mode,

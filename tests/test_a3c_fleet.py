@@ -14,6 +14,7 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
 
 
+@pytest.mark.slow
 def test_a3c_fleet_async_gradient_protocol():
     """Plumbing: fleet workers return real gradients, the server applies
     every one of them (updates == tasks), and the weight version advances

@@ -167,6 +167,7 @@ def test_apex_trainer_e2e_learns_cartpole(tmp_path):
         eval_envs.close()
 
 
+@pytest.mark.slow
 def test_apex_sharded_replay_mesh_e2e(tmp_path):
     """Pod-shape Ape-X: dp/fsdp-meshed learner + lane-sharded PER (the
     BASELINE "replay sharded across TPU HBM" row) trains end to end, with

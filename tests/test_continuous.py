@@ -811,3 +811,165 @@ def test_continuous_config_and_args_validation():
     )
     with pytest.raises(ValueError):
         eng.submit_group(np.asarray([3, 4], np.int32), 3)
+    # speculative-decode knobs (ISSUE 16)
+    with pytest.raises(ValueError):
+        ContinuousConfig(spec_k=-1, **base).validate()
+    with pytest.raises(ValueError):
+        ContinuousConfig(spec_ngram=0, **base).validate()
+    ContinuousConfig(spec_k=0, **base).validate()  # 0 = compiled out
+    with pytest.raises(ValueError):
+        GenRLArguments(spec_enable=True, **argbase).validate()  # fixed eng
+    with pytest.raises(ValueError):
+        GenRLArguments(
+            genrl_engine="continuous", spec_enable=True, spec_k=0, **argbase
+        ).validate()
+    with pytest.raises(ValueError):
+        GenRLArguments(spec_ngram=0, **argbase).validate()
+    GenRLArguments(
+        genrl_engine="continuous", spec_enable=True, **argbase
+    ).validate()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (ISSUE 16): draft-and-verify vs plain decode
+
+
+@pytest.fixture(scope="module")
+def spec_setup():
+    """One model + a plain engine and a speculating engine at the SAME
+    config otherwise — module-scoped so the verify-ladder compiles land
+    on the tier-1 clock once."""
+    m = TransformerPolicy(
+        num_actions=V, vocab_size=V, d_model=32, num_heads=2,
+        num_layers=1, max_len=40,
+    )
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 2), jnp.int32))
+    base = dict(
+        vocab_size=V, max_prompt_len=P_MAX, max_new_tokens=12,
+        temperature=0.0, seed=7, lanes=4, page_size=4,
+        steps_per_macro=4, prompt_buckets=(P_MAX,),
+    )
+    plain = ContinuousEngine(m, params, ContinuousConfig(**base))
+    spec = ContinuousEngine(
+        m, params, ContinuousConfig(spec_k=4, spec_ngram=2, **base)
+    )
+    rng = np.random.default_rng(2)
+    prompts = rng.integers(2, V, size=(5, P_MAX)).astype(np.int32)
+    lengths = np.array([6, 5, 3, 2, 4], np.int32)
+    return dict(
+        model=m, params=params, base=base, plain=plain, spec=spec,
+        prompts=prompts, lengths=lengths,
+    )
+
+
+def _drain(eng, want, prompts, lengths):
+    for i in range(want):
+        eng.submit(prompts[i], lengths[i])
+    return _by_prompt(eng.run_until(want, max_macro_steps=200))
+
+
+def test_spec_greedy_token_identity_vs_plain(spec_setup):
+    """The acceptance pin: at temperature 0, speculation changes WHAT is
+    computed per pass but not what is emitted — tokens exactly equal,
+    behavior logps/values to float tolerance, per prompt."""
+    s = spec_setup
+    a = _drain(s["plain"], 5, s["prompts"], s["lengths"])
+    b = _drain(s["spec"], 5, s["prompts"], s["lengths"])
+    assert set(a) == set(b)
+    for key in a:
+        np.testing.assert_array_equal(
+            a[key].response_tokens, b[key].response_tokens
+        )
+        np.testing.assert_allclose(
+            a[key].behavior_logp, b[key].behavior_logp, atol=1e-5
+        )
+        np.testing.assert_allclose(a[key].values, b[key].values, atol=1e-5)
+    # speculation actually engaged (this is not a vacuous parity)
+    assert s["spec"].spec_proposed_total > 0
+    assert s["spec"].spec_accepted_total > 0
+    st = s["spec"].stats()
+    assert 0.0 <= st["spec_acceptance_rate"] <= 1.0
+    assert st["spec_rollback_pages"] >= 0
+    assert st["spec_k"] == 4
+
+
+def test_spec_verify_ladder_never_retraces_after_warmup(spec_setup):
+    """Each pow2 draft-length bucket compiles at most once, the total is
+    pinned by the finite ladder, and further rounds add NO traces — the
+    spec twin of the decode-macro retrace pin."""
+    s = spec_setup
+    eng = s["spec"]
+    buckets = eng._spec_buckets
+    assert buckets == (0, 1, 2, 4)
+    assert 1 <= eng._verify_traces <= len(buckets)
+    traces = eng._verify_traces
+    warm = set(eng._spec_warm)
+    _drain(eng, 3, s["prompts"], s["lengths"])
+    assert eng._verify_traces == traces + len(set(eng._spec_warm) - warm)
+    assert eng._verify_traces <= len(buckets)
+
+
+def test_spec_one_batched_transfer_per_pass(spec_setup, monkeypatch):
+    """The draft loop is host-side: a steady spec pass is ONE batched
+    upload + ONE batched read, same discipline as the plain macro-step
+    (graftlint's JG001 contract, counted at the module seams)."""
+    import scalerl_tpu.genrl.continuous as cont_mod
+
+    s = spec_setup
+    eng = s["spec"]
+    puts, gets = [], []
+    real_put, real_get = cont_mod._device_put, cont_mod._device_get
+    monkeypatch.setattr(
+        cont_mod, "_device_put", lambda x: (puts.append(1), real_put(x))[1]
+    )
+    monkeypatch.setattr(
+        cont_mod, "_device_get", lambda x: (gets.append(1), real_get(x))[1]
+    )
+    eng.submit(s["prompts"][0], s["lengths"][0])
+    eng.step()  # admission pass: prefill upload(s) + the verify pair
+    while eng.live_lanes or eng.pending:
+        puts.clear()
+        gets.clear()
+        eng.step()  # steady: no admission pending
+        assert (len(puts), len(gets)) == (1, 1)
+
+
+def test_spec_group_submit_cow_identity(spec_setup):
+    """submit_group fans one prompt into CoW lanes sharing prefix pages;
+    at temperature 0 the speculating engine's group responses match the
+    plain engine's exactly (as multisets per prompt — lane order is a
+    scheduling detail)."""
+    s = spec_setup
+
+    def group_run(eng):
+        for i in range(2):
+            eng.submit_group(
+                s["prompts"][i][: s["lengths"][i]], 2, tag=i
+            )
+        done = eng.run_until(4, max_macro_steps=200)
+        out = {}
+        for c in done:
+            out.setdefault(c.tag, []).append(
+                c.response_tokens.tobytes()
+            )
+        return {t: sorted(v) for t, v in out.items()}
+
+    assert group_run(s["plain"]) == group_run(s["spec"])
+
+
+def test_spec_telemetry_counters_registered(spec_setup):
+    """The spec counters ride the shared registry under the genrl prefix
+    and the acceptance-rate gauge tracks the engine property."""
+    s = spec_setup
+    eng = s["spec"]
+    reg = telemetry.get_registry()
+    assert reg.counter("genrl.spec_proposed").value >= (
+        eng.spec_proposed_total
+    )
+    assert reg.counter("genrl.spec_accepted").value >= (
+        eng.spec_accepted_total
+    )
+    assert reg.counter("genrl.spec_rollback_pages").value >= 0
+    assert reg.gauge("genrl.spec_acceptance_rate").value == pytest.approx(
+        eng.spec_acceptance_rate
+    )

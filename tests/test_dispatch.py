@@ -378,6 +378,7 @@ def test_iter_mode_scan_unroll_parity():
         )
 
 
+@pytest.mark.slow
 def test_anakin_parity_with_chunked_driver():
     """run_anakin(N) — ONE dispatch covering N chunks — produces the same
     final params and the same per-chunk metric stream as the existing

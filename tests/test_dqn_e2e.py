@@ -65,6 +65,7 @@ def test_dqn_per_nstep_smoke(tmp_path):
     train_envs.close()
 
 
+@pytest.mark.slow
 def test_c51_dqn_smoke(tmp_path):
     """Categorical (C51) DQN end-to-end: distributional head + projected
     Bellman loss train through the same off-policy trainer."""
@@ -145,6 +146,7 @@ def test_dqn_checkpoint_roundtrip(tmp_path):
     train_envs.close()
 
 
+@pytest.mark.slow
 def test_dqn_kill_and_resume(tmp_path):
     """Kill-and-resume: a run interrupted at its last checkpoint and resumed
     with ``--resume`` reaches the same step count as an uninterrupted run,

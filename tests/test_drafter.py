@@ -1,0 +1,128 @@
+"""Self-drafting n-gram tables (ISSUE 16): the host-side proposal half of
+speculative decode.  All jax-free — the drafter's contract is dict/list
+lookups in the host gap between verify read and next dispatch.
+"""
+
+import numpy as np
+import pytest
+
+from scalerl_tpu.genrl.drafter import NgramDrafter
+
+
+def _mk(n=2, k=4):
+    return NgramDrafter(n=n, k=k)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        NgramDrafter(n=0)
+    with pytest.raises(ValueError):
+        NgramDrafter(k=0)
+
+
+def test_propose_repeats_prompt_continuation():
+    """The prompt-lookup case: the context tail re-occurs earlier in the
+    prompt, and the proposal is the tokens that followed it."""
+    d = _mk(n=2, k=3)
+    d.start(0, np.asarray([5, 6, 7, 8, 5, 6], np.int32))
+    out = d.propose(0)
+    assert out is not None
+    np.testing.assert_array_equal(out, [7, 8, 5])
+
+
+def test_no_self_match_index_before_append():
+    """Position p's gram is recorded BEFORE token p is appended, so the
+    context's own trailing gram never indexes itself: a context whose
+    tail occurs nowhere EARLIER yields no full-width match."""
+    d = _mk(n=2, k=4)
+    d.start(0, np.asarray([3, 4], np.int32))
+    # tail (3, 4) was never seen before any position -> width-2 misses;
+    # the width-1 fallback also misses (4 followed nothing earlier)
+    assert d.propose(0) is None
+
+
+def test_miss_cases_cold_lane_and_unseen_gram():
+    d = _mk(n=2, k=2)
+    assert d.propose(99) is None  # never started
+    d.start(1, np.asarray([2, 3, 4, 5], np.int32))
+    assert d.propose(1) is None  # all tokens distinct: no earlier match
+    d.release(1)
+    assert d.propose(1) is None  # released lane is a miss, not an error
+    assert d.stats()["lanes"] == 0
+
+
+def test_latest_full_continuation_beats_earliest():
+    """Among multiple occurrences the NEWEST one with a full-k
+    continuation wins (recency tracks the lane's current phrase), while
+    occurrences too close to the tail are skipped."""
+    d = _mk(n=2, k=2)
+    #            0  1  2  3  4  5  6  7
+    toks = [9, 2, 5, 9, 2, 6, 9, 2]
+    d.start(0, np.asarray(toks, np.int32))
+    # tail (9, 2): occurrences at p=2 (cont 5, 9) and p=5 (cont 6, 9);
+    # p=5 is newer and has 2 tokens after it -> its continuation wins
+    np.testing.assert_array_equal(d.propose(0), [6, 9])
+
+
+def test_earliest_fallback_on_periodic_tail():
+    """On a periodic sequence every recent occurrence sits within one
+    period of the tail; the earliest occurrence — the longest
+    continuation — backstops the draft to full k."""
+    d = _mk(n=2, k=4)
+    d.start(0, np.asarray([7, 8, 7, 8, 7, 8], np.int32))
+    # tail (7, 8) latest occurrence with 4 tokens following is p=2
+    # (cont 7 8 7 8); p=4 only has 2 left and is skipped
+    np.testing.assert_array_equal(d.propose(0), [7, 8, 7, 8])
+
+
+def test_extend_feeds_future_proposals():
+    d = _mk(n=2, k=2)
+    d.start(0, np.asarray([4, 5], np.int32))
+    d.extend(0, np.asarray([6, 4, 5], np.int32))
+    # tail (4, 5) now matches the occurrence at p=0, continuing (6, 4)
+    np.testing.assert_array_equal(d.propose(0), [6, 4])
+
+
+def test_width_fallback_only_while_lane_is_young():
+    """The narrow-width ladder exists for the cold-start ramp: once a
+    lane has generated >= k tokens past its prompt, the full n-gram
+    index is populated and mis-draft-prone narrow matches are off."""
+    d = _mk(n=2, k=2)
+    d.start(0, np.asarray([3, 9, 4], np.int32))
+    # young lane (0 generated): width-2 misses, width-1 (tail 4) misses,
+    # but after emitting a repeat the width-1 index carries it
+    d.extend(0, np.asarray([9], np.int32))
+    out = d.propose(0)  # width-1 match on 9@p1 -> continuation (4, 9)
+    np.testing.assert_array_equal(out, [4, 9])
+    d.extend(0, np.asarray([5], np.int32))  # now 2 = k generated: mature
+    # tail (9, 5) has no width-2 occurrence, and the width-1 fallback is
+    # closed to mature lanes -> no proposal at all
+    assert d.propose(0) is None
+
+
+def test_aimd_cap_clamps_on_rejection_and_regrows():
+    d = _mk(n=1, k=8)
+    d.start(0, np.asarray([6, 6, 6, 6, 6, 6, 6, 6, 6], np.int32))
+    assert len(d.propose(0)) == 8  # cap starts optimistic at k
+    d.observe(0, proposed=8, accepted=1)  # rejection -> clamp past run
+    assert len(d.propose(0)) == 2
+    d.observe(0, proposed=2, accepted=2)  # full accept -> double
+    assert len(d.propose(0)) == 4
+    d.observe(0, proposed=4, accepted=4)
+    assert len(d.propose(0)) == 8  # back at k, never beyond
+    d.observe(0, proposed=8, accepted=8)
+    assert len(d.propose(0)) == 8
+    d.observe(0, proposed=0, accepted=0)  # no-proposal pass: no-op
+    assert len(d.propose(0)) == 8
+    d.observe(123, proposed=4, accepted=0)  # unknown lane: no-op
+
+
+def test_release_and_restart_recycles_lane_id():
+    d = _mk(n=2, k=2)
+    d.start(3, np.asarray([5, 6, 5, 6], np.int32))
+    assert d.propose(3) is not None
+    d.release(3)
+    d.start(3, np.asarray([2, 3, 4], np.int32))
+    assert d.propose(3) is None  # old table gone, fresh context misses
+    assert d.stats()["lanes"] == 1
+    assert d.stats()["indexed_ngrams"] > 0

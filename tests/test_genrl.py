@@ -409,6 +409,7 @@ def test_token_ppo_agent_learn_one_batched_transfer(monkeypatch):
 # trainer e2e (also run standalone by the tpu_watch genrl soak via -k e2e)
 
 
+@pytest.mark.slow
 def test_genrl_e2e_token_ppo_improves_reward():
     """The hermetic acceptance loop: token-PPO on the synthetic recall
     task beats the pinned threshold on CPU, with the steady-state rounds

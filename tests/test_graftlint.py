@@ -904,6 +904,73 @@ def test_jg001_prefix_admission_per_lane_table_read_flags():
     assert "device_get" in findings[0].message
 
 
+# speculative-decode drafter fixtures (ISSUE 16): the draft-and-verify
+# loop's contract is host-side n-gram proposals, ONE batched verify
+# dispatch, ONE batched read of the whole pass's outcomes.  Reading the
+# verify result back per proposed token to "check acceptance early" is a
+# per-token transfer storm inside the tightest loop the engine has —
+# exactly what the one-pass accept-chain math on the device exists to
+# avoid.
+
+GOOD_SPEC_ONE_BATCHED_VERIFY_READ = """
+    import numpy as np
+    import jax
+
+    def spec_pass(drafter, lanes, verify, state, upload):
+        drafts = np.zeros((len(lanes), 8), np.int32)
+        draft_len = np.zeros((len(lanes),), np.int32)
+        for lane_id in lanes:
+            # proposals are host dict/list lookups — no device traffic in
+            # the draft loop
+            d = drafter.propose(lane_id)
+            if d is not None:
+                drafts[lane_id, : len(d)] = d
+                draft_len[lane_id] = len(d)
+        # ONE batched upload, one dispatch, ONE batched read of every
+        # lane's accept counts and emitted tokens
+        state, outputs = verify(state, upload((drafts, draft_len)))
+        host = jax.device_get(outputs)
+        for lane_id in lanes:
+            drafter.observe(lane_id, int(draft_len[lane_id]),
+                            int(host["accepted"][lane_id]))
+        return state, host
+"""
+
+BAD_SPEC_PER_TOKEN_ACCEPT_READ = """
+    import numpy as np
+    import jax
+
+    def spec_pass(drafter, lanes, verify, state, upload):
+        emitted = []
+        for lane_id in lanes:
+            d = drafter.propose(lane_id)
+            state, outputs = verify(state, upload(d))
+            for j in range(len(d)):
+                # per-proposed-token device_get to early-exit on the first
+                # rejection: k blocking round trips per lane per pass
+                ok = jax.device_get(outputs["accept"][lane_id, j])
+                if not ok:
+                    break
+                emitted.append(int(d[j]))
+        return state, emitted
+"""
+
+
+def test_jg001_spec_one_batched_verify_read_is_clean():
+    """The sanctioned draft-and-verify shape — host-side proposals, one
+    batched verify read feeding the drafter's AIMD observe — lints clean
+    in the genrl package."""
+    assert lint(GOOD_SPEC_ONE_BATCHED_VERIFY_READ, relpath=GENRL) == []
+
+
+def test_jg001_spec_per_token_accept_read_flags():
+    """device_get per proposed token inside the draft loop (early-exit
+    acceptance polling) is the ISSUE 16 JG001 violation."""
+    findings = lint(BAD_SPEC_PER_TOKEN_ACCEPT_READ, relpath=GENRL)
+    assert rules_of(findings) == ["JG001"]
+    assert "device_get" in findings[0].message
+
+
 # ---------------------------------------------------------------------------
 # distributed-tracing fixtures (ISSUE 13): scalerl_tpu/runtime is a HOT
 # package and the tracer lives there — spans must be stamped from HOST

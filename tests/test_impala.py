@@ -81,6 +81,7 @@ def test_impala_loss_on_policy_equals_a2c():
     assert float(metrics["mean_reward"]) == 1.0
 
 
+@pytest.mark.slow
 def test_impala_lstm_agent_pixels():
     args = _args(use_lstm=True, hidden_size=32, rollout_length=3)
     agent = ImpalaAgent(args, obs_shape=(84, 84, 4), num_actions=6)

@@ -157,6 +157,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_mesh_learner_plus_tcp_fleet_rehearsal():
     coord = f"127.0.0.1:{_free_port()}"
     procs = [

@@ -291,6 +291,7 @@ def _ragged_batches(seed, V=12, P=8, R=8, B=6, kl=False):
     return padded, packed, pk
 
 
+@pytest.mark.slow
 def test_packed_vs_padded_loss_and_grad_parity():
     """Token-PPO loss AND parameter gradients agree to 1e-5 across ragged
     length mixes — the packed path learns exactly what the padded path

@@ -10,7 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from scalerl_tpu.genrl.paging import PageAllocator
+from scalerl_tpu.genrl.paging import PageAllocator, rewind_pages
 from scalerl_tpu.models.transformer import (
     TransformerPolicy,
     init_paged_kv_cache,
@@ -153,6 +153,90 @@ def test_allocator_reclaim_hook_fires_when_free_list_short():
     a.set_reclaim_hook(reclaim)
     got = a.alloc(2, holder="y")
     assert calls == [2] and len(got) == 2
+
+
+# ---------------------------------------------------------------------------
+# page-cursor rewind (ISSUE 16): the speculative-decode rollback primitive
+
+
+def test_rewind_pages_truncates_tail_and_keeps_cow_prefix_untouched():
+    """A lane pre-extended for the draft horizon rewinds to its
+    post-verify cursor: tail pages free (refcount decrement), the kept
+    prefix — including pages CoW-shared with a sibling lane — is never
+    touched."""
+    a = PageAllocator(num_pages=17, page_size=4)
+    assert a.try_reserve(8)
+    shared = a.alloc(2, holder="lane[0]")
+    a.share(shared, holder="lane[1]")  # sibling group lane's prefix hold
+    tail = a.alloc(3, holder="lane[0]")
+    pages = shared + tail
+    free_before = a.free_pages
+    # cursor landed at 11 tokens -> ceil(11/4) = 3 pages kept
+    n = rewind_pages(a, pages, a.pages_for_tokens(11), holder="lane[0]")
+    assert n == 2
+    assert pages == shared + tail[:1]  # truncated IN PLACE
+    assert a.free_pages == free_before + 2
+    for p in shared:  # CoW prefix refcounts untouched by the rewind
+        assert a.refcount(p) == 2
+        assert sorted(a.holders(p)) == ["lane[0]", "lane[1]"]
+    with pytest.raises(ValueError):
+        rewind_pages(a, pages, -1)
+    assert rewind_pages(a, pages, len(pages)) == 0  # nothing past keep
+
+
+def test_rewind_tail_page_shared_with_prefix_cache_stays_live():
+    """Rewinding a tail page the prefix cache still holds drops only the
+    lane's ref: the page stays allocated for the cache — rollback is
+    refcount bookkeeping, never a recycle of live data."""
+    a = PageAllocator(num_pages=9, page_size=4)
+    assert a.try_reserve(4)
+    pages = a.alloc(3, holder="lane[2]")
+    cached = pages[-1]
+    a.share([cached], holder="prefix-cache")
+    free_before = a.free_pages
+    assert rewind_pages(a, pages, 1, holder="lane[2]") == 2
+    # pages[1] hit zero refs and recycled; the cached page did not
+    assert a.free_pages == free_before + 1
+    assert a.refcount(cached) == 1
+    assert a.holders(cached) == ["prefix-cache"]
+    a.free([cached], holder="prefix-cache")
+    assert a.free_pages == free_before + 2
+
+
+def test_rewind_randomized_schedule_allocator_invariant():
+    """Randomized admit / draft-extend / rewind / finish churn: at every
+    step the free list and the live holds partition the pool
+    (free + held == capacity) and no page is aliased across lanes."""
+    rng = np.random.default_rng(1)
+    a = PageAllocator(num_pages=23, page_size=4)
+    lanes = {}
+    for step in range(400):
+        r = rng.random()
+        if lanes and (r < 0.25 or a.free_pages < 4):
+            lane = int(rng.choice(list(lanes)))
+            pages = lanes.pop(lane)
+            a.free(pages, holder=f"lane[{lane}]")
+        elif lanes and r < 0.6:
+            # one speculative cycle: pre-extend for the draft horizon,
+            # verify accepts a shorter run, rewind to the new cursor
+            lane = int(rng.choice(list(lanes)))
+            pages = lanes[lane]
+            grow = min(int(rng.integers(1, 4)), a.free_pages)
+            if grow:
+                pages.extend(a.alloc(grow, holder=f"lane[{lane}]"))
+            keep = int(rng.integers(1, len(pages) + 1))
+            n = rewind_pages(a, pages, keep, holder=f"lane[{lane}]")
+            assert len(pages) == keep and n >= 0
+        elif a.free_pages >= 2:
+            want = min(int(rng.integers(1, 3)), a.free_pages)
+            lanes[step] = a.alloc(want, holder=f"lane[{step}]")
+        held = [p for pages in lanes.values() for p in pages]
+        assert len(held) == len(set(held)), "page aliased to two lanes"
+        assert len(held) + a.free_pages == a.capacity
+        assert not set(held) & set(a._free)
+    for lane, pages in lanes.items():
+        a.free(pages, holder=f"lane[{lane}]")
+    assert a.free_pages == a.capacity
 
 
 # ---------------------------------------------------------------------------
@@ -330,6 +414,7 @@ def test_resolve_paged_attn(monkeypatch):
 # transformer paged paths vs the dense oracle (same params on every path)
 
 
+@pytest.mark.slow
 def test_paged_prefill_and_decode_match_dense_forward():
     """Paged prefill (compact right-padded prompts, K/V scattered into
     pages) + paged single-token decode steps reproduce the dense masked

@@ -229,6 +229,11 @@ def test_genrl_bench_artifact_schema(capsys, monkeypatch):
     import importlib.util
 
     monkeypatch.setenv("BENCH_LEARN_TARGET_S", "0.2")
+    # shrink the speculative A/B (ISSUE 16) to schema-test scale: short
+    # responses + tiny draft window keep the verify-ladder compiles small
+    monkeypatch.setenv("BENCH_SPEC_TARGET_S", "0.2")
+    monkeypatch.setenv("BENCH_SPEC_RESPONSE", "8")
+    monkeypatch.setenv("BENCH_SPEC_K", "1")
     spec = importlib.util.spec_from_file_location(
         "bench_genrl_mod", REPO / "bench.py"
     )
@@ -257,6 +262,17 @@ def test_genrl_bench_artifact_schema(capsys, monkeypatch):
     assert 0.0 <= result["learn_packed_pad_ratio"] < result["learn_pad_ratio"]
     assert 0 < result["learn_packed_rows"] <= result["learn_batch_sequences"]
     assert result["learn_pack_len"] > 0
+    # speculative-decode A/B fields (ISSUE 16): the gated spec-on rate,
+    # its spec-off twin at the same shape, and the acceptance economics
+    # behind the ratio (>1x only at production response budgets — the
+    # schema-test budget is ramp-dominated by design)
+    assert result["genrl_spec_accepted_tokens_per_sec"] > 0
+    assert result["spec_off_tokens_per_sec"] > 0
+    assert result["spec_speedup"] > 0
+    assert 0.0 <= result["spec_acceptance_rate"] <= 1.0
+    assert result["spec_k"] == 1
+    assert result["spec_response_budget"] == 8
+    assert result["spec_rollback_pages"] >= 0
     # the gate filter treats mode rows like the other modes
     from tools.tpu_watch import perf_gate_verdict
 
@@ -275,13 +291,20 @@ def test_perf_gate_gated_fields_like_for_like(tmp_path, monkeypatch):
     assert "token_ppo_learn_tokens_per_sec_per_chip" in GATED_FIELDS[
         "genrl_decode_tokens_per_sec_per_chip"
     ]
+    # the ISSUE 16 speculative-decode rate rides the same artifact and
+    # gates like-for-like alongside the decode headline
+    assert "genrl_spec_accepted_tokens_per_sec" in GATED_FIELDS[
+        "genrl_decode_tokens_per_sec_per_chip"
+    ]
     history = [
         {"metric": "genrl_decode_tokens_per_sec_per_chip",
          "mode": "genrl", "value": 15000.0,
-         "token_ppo_learn_tokens_per_sec_per_chip": 20000.0},
+         "token_ppo_learn_tokens_per_sec_per_chip": 20000.0,
+         "genrl_spec_accepted_tokens_per_sec": 16000.0},
         {"metric": "genrl_decode_tokens_per_sec_per_chip",
          "mode": "genrl", "value": 15000.0,
-         "token_ppo_learn_tokens_per_sec_per_chip": 21000.0},
+         "token_ppo_learn_tokens_per_sec_per_chip": 21000.0,
+         "genrl_spec_accepted_tokens_per_sec": 17000.0},
         # a different mode never gates this one
         {"metric": "genrl_decode_tokens_per_sec_per_chip",
          "mode": "genrl-continuous", "value": 15000.0,
@@ -309,11 +332,22 @@ def test_perf_gate_gated_fields_like_for_like(tmp_path, monkeypatch):
     })
     assert "token_ppo_learn_tokens_per_sec_per_chip" in m
     assert "+perf-drop" in m
-    # both within 20% -> clean
+    # decode and learn hold but the spec rate regressed >20% below its
+    # own 16500 median -> marker names the spec field
+    m = marker_for({
+        "metric": "genrl_decode_tokens_per_sec_per_chip", "mode": "genrl",
+        "value": 15100.0,
+        "token_ppo_learn_tokens_per_sec_per_chip": 20000.0,
+        "genrl_spec_accepted_tokens_per_sec": 8000.0,
+    })
+    assert "genrl_spec_accepted_tokens_per_sec" in m
+    assert "+perf-drop" in m
+    # all within 20% -> clean
     m = marker_for({
         "metric": "genrl_decode_tokens_per_sec_per_chip", "mode": "genrl",
         "value": 14000.0,
         "token_ppo_learn_tokens_per_sec_per_chip": 19000.0,
+        "genrl_spec_accepted_tokens_per_sec": 15000.0,
     })
     assert m == ""
     # a result without the field (old artifact) only gates the headline

@@ -158,6 +158,7 @@ def test_hetero_pipeline_bubble_schedule_is_tight():
     np.testing.assert_array_equal(np.asarray(short[-mb:]), 0.0)
 
 
+@pytest.mark.slow
 def test_hetero_pipeline_gradients_flow_to_all_stage_kinds():
     mesh = make_mesh("pp=4", devices=jax.devices()[:4])
     (embed_fn, block_fn, head_fn), params, make_pipe, _ = _hetero_setup(

@@ -282,6 +282,7 @@ def test_ppo_trainer_cartpole_smoke(tmp_path):
         envs.close()
 
 
+@pytest.mark.slow
 def test_ppo_fused_device_loop():
     """PPO's learn fn drops into the fused device loop (Anakin-style
     device-native PPO, a la Brax): env step + inference + the full

@@ -28,6 +28,7 @@ def _args(tmp_path, **kw):
     return ImpalaArguments(**base)
 
 
+@pytest.mark.slow
 def test_process_actor_learner_smoke(tmp_path):
     """Actors in spawned processes fill shm slots with their own CPU policy;
     the learner drains, learns, and publishes versioned weights back."""
@@ -104,6 +105,7 @@ def test_process_actor_elastic_restart(tmp_path, monkeypatch):
     assert all(not p.is_alive() for p in trainer.procs)
 
 
+@pytest.mark.slow
 def test_process_actor_error_funnels_to_learner(tmp_path):
     """A crashing actor must surface in the learner, not hang the train loop
     (reference teardown ladder, impala_atari.py:473-494)."""

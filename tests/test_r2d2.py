@@ -212,6 +212,7 @@ def test_r2d2_eval_api_keeps_recurrent_state():
     assert set(agent._eval_state._modes) == {"greedy", "explore"}
 
 
+@pytest.mark.slow
 def test_r2d2_enable_mesh_matches_unsharded():
     """DDP R2D2: the dp/fsdp-sharded learn step is numerically identical to
     the single-device update at the same global sequence batch, and the
@@ -341,7 +342,9 @@ def test_r2d2_host_plane_meshed_dispatch_guard_e2e(tmp_path):
         plain.close()
 
 
-@pytest.mark.parametrize("fused", [True, False])
+@pytest.mark.parametrize(
+    "fused", [True, pytest.param(False, marks=pytest.mark.slow)]
+)
 def test_device_r2d2_trainer_smoke(tmp_path, fused):
     """The device-native loop runs end to end and counts frames/learn
     steps correctly — both as ONE fused dispatch per iteration (the TPU
@@ -367,6 +370,7 @@ def test_device_r2d2_trainer_smoke(tmp_path, fused):
     trainer.close()
 
 
+@pytest.mark.slow
 def test_device_r2d2_fused_mesh(tmp_path):
     """The fused iteration sharded over dp=8: per-shard local replay
     rings, psum'd gradients (params stay replicated), pod-shape R2D2 in

@@ -44,6 +44,7 @@ def test_ring_matches_full_attention(sp_mesh, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match(sp_mesh):
     q, k, v = _qkv(seed=1)
     ring_fn = make_ring_attention_fn(sp_mesh, causal=True)

@@ -104,6 +104,7 @@ def test_sac_learn_step_updates_all_parts():
     assert int(agent.state.step) == 1
 
 
+@pytest.mark.slow
 def test_sac_enable_mesh_matches_unsharded():
     """DDP SAC: dp×fsdp-sharded learn == single-device learn at the same
     global batch (every agent family is one call from DDP)."""
@@ -166,7 +167,9 @@ def test_sac_actions_respect_bounds():
 # pipeline
 
 
-@pytest.mark.parametrize("use_per", [False, True])
+@pytest.mark.parametrize(
+    "use_per", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
 def test_sac_offpolicy_trainer_pipeline(tmp_path, use_per):
     """SAC rides the DQN off-policy pipeline end to end — continuous
     actions through the (plumbed) replay, PER priority feedback included."""

@@ -309,6 +309,7 @@ def test_on_policy_trainer_resolves_mesh_from_args(tmp_path):
 # bf16 params / fp32 optimizer state
 
 
+@pytest.mark.slow
 def test_bf16_params_with_fp32_opt_state():
     args = _transformer_args(bf16_params=True)
     agent = _make_agent(args)

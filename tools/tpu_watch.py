@@ -330,10 +330,14 @@ def _bench_history_values(
 # one-json-line contract keeps them from being their own metric lines):
 # per headline metric, the extra fields whose like-for-like history must
 # not regress >20% either.  token_ppo_learn_tokens_per_sec_per_chip is
-# the ISSUE 15 packed-learner rate (real, non-pad tokens/s).
+# the ISSUE 15 packed-learner rate (real, non-pad tokens/s);
+# genrl_spec_accepted_tokens_per_sec is the ISSUE 16 speculative-decode
+# rate (accepted tokens over whole-round wall clock, spec-on side of the
+# same-shape A/B).
 GATED_FIELDS = {
     "genrl_decode_tokens_per_sec_per_chip": (
         "token_ppo_learn_tokens_per_sec_per_chip",
+        "genrl_spec_accepted_tokens_per_sec",
     ),
 }
 
