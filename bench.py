@@ -542,6 +542,226 @@ def _run_serving_measurement() -> None:
     print(json.dumps(result))
 
 
+def _run_traffic_measurement() -> None:
+    """``--mode traffic``: the serving front door's headline number —
+    goodput under SLO (requests answered within ``BENCH_TRAFFIC_SLO_MS``
+    per second) through the :class:`ServingRouter` over N in-process
+    replicas, under OPEN-LOOP arrivals.
+
+    Open-loop is the honest load model for a front door: each client fires
+    on a Poisson schedule (plus periodic bursts) regardless of whether the
+    previous reply came back, so queueing delay compounds the way real
+    traffic makes it compound — a closed loop would self-throttle and hide
+    exactly the latency the SLO gate exists to catch.  Latency is measured
+    from the request's SCHEDULED arrival, so schedule slip (the client
+    thread falling behind) counts against the tier, and every request
+    carries a head-sampled trace (the PR 13 context keys), so the router's
+    ``router.route`` spans land under each ``traffic.request`` root.
+
+    Exact accounting is asserted before the verdict line: admitted ==
+    answered + shed + orphaned at quiesce, the same equation the chaos e2e
+    gates on.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalerl_tpu.agents.impala import ImpalaAgent
+    from scalerl_tpu.config import ImpalaArguments
+    from scalerl_tpu.runtime import tracing
+    from scalerl_tpu.serving import (
+        InferenceServer,
+        RemotePolicyClient,
+        RouterConfig,
+        ServingConfig,
+        ServingRouter,
+        connect_replica,
+        local_pair,
+    )
+    from scalerl_tpu.utils.platform import setup_platform
+
+    platform = setup_platform("auto")
+    print("backend:", platform, flush=True)
+    device_kind = jax.devices()[0].device_kind
+    on_accel = platform in ("tpu", "gpu")
+    obs_dim, num_actions, lanes = 64, 16, 4
+    if on_accel:
+        n_replicas, n_clients, rps, target_s, slo_ms = 3, 16, 200.0, 10.0, 100.0
+    else:
+        n_replicas = int(os.environ.get("BENCH_TRAFFIC_REPLICAS", "3"))
+        n_clients = int(os.environ.get("BENCH_TRAFFIC_CLIENTS", "4"))
+        rps = float(os.environ.get("BENCH_TRAFFIC_RPS", "60"))
+        target_s = float(os.environ.get("BENCH_TRAFFIC_TARGET_S", "4.0"))
+        slo_ms = float(os.environ.get("BENCH_TRAFFIC_SLO_MS", "250"))
+
+    args = ImpalaArguments(
+        use_lstm=False, hidden_size=256, rollout_length=8, batch_size=4,
+        num_actors=1, num_buffers=2, max_timesteps=0, logger_backend="none",
+    )
+    agent = ImpalaAgent(
+        args, obs_shape=(obs_dim,), num_actions=num_actions,
+        obs_dtype=jnp.float32,
+    )
+    servers = [
+        InferenceServer(agent, ServingConfig(max_batch=32, max_wait_s=0.002))
+        for _ in range(n_replicas)
+    ]
+    for s in servers:
+        s.start()
+    router = ServingRouter(
+        [connect_replica(s, f"replica{i}") for i, s in enumerate(servers)],
+        RouterConfig(hedge_budget=2, probe_backoff_s=0.05, seed=0),
+    )
+    router.start()
+    clients = []
+    for _ in range(n_clients):
+        c_end, r_end = local_pair()
+        router.add_client(r_end)
+        clients.append(RemotePolicyClient(conn=c_end, request_timeout_s=60.0))
+
+    rng = np.random.default_rng(0)
+    la = np.zeros(lanes, np.int32)
+    rew = np.zeros(lanes, np.float32)
+    done = np.zeros(lanes, bool)
+
+    # warmup: keep acting until EVERY replica has flushed at least once —
+    # affinity routing can pin early traffic to one replica, and a replica
+    # that first compiles inside the window torches the latency tail
+    warm_deadline = time.monotonic() + 120.0
+    while (any(s.flushes == 0 for s in servers)
+           and time.monotonic() < warm_deadline):
+        for c in clients:
+            c.act(rng.normal(size=(lanes, obs_dim)).astype(np.float32),
+                  la, rew, done, ())
+
+    per_client_rps = rps / n_clients
+    burst_every_s, burst_n = 1.0, max(2, int(per_client_rps // 4))
+    stop = threading.Event()
+    lat_s: list[list[float]] = [[] for _ in range(n_clients)]
+    sheds = [0] * n_clients
+
+    import queue as queue_mod
+
+    def open_loop(i: int) -> None:
+        local = np.random.default_rng(1000 + i)
+        c = clients[i]
+        inflight: queue_mod.Queue = queue_mod.Queue()
+
+        # companion drain: harvests replies AS THEY LAND (per-client reply
+        # streams are FIFO-demuxed), so t_done is delivery time, not the
+        # end of the window — blocking result() on the oldest first
+        def drain() -> None:
+            while True:
+                item = inflight.get()
+                if item is None:
+                    return
+                pending, t_sched, span = item
+                try:
+                    reply = pending.result(timeout=30.0)
+                except (TimeoutError, ConnectionError):
+                    span.end(outcome="lost")
+                    continue
+                t_done = time.perf_counter()
+                if reply.get("shed"):
+                    sheds[i] += 1
+                    span.end(outcome="shed")
+                else:
+                    lat_s[i].append(t_done - t_sched)
+                    span.end(outcome="ok")
+
+        drainer = threading.Thread(target=drain, daemon=True)
+        drainer.start()
+
+        def fire(t_sched: float) -> None:
+            span = tracing.start_span("traffic.request", kind="serving")
+            msg = c._act_msg(
+                local.normal(size=(lanes, obs_dim)).astype(np.float32),
+                la, rew, done, (),
+            )
+            tracing.inject(msg, span)
+            inflight.put((c._submit(msg), t_sched, span))
+
+        t0 = time.perf_counter()
+        next_poisson = t0 + local.exponential(1.0 / per_client_rps)
+        next_burst = t0 + burst_every_s
+        while not stop.is_set():
+            now = time.perf_counter()
+            # fire everything the schedule owes us — open loop never waits
+            # on a reply to advance the clock
+            while next_poisson <= now:
+                fire(next_poisson)
+                next_poisson += local.exponential(1.0 / per_client_rps)
+            if next_burst <= now:
+                for _ in range(burst_n):
+                    fire(next_burst)
+                next_burst += burst_every_s
+            time.sleep(min(0.002, max(next_poisson - now, 0.0)))
+        inflight.put(None)
+        drainer.join(timeout=60.0)
+
+    threads = [
+        threading.Thread(target=open_loop, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(target_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=90.0)
+    elapsed = time.perf_counter() - t0
+
+    # quiesce, then assert the chaos e2e's accounting equation
+    deadline = time.monotonic() + 10.0
+    while router.stats()["inflight"] > 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stats = router.stats()
+    balanced = (
+        stats["answered"] + stats["shed"] + stats["orphaned"]
+        == stats["admitted"]
+    )
+
+    lat = np.sort(np.concatenate([np.asarray(v) for v in lat_s])
+                  if any(lat_s) else np.zeros(0))
+    answered = int(lat.size)
+    good = int(np.searchsorted(lat, slo_ms / 1e3, side="right"))
+    shed_total = sum(sheds)
+
+    def _q(q: float) -> float:
+        return float(lat[min(int(q * lat.size), lat.size - 1)]) * 1e3 if lat.size else 0.0
+
+    result = {
+        "metric": "traffic_goodput_rps",
+        "mode": "traffic",
+        "value": round(good / elapsed, 1),
+        "unit": f"requests answered within {slo_ms:g} ms SLO per sec "
+                f"({platform}, {n_replicas} replicas)",
+        "offered_rps": round((answered + shed_total) / elapsed, 1),
+        "answered": answered,
+        "good": good,
+        "shed": shed_total,
+        "slo_ms": slo_ms,
+        "p50_ms": round(_q(0.50), 3),
+        "p95_ms": round(_q(0.95), 3),
+        "p99_ms": round(_q(0.99), 3),
+        "retries": stats["retries"],
+        "ejections": stats["ejections"],
+        "accounting_balanced": balanced,
+        "n_replicas": n_replicas,
+        "n_clients": n_clients,
+        "lanes": lanes,
+        "device_kind": device_kind,
+        "measured_s": round(elapsed, 1),
+    }
+    for c in clients:
+        c.close()
+    router.stop()
+    for s in servers:
+        s.stop()
+    print(json.dumps(result))
+
+
 def _run_genrl_continuous_measurement() -> None:
     """``--mode genrl --continuous``: the continuous-batching decode plane
     vs the fixed-cohort engine, like-for-like (same model, same params,
@@ -1368,6 +1588,11 @@ def _run_measurement(
         # the centralized inference plane: requests/sec + latency SLO
         _run_serving_measurement()
         return
+    if mode == "traffic":
+        # the serving front door: open-loop goodput under SLO through the
+        # multi-replica router
+        _run_traffic_measurement()
+        return
     if mode == "genrl":
         # the token-level sequence-RL plane: prefill/decode tokens/s +
         # token-PPO learn steps/s through the KV-cached engine
@@ -1791,6 +2016,7 @@ def main(
         "impala_learn_step_frames_per_sec" if learn
         else "sharded_train_step_frames_per_sec" if mode == "sharded"
         else "serving_requests_per_sec" if mode == "serving"
+        else "traffic_goodput_rps" if mode == "traffic"
         else "genrl_decode_tokens_per_sec_per_chip"
         if mode in ("genrl", "genrl-continuous")
         else "disagg_sequences_per_sec" if mode == "disagg"
@@ -2019,10 +2245,12 @@ if __name__ == "__main__":
             if _mi + 1 >= len(sys.argv):
                 raise SystemExit("--mode requires an argument (anakin | sharded)")
             _mode = sys.argv[_mi + 1]
-            if _mode not in ("anakin", "sharded", "serving", "genrl", "disagg"):
+            if _mode not in (
+                "anakin", "sharded", "serving", "traffic", "genrl", "disagg"
+            ):
                 raise SystemExit(
                     f"unknown --mode {_mode!r}; supported: anakin, sharded, "
-                    "serving, genrl, disagg"
+                    "serving, traffic, genrl, disagg"
                 )
             if _mode == "genrl" and "--continuous" in sys.argv[1:]:
                 # --mode genrl --continuous: the continuous-batching decode
@@ -2047,6 +2275,8 @@ if __name__ == "__main__":
                             if _mode == "sharded"
                             else "serving_requests_per_sec"
                             if _mode == "serving"
+                            else "traffic_goodput_rps"
+                            if _mode == "traffic"
                             else "genrl_decode_tokens_per_sec_per_chip"
                             if _mode in ("genrl", "genrl-continuous")
                             else "disagg_sequences_per_sec"

@@ -184,6 +184,13 @@ class RLArguments:
     # staler than this many learner steps (the unified staleness gauge)
     # is scale-up pressure on the generation fleet.  0 disables the rule.
     autoscale_max_staleness: float = 0.0
+    # Serving-tier capacity rules (the router's replica fleet,
+    # serving/router.py): aggregate p95 past the up threshold adds a
+    # replica; under the down threshold drains one.  Opposite semantics
+    # from the actor-fleet p95 guard — configure per autoscaler instance.
+    # 0 disables either side.
+    autoscale_serving_up_p95_ms: float = 0.0
+    autoscale_serving_down_p95_ms: float = 0.0
 
     # Pallas kernels (ops/pallas_vtrace.py, ops/pallas_per.py): route the
     # V-trace target computation and the PER priority/sum-tree update
@@ -235,6 +242,17 @@ class RLArguments:
             raise ValueError(
                 "autoscale_hysteresis must be >= 1, got "
                 f"{self.autoscale_hysteresis}"
+            )
+        if (
+            self.autoscale_serving_up_p95_ms > 0
+            and self.autoscale_serving_down_p95_ms
+            >= self.autoscale_serving_up_p95_ms
+        ):
+            raise ValueError(
+                "autoscale_serving_down_p95_ms "
+                f"({self.autoscale_serving_down_p95_ms}) must be < "
+                "autoscale_serving_up_p95_ms "
+                f"({self.autoscale_serving_up_p95_ms})"
             )
 
 

@@ -91,6 +91,13 @@ class AutoscalerConfig:
     # optional serving-plane guard: p95 act latency above this sheds load by
     # draining workers (0 disables the rule)
     serving_p95_slo_ms: float = 0.0
+    # serving-TIER capacity rule (the router's replica fleet, where
+    # live_workers are replicas, not actors — opposite semantics from the
+    # guard above): aggregate p95 past the up threshold means the tier is
+    # out of capacity -> add a replica; p95 under the down threshold means
+    # it is over-provisioned -> drain one.  0 disables either side.
+    serving_scale_up_p95_ms: float = 0.0
+    serving_scale_down_p95_ms: float = 0.0
     # optional generation-tier guard (disaggregated sequence RL): consumed
     # data staler than this many learner steps means the generation fleet
     # is underproducing — scale it up (0 disables the rule)
@@ -112,6 +119,23 @@ class AutoscalerConfig:
             raise ValueError(f"scale_step must be >= 1, got {self.scale_step}")
         if self.up_hysteresis < 1 or self.down_hysteresis < 1:
             raise ValueError("hysteresis thresholds must be >= 1")
+        if (
+            self.serving_scale_up_p95_ms > 0
+            and self.serving_scale_down_p95_ms >= self.serving_scale_up_p95_ms
+        ):
+            raise ValueError(
+                "serving_scale_down_p95_ms "
+                f"({self.serving_scale_down_p95_ms}) must be < "
+                f"serving_scale_up_p95_ms ({self.serving_scale_up_p95_ms}) "
+                "or the tier flaps between the two verdicts"
+            )
+        if self.serving_scale_up_p95_ms > 0 and self.serving_p95_slo_ms > 0:
+            raise ValueError(
+                "serving_scale_up_p95_ms (serving-tier capacity: p95 adds "
+                "replicas) and serving_p95_slo_ms (actor-fleet guard: p95 "
+                "drains actors) are opposite semantics for one signal — "
+                "configure one per autoscaler instance"
+            )
 
     @classmethod
     def from_args(cls, args: Any) -> "AutoscalerConfig":
@@ -123,6 +147,14 @@ class AutoscalerConfig:
             cooldown_s=getattr(args, "autoscale_cooldown_s", cls.cooldown_s),
             max_staleness=getattr(
                 args, "autoscale_max_staleness", cls.max_staleness
+            ),
+            serving_scale_up_p95_ms=getattr(
+                args, "autoscale_serving_up_p95_ms", cls.serving_scale_up_p95_ms
+            ),
+            serving_scale_down_p95_ms=getattr(
+                args,
+                "autoscale_serving_down_p95_ms",
+                cls.serving_scale_down_p95_ms,
             ),
         )
         hyst = int(getattr(args, "autoscale_hysteresis", cfg.up_hysteresis))
@@ -201,6 +233,23 @@ class Autoscaler:
     def _pressure(self, s: FleetSignals) -> Optional[str]:
         """Raw directional verdict from one signal vector, pre-hysteresis."""
         cfg = self.config
+        if cfg.serving_scale_up_p95_ms > 0 or cfg.serving_scale_down_p95_ms > 0:
+            # serving-tier capacity semantics (the router's replica fleet):
+            # latency pressure ADDS capacity — checked before the actor
+            # rules because replica sheds are a scale-UP signal here
+            if (
+                cfg.serving_scale_up_p95_ms > 0
+                and s.serving_p95_ms > cfg.serving_scale_up_p95_ms
+            ):
+                return SCALE_UP  # tier out of capacity: add a replica
+            if s.shed_delta > 0:
+                return SCALE_UP  # replicas shedding = demand over capacity
+            if (
+                cfg.serving_scale_down_p95_ms > 0
+                and 0.0 < s.serving_p95_ms <= cfg.serving_scale_down_p95_ms
+            ):
+                return SCALE_DOWN  # comfortably under SLO: drain a replica
+            return None
         if s.shed_delta > 0:
             return SCALE_DOWN  # bounded admission is actively dropping data
         if s.queue_occupancy >= cfg.high_occupancy:
@@ -252,15 +301,20 @@ class Autoscaler:
             )
         if now - self._last_action_t < cfg.cooldown_s:
             return self._hold(f"cooldown:{pressure}", signals, now)
+        serving_tier = (
+            cfg.serving_scale_up_p95_ms > 0 or cfg.serving_scale_down_p95_ms > 0
+        )
         if pressure == SCALE_UP:
             delta = min(cfg.scale_step, cfg.max_workers - live)
             if delta <= 0:
                 return self._hold("at_max_workers", signals, now)
-            return self._act(SCALE_UP, delta, "learner_starved", signals, now)
+            why = "tier_over_capacity" if serving_tier else "learner_starved"
+            return self._act(SCALE_UP, delta, why, signals, now)
         delta = min(cfg.scale_step, live - cfg.min_workers)
         if delta <= 0:
             return self._hold("at_min_workers", signals, now)
-        return self._act(SCALE_DOWN, delta, "overload", signals, now)
+        why = "tier_over_provisioned" if serving_tier else "overload"
+        return self._act(SCALE_DOWN, delta, why, signals, now)
 
     def _hold(self, reason: str, signals: FleetSignals, now: float,
               record: bool = True) -> Decision:
@@ -393,6 +447,40 @@ def fleet_signal_source(
             shed_delta=delta,
             serving_p95_ms=p95,
             live_workers=server.live_worker_count(),
+        )
+
+    return read
+
+
+def router_signal_source(router: Any) -> Callable[[], FleetSignals]:
+    """Signal reader over a ``ServingRouter`` — the serving-TIER loop,
+    where capacity units are replicas and the decision table runs the
+    ``serving_scale_up/down_p95_ms`` rules.
+
+    - ``serving_p95_ms``: the router's aggregate end-to-end p95 (admit ->
+      client reply, retries and failover included — per-replica p95s
+      structurally miss both);
+    - ``shed_delta``: router sheds since the previous read (requests no
+      routable replica could serve — demand past the tier's capacity, a
+      scale-UP signal under tier semantics);
+    - ``fps``: the router's request rate meter;
+    - ``queue_occupancy`` is pinned mid-band: the occupancy rules encode
+      actor-fleet semantics and must stay silent for this tier;
+    - ``live_workers``: live replicas (``RouterTierExecutor``'s spawned
+      count overrides this inside ``Autoscaler.step``).
+    """
+    last = {"shed": 0.0}
+
+    def read() -> FleetSignals:
+        reg = telemetry.get_registry()
+        shed = float(router.shed)
+        delta, last["shed"] = shed - last["shed"], shed
+        return FleetSignals(
+            fps=reg.meter("router.requests_per_s").rate(),
+            queue_occupancy=0.5,
+            shed_delta=delta,
+            serving_p95_ms=float(router.aggregate_p95_ms()),
+            live_workers=int(router.replica_count()),
         )
 
     return read

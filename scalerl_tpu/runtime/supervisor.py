@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import faulthandler
 import os
+import random as _random
 import signal
 import sys
 import tempfile
@@ -85,15 +86,36 @@ def is_drain(msg: Any) -> bool:
     return isinstance(msg, dict) and msg.get("kind") == DRAIN
 
 
-def exp_backoff(attempt: int, base: float = 0.5, cap: float = 10.0) -> float:
+def exp_backoff(
+    attempt: int,
+    base: float = 0.5,
+    cap: float = 10.0,
+    jitter: bool = False,
+    rng: Optional[Any] = None,
+) -> float:
     """Capped exponential delay for reconnect attempt ``attempt`` (0-based).
 
-    Deterministic (no jitter): fleet tests assert the schedule, and the
-    handful of gathers per host cannot thundering-herd a learner.
+    Default is deterministic (no jitter): fleet tests assert the schedule,
+    and the handful of gathers per host cannot thundering-herd a learner.
+
+    ``jitter=True`` opts into DECORRELATED jitter for paths where many
+    peers share one failure clock — a dead serving replica puts every
+    router probe and every fallen-back client on the same schedule, and
+    synchronized redials arrive as a reconnect storm.  The draw is uniform
+    in ``[base, min(cap, 3 * prev)]`` where ``prev`` is the deterministic
+    delay of the previous attempt (the stateless rendering of the classic
+    decorrelated-jitter recurrence ``sleep = rand(base, 3 * sleep_prev)``),
+    so delays stay capped and attempt-ordered in expectation while peers
+    spread out.  ``rng`` (anything with ``.uniform``) pins the stream for
+    deterministic tests; default is the process-global ``random``.
     """
     if base <= 0:
         return 0.0
-    return min(cap, base * (2.0 ** max(attempt, 0)))
+    if not jitter:
+        return min(cap, base * (2.0 ** max(attempt, 0)))
+    prev = min(cap, base * (2.0 ** max(attempt - 1, 0)))
+    hi = max(min(cap, 3.0 * prev), base)
+    return (rng if rng is not None else _random).uniform(base, hi)
 
 
 class LivenessTracker:

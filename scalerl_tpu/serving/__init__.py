@@ -5,8 +5,12 @@ workers streaming observations to it over the codec-v2 fleet transport
 (:class:`RemotePolicyClient`), dynamic batching with bucketed static shapes
 (:class:`DynamicBatcher`), bounded admission with explicit load shedding,
 and generation-tagged parameters feeding V-trace's behavior-policy
-correction and a staleness gauge.  docs/DISTRIBUTED.md "Centralized
-inference plane" has the wire shape, knob tables, and the SLO row.
+correction and a staleness gauge.  The SLO-aware front door
+(:class:`ServingRouter`) fans that wire over N replicas with circuit-
+breaker health tracking, prefix-affinity + power-of-two-choices routing,
+at-least-once re-dispatch, and rolling weight rollout.  docs/DISTRIBUTED.md
+"Centralized inference plane" has the wire shape, knob tables, and the SLO
+row; §5 there covers the front door.
 """
 
 from scalerl_tpu.serving.batcher import (
@@ -20,6 +24,14 @@ from scalerl_tpu.serving.client import (
     PendingReply,
     RemotePolicyClient,
     ServingUnavailable,
+)
+from scalerl_tpu.serving.router import (
+    ReplicaHandle,
+    ReplicaHealth,
+    RouterConfig,
+    RouterTierExecutor,
+    ServingRouter,
+    connect_replica,
 )
 from scalerl_tpu.serving.server import InferenceServer
 
@@ -46,10 +58,16 @@ __all__ = [
     "InferenceServer",
     "PendingReply",
     "RemotePolicyClient",
+    "ReplicaHandle",
+    "ReplicaHealth",
+    "RouterConfig",
+    "RouterTierExecutor",
     "ServingConfig",
     "ServingRequest",
+    "ServingRouter",
     "ServingUnavailable",
     "bucket_for",
+    "connect_replica",
     "default_buckets",
     "local_pair",
 ]

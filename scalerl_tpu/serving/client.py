@@ -21,7 +21,11 @@ Robustness contract (rides PR 2's vocabulary):
 - **local fallback**: when the reconnect budget is exhausted (or the
   server sheds under load and a fallback policy was provided), the client
   flips to local inference instead of stalling the env loop — the worker
-  degrades to the pre-serving topology, it does not die.
+  degrades to the pre-serving topology, it does not die;
+- **capped-backoff re-probe out of degraded mode**: a fallen-back client
+  periodically redials (one cheap connect attempt per window, never a
+  blocking loop) so a recovered or router-re-admitted server gets its
+  clients back — degraded mode is a state, not a one-way door.
 
 Every reply carries the parameter ``generation`` that served it; the
 client exposes the newest one (``.generation``) so the trainer can record
@@ -107,11 +111,23 @@ class RemotePolicyClient:
         reconnect_backoff_cap_s: float = 2.0,
         max_attempts: int = 8,
         dispatch_guard: Optional[Callable[[], Any]] = None,
+        reprobe_backoff_s: float = 0.5,
+        reprobe_backoff_cap_s: float = 30.0,
+        reprobe_jitter: bool = False,
+        reprobe_rng: Any = None,
     ) -> None:
         """``dispatch_guard``: context-manager factory entered around the
         LOCAL fallback policy's dispatch (the remote path never needs it);
         serving trainers pass their mesh guard so a degraded client cannot
-        interleave multi-device enqueues with the learner."""
+        interleave multi-device enqueues with the learner.
+
+        ``reprobe_backoff_s``/``reprobe_backoff_cap_s``: the capped
+        schedule on which a fallen-back client redials the server
+        (``reprobe_backoff_s <= 0`` disables re-probing — the pre-fix
+        latch).  ``reprobe_jitter`` opts the schedule into decorrelated
+        jitter (``exp_backoff``) so a whole fleet of degraded clients does
+        not redial a recovering server in one synchronized storm; default
+        off for determinism-pinned tests, ``reprobe_rng`` pins the draw."""
         if conn is None and connect is None:
             raise ValueError("need a connection or a connect factory")
         self._connect = connect
@@ -122,6 +138,12 @@ class RemotePolicyClient:
         self.reconnect_backoff_s = reconnect_backoff_s
         self.reconnect_backoff_cap_s = reconnect_backoff_cap_s
         self.max_attempts = max_attempts
+        self.reprobe_backoff_s = reprobe_backoff_s
+        self.reprobe_backoff_cap_s = reprobe_backoff_cap_s
+        self.reprobe_jitter = reprobe_jitter
+        self._reprobe_rng = reprobe_rng
+        self.reprobes_used = 0
+        self._next_probe_t = 0.0
         self.reconnects_used = 0
         self.fallen_back = False
         self.generation = 0  # newest param generation seen in a reply
@@ -236,6 +258,7 @@ class RemotePolicyClient:
                     last = e
             if self._fallback is not None:
                 self.fallen_back = True
+                self._schedule_reprobe()
                 self._reg.counter("serving_client.fallbacks").inc()
                 telemetry.record_event("serving_fallback", why=repr(last))
                 logger.error(
@@ -247,6 +270,66 @@ class RemotePolicyClient:
                 f"inference server unreachable after "
                 f"{self.reconnects_used} reconnect attempts"
             ) from last
+
+    def _schedule_reprobe(self) -> None:
+        """Arm the next degraded-mode redial on the capped schedule."""
+        if self.reprobe_backoff_s <= 0 or self._connect is None:
+            self._next_probe_t = float("inf")
+            return
+        self._next_probe_t = time.monotonic() + exp_backoff(
+            self.reprobes_used,
+            self.reprobe_backoff_s,
+            self.reprobe_backoff_cap_s,
+            jitter=self.reprobe_jitter,
+            rng=self._reprobe_rng,
+        )
+
+    def _maybe_reprobe(self) -> bool:
+        """Fallen back + the probe window passed: ONE redial attempt (a
+        cheap connect, never a blocking retry loop — the env loop stays on
+        the local fallback until a probe lands).  Success re-arms the
+        remote path with a fresh reconnect budget; failure re-schedules on
+        the capped backoff.  Returns True when remote service resumed."""
+        if not self.fallen_back or self._connect is None:
+            return False
+        if self.reprobe_backoff_s <= 0:
+            return False
+        if time.monotonic() < self._next_probe_t:
+            return False
+        with self._link_lock:
+            if not self.fallen_back or self._closed.is_set():
+                return False
+            if time.monotonic() < self._next_probe_t:
+                return False  # another thread probed while we waited
+            self.reprobes_used += 1
+            self._reg.counter("serving_client.reprobes").inc()
+            try:
+                conn = self._connect()
+            except (ConnectionError, OSError) as e:
+                self._schedule_reprobe()
+                telemetry.record_event(
+                    "serving_reprobe", ok=False,
+                    attempt=self.reprobes_used, why=repr(e),
+                )
+                return False
+            try:
+                self._conn.close()
+            except Exception:  # noqa: BLE001 — old link already dead
+                pass
+            self._conn = conn
+            self._link_epoch += 1
+            self._reader = self._start_reader()
+            self.fallen_back = False
+            self.reconnects_used = 0  # recovered link earns a fresh budget
+            self._next_probe_t = 0.0
+        telemetry.record_event(
+            "serving_reprobe", ok=True, attempt=self.reprobes_used
+        )
+        logger.info(
+            "serving client: re-probe succeeded after %d attempt(s); "
+            "resuming REMOTE inference", self.reprobes_used,
+        )
+        return True
 
     # -- request plumbing ----------------------------------------------
     def _submit(self, msg: Dict[str, Any]) -> PendingReply:
@@ -311,6 +394,8 @@ class RemotePolicyClient:
 
     # -- the acting facade ---------------------------------------------
     def initial_state(self, batch_size: int):
+        if self.fallen_back:
+            self._maybe_reprobe()
         if self.fallen_back and self._fallback is not None:
             return self._fallback.initial_state(batch_size)
         try:
@@ -345,6 +430,10 @@ class RemotePolicyClient:
     def act(self, obs, last_action, reward, done, core_state):
         """Central batched inference with the local facade's signature:
         returns ``(action, logits, new_core)`` as host numpy."""
+        if self.fallen_back:
+            # degraded mode is not a one-way door: past the probe window,
+            # one cheap redial per act decides whether remote resumes
+            self._maybe_reprobe()
         if not self.fallen_back:
             self._reg.counter("serving_client.requests").inc()
             # head-sampled request trace: the context rides the act frame
@@ -361,7 +450,11 @@ class RemotePolicyClient:
                     raise
                 reply = {"use_fallback": True}
             if not reply.get("use_fallback"):
-                self.generation = int(reply.get("gen", self.generation))
+                # max-fold: mid-rollout a multi-replica front door serves
+                # mixed generations; the client-observed one stays monotonic
+                self.generation = max(
+                    self.generation, int(reply.get("gen", self.generation))
+                )
                 span.end(gen=self.generation)
                 return (
                     np.asarray(reply["action"]),

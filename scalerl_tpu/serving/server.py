@@ -337,8 +337,42 @@ class InferenceServer(ParamSnapshotPlane):
             self.hub.send(
                 conn, {"kind": "core_init", "req": msg.get("req"), "core": core}
             )
+        elif kind == "health":
+            # the router's health poll: SLO quantiles + queue/shed state off
+            # instruments that already exist — no device traffic, safe at
+            # any load (docs/DISTRIBUTED.md §5 state machine)
+            self.hub.send(conn, self._health_reply(msg))
+        elif kind == "router_hello":
+            # front-door membership announce: ack with identity/generation
+            # so the router pins both before the first act lands
+            logger.info("serving: router membership announce (%r)",
+                        msg.get("req"))
+            self.hub.send(
+                conn,
+                {
+                    "kind": "router_hello",
+                    "req": msg.get("req"),
+                    "gen": self.generation,
+                    "host": telemetry.host_id(),
+                },
+            )
         else:
             logger.warning("serving: unknown message kind %r", kind)
+
+    def _health_reply(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        s = self.slo()
+        q = self.batcher.stats()
+        return {
+            "kind": "health_result",
+            "req": msg.get("req"),
+            "gen": self.generation,
+            "host": telemetry.host_id(),
+            "p50_ms": s["p50_ms"],
+            "p95_ms": s["p95_ms"],
+            "requests": s["requests"],
+            "pending": q["pending_requests"],
+            "shed_total": q["shed_total"] + self.hub.shed_total,
+        }
 
     # -- the flush hot loop --------------------------------------------
     def _flush_loop(self) -> None:

@@ -136,6 +136,89 @@ def test_serving_slo_breach_scales_down():
     assert d.action == HOLD
 
 
+def test_serving_tier_p95_over_threshold_adds_replica():
+    """The serving-TIER capacity rule (router replica fleet): p95 past the
+    up threshold means the tier is out of capacity — SCALE_UP, the
+    opposite verdict from the actor-fleet SLO guard above."""
+    a = _engine(serving_scale_up_p95_ms=50.0, serving_scale_down_p95_ms=5.0)
+    d = a.evaluate(
+        FleetSignals(live_workers=2, queue_occupancy=0.5, serving_p95_ms=80.0),
+        now=0.0,
+    )
+    assert d.action == SCALE_UP and d.reason == "tier_over_capacity"
+
+
+def test_serving_tier_sheds_scale_up_not_down():
+    """Router sheds are demand over the tier's capacity — a scale-UP
+    signal, where the actor table reads shed_delta as flooding."""
+    a = _engine(serving_scale_up_p95_ms=50.0, serving_scale_down_p95_ms=5.0)
+    d = a.evaluate(
+        FleetSignals(live_workers=2, queue_occupancy=0.5,
+                     serving_p95_ms=20.0, shed_delta=3.0),
+        now=0.0,
+    )
+    assert d.action == SCALE_UP and d.reason == "tier_over_capacity"
+
+
+def test_serving_tier_under_floor_drains_replica():
+    a = _engine(serving_scale_up_p95_ms=50.0, serving_scale_down_p95_ms=5.0)
+    d = a.evaluate(
+        FleetSignals(live_workers=4, queue_occupancy=0.5, serving_p95_ms=2.0),
+        now=0.0,
+    )
+    assert d.action == SCALE_DOWN and d.reason == "tier_over_provisioned"
+    # mid-band p95 (and a cold hist reading 0.0): hold
+    for p95 in (20.0, 0.0):
+        d = a.evaluate(
+            FleetSignals(live_workers=4, queue_occupancy=0.5,
+                         serving_p95_ms=p95),
+            now=100.0 + p95,
+        )
+        assert d.action == HOLD
+
+
+def test_serving_tier_bypasses_actor_occupancy_rules():
+    """With the tier rules armed, the actor decision table is off: a
+    queue occupancy that would flood-drain the actor fleet holds here —
+    occupancy measures the learner's rollout queue, not replica load."""
+    a = _engine(serving_scale_up_p95_ms=50.0, serving_scale_down_p95_ms=5.0)
+    d = a.evaluate(
+        FleetSignals(live_workers=2, queue_occupancy=0.95,
+                     serving_p95_ms=20.0),
+        now=0.0,
+    )
+    assert d.action == HOLD
+    d = a.evaluate(
+        FleetSignals(live_workers=2, queue_occupancy=0.05,
+                     serving_p95_ms=20.0),
+        now=1.0,
+    )
+    assert d.action == HOLD
+
+
+def test_serving_tier_config_validation_and_from_args():
+    from scalerl_tpu.config import RLArguments
+
+    # inverted band flaps between the two verdicts: rejected
+    with pytest.raises(ValueError):
+        AutoscalerConfig(serving_scale_up_p95_ms=10.0,
+                         serving_scale_down_p95_ms=20.0)
+    # tier rule and actor-fleet SLO guard are mutually exclusive — they
+    # read the same signal with opposite semantics
+    with pytest.raises(ValueError):
+        AutoscalerConfig(serving_scale_up_p95_ms=10.0,
+                         serving_p95_slo_ms=10.0)
+    args = RLArguments(autoscale_serving_up_p95_ms=40.0,
+                       autoscale_serving_down_p95_ms=4.0)
+    args.validate()
+    cfg = AutoscalerConfig.from_args(args)
+    assert cfg.serving_scale_up_p95_ms == 40.0
+    assert cfg.serving_scale_down_p95_ms == 4.0
+    with pytest.raises(ValueError):
+        RLArguments(autoscale_serving_up_p95_ms=5.0,
+                    autoscale_serving_down_p95_ms=6.0).validate()
+
+
 def test_jittered_signals_never_act():
     """Hysteresis holds under jitter: pressure that never persists two
     consecutive evaluations (heartbeat noise, one spiky queue sample) must

@@ -727,6 +727,75 @@ def test_jg002_serving_unguarded_flush_dispatch_flags():
     assert "_dispatch_guard" in findings[0].hint
 
 
+# router front-door fixtures (ISSUE 17): the ServingRouter's dispatch loop
+# is jax-FREE by contract — it runs wherever the clients are and forwards
+# frames between hubs; any device touch in its per-request path is a
+# regression the serving package's HOT rules must catch
+
+ROUTER = "scalerl_tpu/serving/router_fixture.py"
+
+GOOD_ROUTER_DISPATCH_LOOP = """
+    import zlib
+
+    def dispatch_loop(hub, route, pending):
+        while True:
+            conn, msg = hub.recv(timeout=0.2)
+            key = zlib.crc32(msg["obs"].tobytes()[:64])  # host-side hash
+            replica = route(key)
+            fwd = dict(msg)                # pure frame forwarding: no
+            replica.send(fwd)              # device work in the router
+            pending[fwd["req"]] = conn
+"""
+
+BAD_ROUTER_PER_REQUEST_DEVICE_READ = """
+    import jax
+    import jax.numpy as jnp
+
+    def dispatch_loop(hub, route, pending):
+        while True:
+            conn, msg = hub.recv(timeout=0.2)
+            # a device round-trip per routed request: the router just
+            # became a transfer storm in front of every replica
+            score = float(jnp.sum(jnp.asarray(msg["obs"])))
+            replica = route(score)
+            replica.send(dict(msg))
+"""
+
+BAD_ROUTER_UNGUARDED_REPLY_DISPATCH = """
+    import threading
+    import jax
+
+    class Router:
+        def __init__(self, mesh):
+            self._rank = jax.jit(lambda x: x)
+            self.mesh = mesh
+
+        def _on_reply(self, replica, msg):
+            return self._rank(msg["logits"])  # races the learner's enqueues
+"""
+
+
+def test_jg001_router_dispatch_loop_host_only_is_clean():
+    """The real router's shape — recv, crc32 affinity hash, forward —
+    touches no device and lints clean in the HOT serving package."""
+    assert lint(GOOD_ROUTER_DISPATCH_LOOP, relpath=ROUTER) == []
+
+
+def test_jg001_router_per_request_device_read_flags():
+    """A jax-free plane is one import away from not being: a per-request
+    device read in the dispatch loop is JG001 in the serving package."""
+    findings = lint(BAD_ROUTER_PER_REQUEST_DEVICE_READ, relpath=ROUTER)
+    assert "JG001" in rules_of(findings)
+
+
+def test_jg002_router_jitted_reply_path_without_guard_flags():
+    """A jitted call on the router's threaded reply path in a meshed
+    module without the dispatch guard is the same enqueue-order deadlock
+    class JG002 pins on the server's flush thread."""
+    findings = lint(BAD_ROUTER_UNGUARDED_REPLY_DISPATCH, relpath=ROUTER)
+    assert rules_of(findings) == ["JG002"]
+
+
 # ---------------------------------------------------------------------------
 # genrl plane fixtures (ISSUE 10): scalerl_tpu/genrl is a HOT package — the
 # generation engine's decode loop is ONE jitted program dispatched once per

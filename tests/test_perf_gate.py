@@ -220,6 +220,40 @@ def test_serving_bench_artifact_schema(capsys, monkeypatch):
     assert result["flushes"] > 0
 
 
+def test_traffic_bench_artifact_schema(capsys, monkeypatch):
+    """bench --mode traffic artifacts carry the goodput-under-SLO verdict
+    line the gate reads: metric + mode for like-for-like history, the SLO
+    quantiles, and the router's exact-accounting verdict
+    (accounting_balanced — the chaos e2e's equation, re-checked on every
+    bench round).  In-process at a shrunken window, like the serving twin."""
+    import importlib.util
+
+    monkeypatch.setenv("BENCH_TRAFFIC_TARGET_S", "1.0")
+    monkeypatch.setenv("BENCH_TRAFFIC_REPLICAS", "2")
+    monkeypatch.setenv("BENCH_TRAFFIC_CLIENTS", "2")
+    monkeypatch.setenv("BENCH_TRAFFIC_RPS", "30")
+    spec = importlib.util.spec_from_file_location(
+        "bench_traffic_mod", REPO / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    bench._run_traffic_measurement()
+    lines = [
+        l for l in capsys.readouterr().out.splitlines()
+        if l.strip().startswith("{") and l.strip().endswith("}")
+    ]
+    result = json.loads(lines[-1])
+    assert result["metric"] == "traffic_goodput_rps"
+    assert result["mode"] == "traffic"
+    assert result["value"] > 0
+    assert result["offered_rps"] >= result["value"]
+    assert result["answered"] >= result["good"] > 0
+    assert result["p99_ms"] >= result["p95_ms"] >= result["p50_ms"] > 0
+    assert result["slo_ms"] > 0
+    assert result["accounting_balanced"] is True
+    assert result["n_replicas"] == 2
+
+
 def test_genrl_bench_artifact_schema(capsys, monkeypatch):
     """bench --mode genrl artifacts carry the three headline numbers
     (prefill/decode tokens/s + learn steps/s) and the like-for-like gate

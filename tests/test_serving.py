@@ -440,6 +440,71 @@ def test_client_reconnects_over_sockets():
         server.stop()
 
 
+def test_fallen_back_client_reprobes_recovered_server():
+    """The degraded-mode latch is gone: kill the server, the client falls
+    back to its local stub; restart a server on the same port and the
+    client's capped-backoff re-probe redials it — remote serving resumes
+    (real actions again, ``fallen_back`` cleared) with no operator help."""
+    import socket as socket_mod
+
+    from scalerl_tpu.fleet.transport import connect_socket
+
+    def _free_port():
+        s = socket_mod.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    port = _free_port()
+    agent = _agent()
+    server = InferenceServer(agent, ServingConfig(max_batch=8, max_wait_s=0.002))
+    server.start(listen_port=port)
+    client = RemotePolicyClient(
+        connect=lambda: connect_socket("127.0.0.1", port, retries=2),
+        fallback=_StubFallback(),
+        request_timeout_s=2.0,
+        max_attempts=2,
+        max_reconnects=1,
+        reconnect_backoff_s=0.01,
+        reconnect_backoff_cap_s=0.02,
+        reprobe_backoff_s=0.05,
+        reprobe_backoff_cap_s=0.2,
+    )
+    p = _act_payload()
+    try:
+        client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+        assert not client.fallen_back
+        server.stop()  # the whole server dies: accept loop AND links
+        deadline = time.monotonic() + 10.0
+        while not client.fallen_back and time.monotonic() < deadline:
+            client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+        assert client.fallen_back  # degraded: stub actions (all 9s)
+        action, _, _ = client.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        np.testing.assert_array_equal(action, np.full(2, 9, np.int32))
+        # the replica comes back on the same address
+        server = InferenceServer(
+            agent, ServingConfig(max_batch=8, max_wait_s=0.002)
+        )
+        server.start(listen_port=port)
+        deadline = time.monotonic() + 10.0
+        while client.fallen_back and time.monotonic() < deadline:
+            client.act(p["obs"], p["last_action"], p["reward"], p["done"], ())
+            time.sleep(0.02)
+        assert not client.fallen_back, "re-probe never re-attached the client"
+        assert client.reprobes_used >= 1
+        action, _, _ = client.act(
+            p["obs"], p["last_action"], p["reward"], p["done"], ()
+        )
+        # real agent again, not the stub: actions live in [0, num_actions)
+        assert np.all(action < 2)
+    finally:
+        client.close()
+        server.stop()
+
+
 # ---------------------------------------------------------------------------
 # serving-mode IMPALA trainer (the acceptance e2e)
 

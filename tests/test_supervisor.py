@@ -35,6 +35,38 @@ def test_exp_backoff_capped_schedule():
     assert exp_backoff(3, base=0.0, cap=10.0) == 0.0
 
 
+def test_exp_backoff_decorrelated_jitter_stays_in_band():
+    # every draw lands in [base, min(cap, 3 * prev)] — capped, never
+    # under base, growing with the attempt like the deterministic ladder
+    for attempt in range(10):
+        prev = min(10.0, 0.5 * 2.0 ** max(attempt - 1, 0))
+        hi = max(min(10.0, 3.0 * prev), 0.5)
+        for _ in range(50):
+            d = exp_backoff(attempt, base=0.5, cap=10.0, jitter=True)
+            assert 0.5 <= d <= hi
+    assert exp_backoff(3, base=0.0, cap=10.0, jitter=True) == 0.0
+
+
+def test_exp_backoff_jitter_rng_injection_is_deterministic():
+    class Rng:
+        def __init__(self):
+            self.calls = []
+
+        def uniform(self, lo, hi):
+            self.calls.append((lo, hi))
+            return lo
+
+    rng = Rng()
+    assert exp_backoff(0, base=1.0, cap=8.0, jitter=True, rng=rng) == 1.0
+    # attempt 0: prev is the base itself -> band [1, 3]
+    assert rng.calls == [(1.0, 3.0)]
+    # attempt 4: prev = 8 (capped) -> band [1, 8] (3*prev re-capped)
+    exp_backoff(4, base=1.0, cap=8.0, jitter=True, rng=rng)
+    assert rng.calls[-1] == (1.0, 8.0)
+    # default path is untouched by the jitter flag's existence
+    assert exp_backoff(2, base=1.0, cap=8.0) == 4.0
+
+
 def test_checkpoint_cadence_frames_and_wallclock():
     c = CheckpointCadence(frames=100, interval_s=0.0, start_frames=0)
     assert not c.due(99)

@@ -360,6 +360,7 @@ def _perf_gate_marker(bl, start_offset: int) -> str:
             "impala_atari_env_frames_per_sec_per_chip",
             "sharded_train_step_frames_per_sec",
             "serving_requests_per_sec",
+            "traffic_goodput_rps",
             "genrl_decode_tokens_per_sec_per_chip",
             "disagg_sequences_per_sec",
         }
@@ -523,6 +524,13 @@ def run_payload(n_devices: int = 1) -> None:
         # (p50/p95/p99) and batch occupancy; perf-gated like-for-like
         # against serving-mode history exactly like the other bench steps
         ("bench-serving", [sys.executable, "bench.py", "--mode", "serving"],
+         1500, dict(env, BENCH_SKIP_MICRO="1")),
+        # serving front door: open-loop (Poisson + bursty) traffic through
+        # the multi-replica router — goodput under the latency SLO
+        # (traffic_goodput_rps), perf-gated like-for-like against
+        # traffic-mode history; the artifact also carries the exact-
+        # accounting verdict (accounting_balanced) from the router ledger
+        ("bench-traffic", [sys.executable, "bench.py", "--mode", "traffic"],
          1500, dict(env, BENCH_SKIP_MICRO="1")),
         # token-level sequence-RL plane: prefill/decode tokens/s/chip
         # through the KV-cached generation engine + token-PPO learn
