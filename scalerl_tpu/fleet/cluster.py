@@ -73,6 +73,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Set, Tuple
 
+from scalerl_tpu.fleet.framing import ProtocolError
 from scalerl_tpu.fleet.hub import QueueHub
 from scalerl_tpu.fleet.transport import (
     Connection,
@@ -1006,6 +1007,11 @@ class WorkerServer:
                 continue
             try:
                 msg = conn.recv(timeout=10.0)
+                if not isinstance(msg, dict) or msg.get("kind") != "entry":
+                    raise ProtocolError(
+                        f"entry port expects an 'entry' frame, got "
+                        f"{msg.get('kind') if isinstance(msg, dict) else type(msg).__name__!r}"
+                    )
                 n = int(msg["num_workers"])
                 base = self.assign_worker_ids(n)
                 conn.send(
@@ -1447,6 +1453,11 @@ class RemoteCluster:
             ack = send_recv(
                 conn, {"kind": "entry", "num_workers": self.num_workers, "host": ""}
             )
+            if not isinstance(ack, dict) or ack.get("kind") != "entry_ack":
+                raise ProtocolError(
+                    f"entry handshake expects an 'entry_ack' reply, got "
+                    f"{ack.get('kind') if isinstance(ack, dict) else type(ack).__name__!r}"
+                )
             return int(ack["base_worker_id"]), ack["config"]
         finally:
             conn.close()

@@ -366,6 +366,9 @@ class Tracer:
             self.out_dir = ""
             return False
 
+    # "meta"/"skew" are span-file record kinds consumed offline by
+    # tools/trace_report.py, not codec-v2 wire frames — no recv pump ever
+    # dispatches on them.  # graftlint: wire-ignore=meta,skew
     def _sink_write(self, obj: Dict[str, Any]) -> None:
         # called under self._lock.  Line-per-record append on a
         # line-buffered file: a SIGTERM'd host (no atexit) loses at most

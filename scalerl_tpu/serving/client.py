@@ -385,6 +385,14 @@ class RemotePolicyClient:
             if "error" in reply:
                 self._reg.counter("serving_client.errors").inc()
                 raise RuntimeError(f"serving error: {reply['error']}")
+            # the req-id demux matched, but verify the frame kind too: a
+            # stale or mis-routed reply must not be parsed as a result.
+            # "act" requests come back as "act_result"; every other RPC
+            # echoes its request kind on the reply
+            got = reply.get("kind")
+            if got is not None and got not in ("act_result", msg.get("kind")):
+                self._reg.counter("serving_client.kind_mismatch").inc()
+                continue
             return reply
         if self._fallback is not None:
             return {"use_fallback": True}

@@ -1170,3 +1170,424 @@ def test_jg001_packing_per_sequence_length_read_flags():
     findings = lint(BAD_PACKING_PER_SEQUENCE_LENGTH_READ, relpath=GENRL)
     assert rules_of(findings) == ["JG001"]
     assert "device_get" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# v2 whole-program rules (JG006-JG009): seeded-drift fixture pairs.
+# These need the two-phase entry point — per-file lint_source never joins.
+
+from tools.graftlint.engine import lint_sources  # noqa: E402
+
+FLEET = "scalerl_tpu/fleet/fixture_hub.py"
+SERVING = "scalerl_tpu/serving/fixture_router.py"
+
+
+def lint_many(items, catalog=None):
+    """Two-phase lint over [(relpath, src), ...] as a complete program."""
+    return lint_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in items],
+        catalog_text=textwrap.dedent(catalog) if catalog else None,
+        complete=True,
+    )
+
+
+# -- JG006 — lock-order inversion -------------------------------------------
+
+BAD_JG006_HUB = """
+    import threading
+
+    class Hub:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.router = None
+            self.items = []
+
+        def publish(self, item):
+            with self._lock:           # holds Hub._lock ...
+                self.router.route(item)  # ... then takes Router._lock
+
+        def push(self, item):
+            with self._lock:
+                self.items.append(item)
+"""
+
+BAD_JG006_ROUTER = """
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.hub = None
+            self.table = {}
+
+        def route(self, item):
+            with self._lock:
+                self.table[item.key] = item
+
+        def flush(self):
+            with self._lock:           # holds Router._lock ...
+                self.hub.push(1)       # ... then takes Hub._lock: ABBA
+"""
+
+GOOD_JG006_ROUTER = """
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.hub = None
+            self.table = {}
+
+        def route(self, item):
+            with self._lock:
+                self.table[item.key] = item
+
+        def flush(self):
+            with self._lock:
+                drained = list(self.table.values())
+            self.hub.push(drained)     # cross-object call OUTSIDE the lock
+"""
+
+
+def test_jg006_cross_module_abba_cycle_flags():
+    findings = lint_many([(FLEET, BAD_JG006_HUB), (SERVING, BAD_JG006_ROUTER)])
+    assert rules_of(findings) == ["JG006"]
+    assert "Hub._lock" in findings[0].message
+    assert "Router._lock" in findings[0].message
+
+
+def test_jg006_call_outside_lock_is_clean():
+    findings = lint_many([(FLEET, BAD_JG006_HUB), (SERVING, GOOD_JG006_ROUTER)])
+    assert findings == []
+
+
+# -- JG007 — wire-kind exhaustiveness ---------------------------------------
+
+SEND_HELLO = """
+    HELLO = "hello"
+
+    def announce(conn, n):
+        conn.send({"kind": HELLO, "workers": n})
+"""
+
+HANDLE_HELLO = """
+    def pump(conn):
+        while True:
+            msg = conn.recv()
+            kind = msg.get("kind")
+            if kind == "hello":
+                register(msg)
+"""
+
+HANDLE_NOTHING = """
+    def pump(conn):
+        while True:
+            msg = conn.recv()
+            store(msg)
+"""
+
+HANDLE_DEAD_KIND = """
+    def pump(conn):
+        while True:
+            msg = conn.recv()
+            if msg["kind"] in ("hello", "goodbye"):
+                register(msg)
+"""
+
+
+def test_jg007_kind_sent_in_fleet_handled_in_serving_is_clean():
+    # the issue's named join unit: sent in fleet/, dispatched in serving/
+    findings = lint_many([(FLEET, SEND_HELLO), (SERVING, HANDLE_HELLO)])
+    assert findings == []
+
+
+def test_jg007_unhandled_kind_flags_at_send_site():
+    findings = lint_many([(FLEET, SEND_HELLO), (SERVING, HANDLE_NOTHING)])
+    assert rules_of(findings) == ["JG007"]
+    assert findings[0].file == FLEET
+    assert "'hello'" in findings[0].message and "sent" in findings[0].message
+
+
+def test_jg007_dead_kind_flags_at_dispatch_site():
+    findings = lint_many([(FLEET, SEND_HELLO), (SERVING, HANDLE_DEAD_KIND)])
+    assert rules_of(findings) == ["JG007"]
+    assert findings[0].file == SERVING
+    assert "'goodbye'" in findings[0].message and "never sent" in findings[0].message
+
+
+def test_jg007_wire_ignore_directive_clears_both_directions():
+    ignored = SEND_HELLO + "\n    # graftlint: wire-ignore=hello, goodbye\n"
+    findings = lint_many([(FLEET, ignored), (SERVING, HANDLE_DEAD_KIND)])
+    assert [f for f in findings if f.rule == "JG007"] == []
+
+
+def test_jg007_incomplete_program_never_joins():
+    # linting one file in isolation must not flag its peers' kinds
+    findings = lint_sources(
+        [(FLEET, textwrap.dedent(SEND_HELLO))], complete=False
+    )
+    assert findings == []
+
+
+# -- JG008 — thread / allocator / span lifecycle ----------------------------
+
+BAD_JG008_THREAD = """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+"""
+
+GOOD_JG008_THREAD_DAEMON = """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+"""
+
+GOOD_JG008_THREAD_JOINED = """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def stop(self):
+            self._t.join(timeout=5.0)
+"""
+
+BAD_JG008_ALLOC_TRY_LEAK = """
+    class Lane:
+        def admit(self, n):
+            try:
+                ok = self.allocator.try_reserve(n)
+                self.decode(n)
+            except ValueError:
+                pass                       # pages leak on this path
+            self.allocator.release(n)
+"""
+
+GOOD_JG008_ALLOC_FINALLY = """
+    class Lane:
+        def admit(self, n):
+            try:
+                ok = self.allocator.try_reserve(n)
+                self.decode(n)
+            finally:
+                self.allocator.release(n)
+"""
+
+BAD_JG008_ALLOC_NEVER_RELEASED = """
+    class Lane:
+        def admit(self, n):
+            pages = self.allocator.alloc(n, holder="lane")
+            self.pages = pages
+"""
+
+GOOD_JG008_ALLOC_CLASS_PAIRED = """
+    class Lane:
+        def admit(self, n):
+            self.pages = self.allocator.alloc(n, holder="lane")
+
+        def retire(self):
+            self.allocator.free(self.pages, holder="lane")
+"""
+
+BAD_JG008_SPAN_DROPPED = """
+    from scalerl_tpu.runtime import tracing
+
+    def step(self):
+        span = tracing.start_span("engine.step", kind="genrl")
+        self.n += 1
+"""
+
+GOOD_JG008_SPAN_ENDED = """
+    from scalerl_tpu.runtime import tracing
+
+    def step(self):
+        span = tracing.start_span("engine.step", kind="genrl")
+        self.n += 1
+        span.end(ok=True)
+"""
+
+GOOD_JG008_SPAN_ESCAPES = """
+    from scalerl_tpu.runtime import tracing
+
+    def begin(self, key):
+        span = tracing.start_span("round", kind="genrl")
+        self._open[key] = span          # handed off; ended elsewhere
+"""
+
+
+def test_jg008_non_daemon_thread_without_join_flags():
+    findings = lint_many([("scalerl_tpu/runtime/fixture.py", BAD_JG008_THREAD)])
+    assert rules_of(findings) == ["JG008"]
+    assert "non-daemon" in findings[0].message
+
+
+def test_jg008_daemon_or_joined_threads_are_clean():
+    for src in (GOOD_JG008_THREAD_DAEMON, GOOD_JG008_THREAD_JOINED):
+        assert lint_many([("scalerl_tpu/runtime/fixture.py", src)]) == []
+
+
+def test_jg008_thread_rule_is_hot_dir_scoped():
+    # models/ is not a hot dir: one-shot scripts there may block on exit
+    assert lint_many([("scalerl_tpu/models/fixture.py", BAD_JG008_THREAD)]) == []
+
+
+def test_jg008_alloc_acquire_in_try_without_exception_release_flags():
+    findings = lint_many([("scalerl_tpu/genrl/fixture.py", BAD_JG008_ALLOC_TRY_LEAK)])
+    assert rules_of(findings) == ["JG008"]
+    assert "exception path" in findings[0].message
+
+
+def test_jg008_alloc_release_in_finally_is_clean():
+    assert lint_many([("scalerl_tpu/genrl/fixture.py", GOOD_JG008_ALLOC_FINALLY)]) == []
+
+
+def test_jg008_alloc_never_released_flags_class_level():
+    findings = lint_many(
+        [("scalerl_tpu/genrl/fixture.py", BAD_JG008_ALLOC_NEVER_RELEASED)]
+    )
+    assert rules_of(findings) == ["JG008"]
+    assert "never releases" in findings[0].message
+
+
+def test_jg008_alloc_pairing_is_class_level_across_methods():
+    # acquire in admit(), release in retire() — the continuous-engine shape
+    assert lint_many(
+        [("scalerl_tpu/genrl/fixture.py", GOOD_JG008_ALLOC_CLASS_PAIRED)]
+    ) == []
+
+
+def test_jg008_dropped_span_flags():
+    findings = lint_many([("scalerl_tpu/genrl/fixture.py", BAD_JG008_SPAN_DROPPED)])
+    assert rules_of(findings) == ["JG008"]
+    assert "span" in findings[0].message
+
+
+def test_jg008_ended_or_escaping_span_is_clean():
+    for src in (GOOD_JG008_SPAN_ENDED, GOOD_JG008_SPAN_ESCAPES):
+        assert lint_many([("scalerl_tpu/genrl/fixture.py", src)]) == []
+
+
+# -- JG009 — telemetry-catalog drift ----------------------------------------
+
+CATALOG = """
+    ### Instrument catalog
+
+    | name | kind | source |
+    |---|---|---|
+    | `pump.frames` / `drops` | counter | pump accounting |
+    | `chaos.<fault_kind>` | counter | injected faults |
+    | `router` | bind | router stats snapshot |
+"""
+
+CATALOG_WITH_STALE_ROW = CATALOG + """\
+    | `ghost.counter` | counter | removed two PRs ago |
+"""
+
+GOOD_JG009_DOCUMENTED = """
+    def wire(reg, kind):
+        reg.counter("pump.frames")
+        reg.counter("pump.drops")        # slash row, prefix propagated
+        reg.counter(f"chaos.{kind}")     # wildcard row covers the family
+        reg.bind("router", lambda: {})
+"""
+
+BAD_JG009_UNDOCUMENTED = GOOD_JG009_DOCUMENTED + """\
+        reg.counter("pump.mystery")      # not in the catalog
+"""
+
+
+def test_jg009_documented_instruments_are_clean():
+    findings = lint_many(
+        [("scalerl_tpu/runtime/fixture.py", GOOD_JG009_DOCUMENTED)],
+        catalog=CATALOG,
+    )
+    assert findings == []
+
+
+def test_jg009_undocumented_instrument_flags():
+    findings = lint_many(
+        [("scalerl_tpu/runtime/fixture.py", BAD_JG009_UNDOCUMENTED)],
+        catalog=CATALOG,
+    )
+    assert rules_of(findings) == ["JG009"]
+    assert "pump.mystery" in findings[0].message
+
+
+def test_jg009_stale_catalog_row_flags_in_the_doc():
+    findings = lint_many(
+        [("scalerl_tpu/runtime/fixture.py", GOOD_JG009_DOCUMENTED)],
+        catalog=CATALOG_WITH_STALE_ROW,
+    )
+    assert rules_of(findings) == ["JG009"]
+    assert findings[0].file == "docs/OBSERVABILITY.md"
+    assert "ghost.counter" in findings[0].message
+
+
+def test_jg009_non_registry_receivers_are_ignored():
+    src = """
+        def other(watchdog, sock):
+            watchdog.counter("learn_steps")   # StallWatchdog, not a registry
+            sock.bind(("0.0.0.0", 0))          # socket, not a registry
+    """
+    # complete=False: only the code->doc direction runs, which is the one
+    # that would misfire if the receiver filter let these through
+    findings = lint_sources(
+        [("scalerl_tpu/runtime/fixture.py", textwrap.dedent(src))],
+        catalog_text=textwrap.dedent(CATALOG),
+        complete=False,
+    )
+    assert findings == []
+
+
+# -- cross-file suppressions and machine-readable output --------------------
+
+
+def test_xrule_findings_honor_inline_suppression_at_anchor():
+    suppressed = SEND_HELLO.replace(
+        'conn.send({"kind": HELLO, "workers": n})',
+        'conn.send({"kind": HELLO, "workers": n})  # graftlint: disable=JG007',
+    )
+    findings = lint_many([(FLEET, suppressed), (SERVING, HANDLE_NOTHING)])
+    assert findings == []
+
+
+def test_cli_json_format_and_stats(tmp_path, capsys):
+    from tools.graftlint.__main__ import main
+
+    out = tmp_path / "findings.json"
+    code = main(
+        [
+            str(REPO_ROOT / "tools" / "graftlint" / "engine.py"),
+            "--no-baseline",
+            "--format",
+            "json",
+            "--stats",
+            "--json-out",
+            str(out),
+        ]
+    )
+    assert code == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["findings"] == []
+    assert payload["summary"]["new"] == 0
+    assert payload["stats"]["files"] == 1.0
+    artifact = json.loads(out.read_text())
+    assert artifact["summary"] == payload["summary"]
+
+
+def test_cli_list_rules_includes_v2(capsys):
+    from tools.graftlint.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule_id in ("JG001", "JG006", "JG007", "JG008", "JG009"):
+        assert rule_id in listed

@@ -6,16 +6,25 @@ runs the same engine in-process so a hot-path host sync (JG001), an
 unguarded mesh dispatch (JG002), a retrace hazard (JG003), a tracer leak
 (JG004), or a use-after-donation (JG005) introduced by any later PR fails
 the fast suite with the offending ``file:line`` in the assertion message.
+
+v2 extends the gate to the whole-program rules: the same ``gate()`` call
+now runs the two-phase analyzer, so a lock-order inversion (JG006), an
+unhandled wire kind (JG007), a leaked thread/page/span (JG008), or
+telemetry-catalog drift (JG009) anywhere in ``scalerl_tpu/`` — including
+drift in docs/OBSERVABILITY.md itself — fails tier-1.  The bad-twin
+smokes below prove each v2 rule is actually armed in-process (a rule that
+silently stopped firing would otherwise look like a clean tree).
 """
 
 import sys
+import textwrap
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:  # `python -m pytest` from elsewhere
     sys.path.insert(0, str(REPO_ROOT))
 
-from tools.graftlint import DEFAULT_BASELINE, gate  # noqa: E402
+from tools.graftlint import DEFAULT_BASELINE, gate, lint_sources  # noqa: E402
 
 
 def test_graftlint_gate_scalerl_tpu_is_clean():
@@ -51,3 +60,113 @@ def test_baseline_file_is_checked_in_and_valid():
     data = json.loads(path.read_text())
     assert data["version"] == 1
     assert isinstance(data["entries"], dict)
+
+
+def test_baseline_is_empty():
+    # the v2 burn-down contract: real findings get FIXED, not baselined
+    import json
+
+    data = json.loads(Path(DEFAULT_BASELINE).read_text())
+    assert data["entries"] == {}, (
+        "baseline.json must stay empty — fix findings instead of absorbing "
+        "them: " + ", ".join(sorted(data["entries"]))
+    )
+
+
+def test_all_nine_rules_are_registered():
+    from tools.graftlint.rules import RULES
+    from tools.graftlint.xrules import XRULES
+
+    ids = [r[0] for r in RULES] + [r[0] for r in XRULES]
+    assert ids == [f"JG00{i}" for i in range(1, 10)]
+
+
+# -- v2 armed-rule smokes: one minimal bad twin per whole-program rule ------
+
+
+def _lint2(items, catalog=None):
+    return lint_sources(
+        [(rel, textwrap.dedent(src)) for rel, src in items],
+        catalog_text=textwrap.dedent(catalog) if catalog else None,
+        complete=True,
+    )
+
+
+def test_jg006_is_armed():
+    a = """
+        import threading
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def fwd(self):
+                with self._lock:
+                    self.b.absorb()
+            def enter(self):
+                with self._lock:
+                    pass
+    """
+    b = """
+        import threading
+        class B:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def absorb(self):
+                with self._lock:
+                    pass
+            def back(self):
+                with self._lock:
+                    self.a.fwd()
+    """
+    findings = _lint2(
+        [("scalerl_tpu/fleet/a.py", a), ("scalerl_tpu/serving/b.py", b)]
+    )
+    assert [f.rule for f in findings] == ["JG006"]
+
+
+def test_jg007_is_armed():
+    send = """
+        def announce(conn):
+            conn.send({"kind": "orphan_kind", "x": 1})
+    """
+    pump = """
+        def pump(conn):
+            msg = conn.recv()
+            if msg.get("kind") == "other":
+                pass
+            conn.send({"kind": "other"})
+    """
+    findings = _lint2(
+        [("scalerl_tpu/fleet/s.py", send), ("scalerl_tpu/serving/p.py", pump)]
+    )
+    assert [f.rule for f in findings] == ["JG007"]
+    assert "orphan_kind" in findings[0].message
+
+
+def test_jg008_is_armed():
+    src = """
+        import threading
+        def launch(run):
+            t = threading.Thread(target=run)
+            t.start()
+            return t
+    """
+    findings = _lint2([("scalerl_tpu/runtime/t.py", src)])
+    assert [f.rule for f in findings] == ["JG008"]
+
+
+def test_jg009_is_armed():
+    catalog = """
+        ### Instrument catalog
+
+        | name | kind | source |
+        |---|---|---|
+        | `known.counter` | counter | known |
+    """
+    src = """
+        def wire(reg):
+            reg.counter("known.counter")
+            reg.counter("unknown.counter")
+    """
+    findings = _lint2([("scalerl_tpu/runtime/m.py", src)], catalog=catalog)
+    assert [f.rule for f in findings] == ["JG009"]
+    assert "unknown.counter" in findings[0].message

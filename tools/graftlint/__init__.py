@@ -1,5 +1,7 @@
 """graftlint: jax-free static analyzer for this repo's dispatch/transfer
-discipline (rules JG001-JG005) plus the baseline/suppression gate.
+discipline (per-file rules JG001-JG005), the whole-program host-plane
+rules (JG006-JG009: lock order, wire-kind exhaustiveness, thread/resource
+lifecycle, telemetry-catalog drift), and the baseline/suppression gate.
 
 Run: ``python -m tools.graftlint scalerl_tpu``
 Programmatic: :func:`gate` returns (all_findings, new_findings) — the
@@ -15,6 +17,7 @@ from tools.graftlint.engine import (
     Finding,
     lint_paths,
     lint_source,
+    lint_sources,
     load_baseline,
     partition_new,
     write_baseline,
@@ -50,6 +53,7 @@ __all__ = [
     "gate",
     "lint_paths",
     "lint_source",
+    "lint_sources",
     "load_baseline",
     "partition_new",
     "write_baseline",

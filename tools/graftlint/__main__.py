@@ -7,18 +7,36 @@ findings exist (the CI gate); exit 2 on usage errors.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
-from tools.graftlint import DEFAULT_BASELINE, gate, write_baseline
+from tools.graftlint import DEFAULT_BASELINE, write_baseline
 from tools.graftlint.engine import lint_paths, load_baseline, partition_new
 from tools.graftlint.rules import RULES
+from tools.graftlint.xrules import XRULES
+
+
+def _findings_payload(findings, new_keys):
+    return [
+        {
+            "file": f.file,
+            "line": f.line,
+            "rule": f.rule,
+            "message": f.message,
+            "hint": f.hint,
+            "snippet": f.snippet,
+            "new": id(f) in new_keys,
+        }
+        for f in findings
+    ]
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX dispatch/transfer static analyzer (rules JG001-JG005)",
+        description="static analyzer: per-file JAX dispatch/transfer rules "
+        "(JG001-JG005) plus whole-program host-plane rules (JG006-JG009)",
     )
     parser.add_argument("paths", nargs="*", default=["scalerl_tpu"],
                         help="files/packages to lint (default: scalerl_tpu)")
@@ -30,11 +48,18 @@ def main(argv=None) -> int:
                         help="accept all current findings into the baseline")
     parser.add_argument("--show-baselined", action="store_true",
                         help="also print findings the baseline absorbs")
+    parser.add_argument("--format", choices=("text", "json"), default="text",
+                        help="finding output format (default: text)")
+    parser.add_argument("--json-out", metavar="PATH", default=None,
+                        help="also write the JSON findings payload to PATH "
+                        "(the CI artifact), independent of --format")
+    parser.add_argument("--stats", action="store_true",
+                        help="print a per-stage wall-clock timing line")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for rule_id, title, fn in RULES:
+        for rule_id, title, fn in list(RULES) + list(XRULES):
             doc = (fn.__doc__ or "").strip().splitlines()
             print(f"{rule_id}  {title}" + (f" — {doc[0]}" if doc else ""))
         return 0
@@ -45,7 +70,8 @@ def main(argv=None) -> int:
         print(f"graftlint: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    findings = lint_paths(paths)
+    stats = {} if args.stats else None
+    findings = lint_paths(paths, stats_out=stats)
     if args.write_baseline:
         write_baseline(args.baseline, findings)
         print(
@@ -58,18 +84,43 @@ def main(argv=None) -> int:
         baseline = load_baseline(args.baseline)
     old, new = partition_new(findings, baseline)
 
-    shown = findings if args.no_baseline else new
-    if args.show_baselined and not args.no_baseline:
-        for f in old:
-            print(f"[baselined] {f.render()}")
-    for f in shown:
-        print(f.render())
-
     n_files = len({f.file for f in findings})
-    print(
-        f"graftlint: {len(findings)} finding(s) across {n_files} file(s): "
-        f"{len(old)} baselined, {len(new)} new"
-    )
+    shown = findings if args.no_baseline else new
+    payload = {
+        "findings": _findings_payload(findings, {id(f) for f in new}),
+        "summary": {
+            "total": len(findings),
+            "files_with_findings": n_files,
+            "baselined": len(old),
+            "new": len(new),
+        },
+    }
+    if stats is not None:
+        payload["stats"] = stats
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        if args.show_baselined and not args.no_baseline:
+            for f in old:
+                print(f"[baselined] {f.render()}")
+        for f in shown:
+            print(f.render())
+        print(
+            f"graftlint: {len(findings)} finding(s) across {n_files} file(s): "
+            f"{len(old)} baselined, {len(new)} new"
+        )
+        if stats is not None:
+            print(
+                "graftlint: stats: {files:.0f} files, parse {parse:.3f}s, "
+                "per-file rules {rules:.3f}s, fact harvest {facts:.3f}s, "
+                "cross-file join {join:.3f}s".format(**stats)
+            )
+
     if args.no_baseline:
         return 1 if findings else 0
     return 1 if new else 0

@@ -62,9 +62,28 @@ class ModuleContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source)
         self._parents: Dict[ast.AST, ast.AST] = {}
+        # cache the full node list while building the parent map: every rule
+        # iterates it via walk(), so the tree is traversed once per file
+        # instead of once per rule
+        self._nodes: List[ast.AST] = [self.tree]
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
                 self._parents[child] = node
+                self._nodes.append(child)
+        self._jit_index = None
+
+    def walk(self) -> List[ast.AST]:
+        """Every node in the tree (ast.walk order) — the shared-walk path."""
+        return self._nodes
+
+    def jit_index(self):
+        """The module's jit-wrapper index, built once and shared by every
+        rule that needs it (JG002-JG005 each used to rebuild it)."""
+        if self._jit_index is None:
+            from tools.graftlint.rules import _JitIndex
+
+            self._jit_index = _JitIndex(self)
+        return self._jit_index
 
     # -- tree navigation ------------------------------------------------
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -255,7 +274,8 @@ def partition_new(
 
 
 def lint_source(source: str, relpath: str) -> List[Finding]:
-    """Lint one file's source; returns findings with suppressions applied."""
+    """Lint one file's source with the per-file rules (JG001-JG005) only;
+    the whole-program rules need the full tree — see :func:`lint_sources`."""
     from tools.graftlint.rules import RULES
 
     ctx = ModuleContext(relpath, source)
@@ -269,6 +289,107 @@ def lint_source(source: str, relpath: str) -> List[Finding]:
                 continue
             findings.append(f)
     findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
+
+
+#: relpath suffix that marks a lint run as covering the whole program: the
+#: telemetry registry is the host plane's innermost module, so a run that
+#: includes it is linting the full tree and the global joins (JG007 both
+#: directions, JG009 doc->code) are sound.  Single-file runs skip them.
+_COMPLETE_SENTINEL = "runtime/telemetry.py"
+
+
+def lint_sources(
+    items: Sequence[Tuple[str, str]],
+    catalog_text: Optional[str] = None,
+    complete: Optional[bool] = None,
+    stats_out: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
+    """Two-phase lint over ``(relpath, source)`` pairs.
+
+    Phase 1 runs the per-file rules and harvests each module's facts off
+    the same parsed AST; phase 2 joins the facts across files and runs the
+    whole-program rules (JG006-JG009).  Phase-2 findings honor the anchor
+    file's inline/file-wide suppressions just like per-file findings.
+
+    ``catalog_text`` is docs/OBSERVABILITY.md for JG009 (None skips it).
+    ``complete`` marks the item set as the whole program; None auto-detects
+    via :data:`_COMPLETE_SENTINEL`.  ``stats_out`` receives wall-clock
+    seconds per stage when provided.
+    """
+    import time as _time
+
+    from tools.graftlint.facts import harvest
+    from tools.graftlint.rules import RULES
+    from tools.graftlint.xrules import XRULES, Program, parse_catalog
+
+    findings: List[Finding] = []
+    all_facts = []
+    lines_by_file: Dict[str, List[str]] = {}
+    t_parse = t_rules = t_facts = 0.0
+
+    for relpath, source in items:
+        rel = relpath.replace(os.sep, "/")
+        t0 = _time.perf_counter()
+        try:
+            ctx = ModuleContext(rel, source)
+        except SyntaxError as e:
+            t_parse += _time.perf_counter() - t0
+            findings.append(
+                Finding(
+                    file=rel,
+                    line=e.lineno or 1,
+                    rule="JG000",
+                    message=f"file does not parse: {e.msg}",
+                    snippet="",
+                )
+            )
+            continue
+        t_parse += _time.perf_counter() - t0
+        lines_by_file[rel] = ctx.lines
+        by_line, file_wide = collect_suppressions(ctx.lines)
+
+        t0 = _time.perf_counter()
+        for rule_id, _title, fn in RULES:
+            if rule_id in file_wide:
+                continue
+            for f in fn(ctx):
+                if f.rule in by_line.get(f.line, ()):
+                    continue
+                findings.append(f)
+        t_rules += _time.perf_counter() - t0
+
+        t0 = _time.perf_counter()
+        all_facts.append(harvest(ctx, by_line, file_wide))
+        t_facts += _time.perf_counter() - t0
+
+    t0 = _time.perf_counter()
+    if complete is None:
+        complete = any(m.relpath.endswith(_COMPLETE_SENTINEL) for m in all_facts)
+    catalog = parse_catalog(catalog_text) if catalog_text is not None else None
+    prog = Program(
+        modules=all_facts,
+        complete=complete,
+        catalog=catalog,
+        lines=lines_by_file,
+    )
+    if catalog_text is not None:
+        prog.lines[prog.catalog_relpath] = catalog_text.splitlines()
+    suppress = {m.relpath: (m.suppress_lines, m.suppress_file) for m in all_facts}
+    for rule_id, _title, fn in XRULES:
+        for f in fn(prog):
+            by_line, file_wide = suppress.get(f.file, ({}, set()))
+            if f.rule in file_wide or f.rule in by_line.get(f.line, ()):
+                continue
+            findings.append(f)
+    if stats_out is not None:
+        stats_out["join"] = _time.perf_counter() - t0
+        stats_out["parse"] = t_parse
+        stats_out["rules"] = t_rules
+        stats_out["facts"] = t_facts
+        stats_out["files"] = float(len(items))
+
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
     return findings
 
 
@@ -286,25 +407,24 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return sorted(set(out))
 
 
-def lint_paths(paths: Sequence[str], repo_root: Optional[str] = None) -> List[Finding]:
-    """Lint every .py under ``paths``; files that fail to parse yield a
-    single parse-error finding instead of crashing the run."""
+def lint_paths(
+    paths: Sequence[str],
+    repo_root: Optional[str] = None,
+    stats_out: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
+    """Two-phase lint of every .py under ``paths``; files that fail to
+    parse yield a single parse-error finding instead of crashing the run.
+    Picks up docs/OBSERVABILITY.md from ``repo_root`` for JG009 when it
+    exists."""
     repo_root = repo_root or os.getcwd()
-    findings: List[Finding] = []
+    items: List[Tuple[str, str]] = []
     for path in iter_python_files(paths):
         rel = os.path.relpath(os.path.abspath(path), repo_root).replace(os.sep, "/")
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
-            findings.extend(lint_source(source, rel))
-        except SyntaxError as e:
-            findings.append(
-                Finding(
-                    file=rel,
-                    line=e.lineno or 1,
-                    rule="JG000",
-                    message=f"file does not parse: {e.msg}",
-                    snippet="",
-                )
-            )
-    return findings
+        with open(path, "r", encoding="utf-8") as f:
+            items.append((rel, f.read()))
+    catalog_text: Optional[str] = None
+    catalog_path = os.path.join(repo_root, "docs", "OBSERVABILITY.md")
+    if os.path.exists(catalog_path):
+        with open(catalog_path, "r", encoding="utf-8") as f:
+            catalog_text = f.read()
+    return lint_sources(items, catalog_text=catalog_text, stats_out=stats_out)

@@ -1,0 +1,578 @@
+"""Phase-1 fact harvest for graftlint v2's whole-program rules.
+
+One pass over a :class:`~tools.graftlint.engine.ModuleContext` distills the
+module into a :class:`ModuleFacts` record — the only thing phase 2 (the
+cross-file join in ``tools/graftlint/xrules.py``) ever sees.  Facts are
+deliberately lossy: each captures just enough structure for its rule.
+
+Fact families (one per v2 rule):
+
+- **locks** (JG006): lock attributes defined via ``threading.Lock()`` et
+  al., ``with``-acquisition edges from lexical nesting (holding A, acquire
+  B), the lock set each method acquires at its top, and method calls made
+  while a lock is held (resolved one hop in phase 2).
+- **wire kinds** (JG007): every dict-literal frame carrying a ``"kind"``
+  key (a *send*) and every comparison/membership test against
+  ``msg["kind"]`` / ``msg.get("kind")`` or a local alias of one (a
+  *handle*).  Values resolve through module-level string constants; names
+  that stay unresolved locally are carried as refs for the phase-2 global
+  constant table.  Only modules under :data:`WIRE_DIRS` contribute — the
+  codec-v2 wire lives in the host plane, and dict literals elsewhere (the
+  linter's own rule tables, say) are not frames.
+- **lifecycle** (JG008): ``threading.Thread(...)`` creations with daemon
+  status, whether the module calls ``.start()`` / ``.join()`` at all,
+  per-class ``PageAllocator`` acquire/release tallies plus acquire-inside-
+  ``try``-without-exception-path-release sites, and ``start_span`` results
+  that are discarded or never read again.
+- **telemetry** (JG009): ``MetricsRegistry`` instrument creations
+  (``reg.counter("a.b")``, f-string families as constant prefixes) and
+  ``reg.bind(...)`` names, with dynamic names recorded as such rather
+  than guessed at.
+
+A module may declare wire kinds that are sent (or dispatched) on purpose
+without a static peer via ``# graftlint: wire-ignore=kind1,kind2``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import ModuleContext, attr_path, root_name
+
+#: directories whose modules speak the codec-v2 wire; dict literals with a
+#: "kind" key outside these are not frames and never enter JG007's join.
+WIRE_DIRS = {"fleet", "serving", "genrl", "runtime", "trainer"}
+
+#: hot host-plane dirs for the JG008 thread sub-rule (mirrors rules.HOT_DIRS).
+HOT_DIRS = {"runtime", "trainer", "agents", "serving", "genrl"}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_LOCK_SUFFIXES = ("_lock", "_guard", "_mutex")
+_LOCK_NAMES = {"lock", "mutex", "guard"}
+
+_ALLOC_ACQUIRE = {"alloc", "try_reserve", "share"}
+_ALLOC_RELEASE = {"free", "release"}
+
+_REG_RECEIVERS = {"reg", "_reg", "registry", "_registry"}
+_INSTRUMENT_APIS = {"counter", "gauge", "histogram", "meter"}
+
+_WIRE_IGNORE_RE = re.compile(r"#\s*graftlint:\s*wire-ignore=([A-Za-z0-9_.,\- ]+)")
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+@dataclass
+class KindSite:
+    """One wire-kind occurrence: a resolved literal, or an unresolved name
+    ref for the phase-2 global constant table, or dynamic (both None)."""
+
+    kind: Optional[str]
+    ref: Optional[str]
+    line: int
+
+
+@dataclass
+class ThreadFact:
+    line: int
+    daemonic: bool
+
+
+@dataclass
+class AllocFact:
+    owner: str  # enclosing class name, or "<module>"
+    acquire_lines: List[int] = field(default_factory=list)
+    releases: int = 0
+
+
+@dataclass
+class InstrumentFact:
+    api: str  # counter / gauge / histogram / meter / bind
+    name: Optional[str]  # exact string name
+    prefix: Optional[str]  # constant prefix of an f-string family
+    line: int
+
+
+@dataclass
+class ModuleFacts:
+    relpath: str
+    module_id: str  # file stem, qualifies module-level lock names
+    is_wire: bool
+    is_hot: bool
+    consts: Dict[str, str] = field(default_factory=dict)
+    # locks
+    lock_defs: Dict[str, int] = field(default_factory=dict)
+    lock_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    method_locks: Dict[str, Set[Tuple[str, FrozenSet[str]]]] = field(
+        default_factory=dict
+    )
+    held_calls: List[Tuple[str, str, int]] = field(default_factory=list)
+    # wire
+    sends: List[KindSite] = field(default_factory=list)
+    handles: List[KindSite] = field(default_factory=list)
+    wire_ignored: Set[str] = field(default_factory=set)
+    # lifecycle
+    threads: List[ThreadFact] = field(default_factory=list)
+    has_start: bool = False
+    has_join: bool = False
+    allocs: Dict[str, AllocFact] = field(default_factory=dict)
+    alloc_leaks: List[int] = field(default_factory=list)
+    unended_spans: List[Tuple[int, str]] = field(default_factory=list)
+    # telemetry
+    instruments: List[InstrumentFact] = field(default_factory=list)
+    binds: List[InstrumentFact] = field(default_factory=list)
+    dynamic_bind: bool = False
+    # suppressions, retained so phase-2 findings honor their anchor file's
+    # inline/file-wide disables
+    suppress_lines: Dict[int, Set[str]] = field(default_factory=dict)
+    suppress_file: Set[str] = field(default_factory=set)
+
+
+def _path_dirs(relpath: str) -> Set[str]:
+    return set(relpath.split("/")[:-1])
+
+
+def _enclosing_class(ctx: ModuleContext, node: ast.AST) -> Optional[str]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+    return None
+
+
+def _const_str(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    return None
+
+
+def _fstring_prefix(expr: ast.AST) -> Optional[str]:
+    """Constant leading text of an f-string (or ``"lit" + x`` concat)."""
+    if isinstance(expr, ast.JoinedStr):
+        prefix = ""
+        for part in expr.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix += part.value
+            else:
+                break
+        return prefix or None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _const_str(expr.left)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# locks
+
+
+def _lock_id(
+    ctx: ModuleContext, expr: ast.AST, cls: Optional[str], module_id: str
+) -> Tuple[Optional[str], Optional[str]]:
+    """(graph node id, last path segment) for a with-item expression."""
+    e = expr
+    if isinstance(e, ast.Call):  # e.g. ``with lock_timeout(self._lock):``
+        if e.args:
+            e = e.args[0]
+        else:
+            e = e.func
+    if isinstance(e, ast.Name):
+        return f"{module_id}.{e.id}", e.id
+    path = attr_path(e)
+    if path is None:
+        return None, None
+    parts = path.split(".")
+    if parts[0] == "self":
+        if len(parts) == 2 and cls:
+            return f"{cls}.{parts[1]}", parts[1]
+        return ".".join(parts[1:]), parts[-1]
+    return path, parts[-1]
+
+
+def _lockish(facts: ModuleFacts, lock_id: Optional[str], last: Optional[str]) -> bool:
+    if not lock_id or not last:
+        return False
+    if lock_id in facts.lock_defs:
+        return True
+    return last.endswith(_LOCK_SUFFIXES) or last in _LOCK_NAMES
+
+
+def _harvest_locks_in_function(
+    ctx: ModuleContext, func: ast.AST, facts: ModuleFacts
+) -> None:
+    cls = _enclosing_class(ctx, func)
+    top_locks: Set[str] = set()
+
+    def visit(node: ast.AST, held: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPES):
+                continue  # nested scopes don't run under this lock
+            nxt = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in child.items:
+                    lid, last = _lock_id(ctx, item.context_expr, cls, facts.module_id)
+                    if _lockish(facts, lid, last):
+                        acquired.append(lid)  # type: ignore[arg-type]
+                if acquired:
+                    for lid in acquired:
+                        if held:
+                            facts.lock_edges.append((held[-1], lid, child.lineno))
+                        else:
+                            top_locks.add(lid)
+                    nxt = held + acquired
+            elif isinstance(child, ast.Call) and held:
+                if isinstance(child.func, ast.Attribute):
+                    facts.held_calls.append(
+                        (held[-1], child.func.attr, child.lineno)
+                    )
+            visit(child, nxt)
+
+    visit(func, [])
+    if top_locks:
+        name = getattr(func, "name", "<lambda>")
+        facts.method_locks.setdefault(name, set()).add(
+            (cls or "", frozenset(top_locks))
+        )
+
+
+# ---------------------------------------------------------------------------
+# wire kinds
+
+
+def _kind_value_site(expr: ast.AST, consts: Dict[str, str]) -> KindSite:
+    line = getattr(expr, "lineno", 1)
+    s = _const_str(expr)
+    if s is not None:
+        return KindSite(kind=s, ref=None, line=line)
+    if isinstance(expr, ast.Name):
+        if expr.id in consts:
+            return KindSite(kind=consts[expr.id], ref=None, line=line)
+        return KindSite(kind=None, ref=expr.id, line=line)
+    if isinstance(expr, ast.Attribute):
+        return KindSite(kind=None, ref=expr.attr, line=line)
+    return KindSite(kind=None, ref=None, line=line)
+
+
+def _is_kind_read(expr: ast.AST, aliases: Set[str]) -> bool:
+    """True for ``X["kind"]``, ``X.get("kind"[, d])``, or a local alias."""
+    if isinstance(expr, ast.Subscript):
+        return _const_str(expr.slice) == "kind"
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "get"
+        and expr.args
+    ):
+        return _const_str(expr.args[0]) == "kind"
+    if isinstance(expr, ast.Name):
+        return expr.id in aliases
+    return False
+
+
+def _harvest_handles_in_function(
+    ctx: ModuleContext, func: ast.AST, facts: ModuleFacts
+) -> None:
+    nodes = [n for n in _scope_walk(func)]
+    aliases: Set[str] = set()
+    for n in nodes:  # pass 1: ``kind = msg.get("kind")`` aliases
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Name) and _is_kind_read(n.value, set()):
+                aliases.add(t.id)
+    for n in nodes:  # pass 2: comparisons / membership tests
+        if not isinstance(n, ast.Compare):
+            continue
+        sides = [n.left] + list(n.comparators)
+        if not any(_is_kind_read(s, aliases) for s in sides):
+            continue
+        for op, comp in zip(n.ops, n.comparators):
+            exprs: List[ast.AST]
+            if isinstance(op, (ast.In, ast.NotIn)) and isinstance(
+                comp, (ast.Tuple, ast.List, ast.Set)
+            ):
+                exprs = list(comp.elts)
+            elif isinstance(op, (ast.Eq, ast.NotEq)):
+                exprs = [comp, n.left]
+            else:
+                continue
+            for e in exprs:
+                if _is_kind_read(e, aliases):
+                    continue
+                site = _kind_value_site(e, facts.consts)
+                if site.kind is not None or site.ref is not None:
+                    facts.handles.append(site)
+
+
+def _scope_walk(func: ast.AST):
+    """Walk a function body without descending into nested scopes."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+
+def _alloc_receiver(call: ast.Call) -> Optional[str]:
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = attr_path(call.func.value) or root_name(call.func.value) or ""
+    return recv if "alloc" in recv else None
+
+
+def _harvest_alloc_call(
+    ctx: ModuleContext, call: ast.Call, facts: ModuleFacts
+) -> None:
+    recv = _alloc_receiver(call)
+    if recv is None:
+        return
+    method = call.func.attr  # type: ignore[union-attr]
+    owner = _enclosing_class(ctx, call) or "<module>"
+    af = facts.allocs.setdefault(owner, AllocFact(owner=owner))
+    if method in _ALLOC_RELEASE:
+        af.releases += 1
+        return
+    if method not in _ALLOC_ACQUIRE:
+        return
+    af.acquire_lines.append(call.lineno)
+    # acquire inside a try whose handlers/finally never release, while the
+    # function does release later: the exception path leaks the pages
+    anc = list(ctx.ancestors(call))
+    for i, a in enumerate(anc):
+        if isinstance(a, _SCOPES):
+            break
+        if isinstance(a, ast.Try):
+            child = anc[i - 1] if i else call
+            if child not in a.body and not any(
+                child is s or _contains(s, child) for s in a.body
+            ):
+                continue
+            cleanup = list(a.finalbody)
+            for h in a.handlers:
+                cleanup.extend(h.body)
+            if any(_has_release(s) for s in cleanup):
+                break
+            func = ctx.enclosing_function(call)
+            if func is not None and any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _ALLOC_RELEASE
+                and _alloc_receiver(n)
+                and n.lineno > max(call.lineno, getattr(a, "end_lineno", 0) or 0)
+                for n in _scope_walk(func)
+            ):
+                facts.alloc_leaks.append(call.lineno)
+            break
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def _has_release(stmt: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call)
+        and isinstance(n.func, ast.Attribute)
+        and n.func.attr in _ALLOC_RELEASE
+        and _alloc_receiver(n)
+        for n in ast.walk(stmt)
+    )
+
+
+def _harvest_spans_in_function(
+    ctx: ModuleContext, func: ast.AST, facts: ModuleFacts
+) -> None:
+    nodes = list(_scope_walk(func))
+    for n in nodes:
+        if not (
+            isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+            and isinstance(n.value, ast.Call)
+        ):
+            continue
+        callee = n.value.func
+        last = callee.attr if isinstance(callee, ast.Attribute) else (
+            callee.id if isinstance(callee, ast.Name) else None
+        )
+        if last != "start_span":
+            continue
+        name = n.targets[0].id
+        used = any(
+            isinstance(m, ast.Name)
+            and m.id == name
+            and isinstance(m.ctx, ast.Load)
+            and m.lineno >= n.lineno
+            for m in nodes
+        )
+        if not used:
+            facts.unended_spans.append((n.lineno, name))
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+
+
+def _is_registry_receiver(recv: ast.AST) -> bool:
+    path = attr_path(recv)
+    if path is not None and path.split(".")[-1] in _REG_RECEIVERS:
+        return True
+    if isinstance(recv, ast.Name) and recv.id in _REG_RECEIVERS:
+        return True
+    if root_name(recv) == "telemetry":
+        return True
+    for n in ast.walk(recv):
+        if isinstance(n, ast.Call):
+            f = n.func
+            tail = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if tail == "get_registry":
+                return True
+    return False
+
+
+def _harvest_telemetry_call(call: ast.Call, facts: ModuleFacts) -> None:
+    if not isinstance(call.func, ast.Attribute):
+        return
+    api = call.func.attr
+    if api not in _INSTRUMENT_APIS and api != "bind":
+        return
+    if not call.args:
+        return
+    if not _is_registry_receiver(call.func.value):
+        return
+    arg = call.args[0]
+    name = _const_str(arg)
+    prefix = None if name is not None else _fstring_prefix(arg)
+    if api == "bind":
+        if name is None and prefix is None:
+            facts.dynamic_bind = True
+            return
+        facts.binds.append(InstrumentFact("bind", name, prefix, call.lineno))
+        return
+    if name is None and prefix is None:
+        return  # fully dynamic instrument name: nothing to check statically
+    facts.instruments.append(InstrumentFact(api, name, prefix, call.lineno))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+
+
+def harvest(
+    ctx: ModuleContext,
+    suppress_lines: Optional[Dict[int, Set[str]]] = None,
+    suppress_file: Optional[Set[str]] = None,
+) -> ModuleFacts:
+    """Distill ``ctx`` into the facts phase 2 joins across the program."""
+    dirs = _path_dirs(ctx.relpath)
+    module_id = ctx.relpath.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+    facts = ModuleFacts(
+        relpath=ctx.relpath,
+        module_id=module_id,
+        is_wire=bool(dirs & WIRE_DIRS),
+        is_hot=bool(dirs & HOT_DIRS),
+        suppress_lines=dict(suppress_lines or {}),
+        suppress_file=set(suppress_file or ()),
+    )
+
+    for m in _WIRE_IGNORE_RE.finditer(ctx.source):
+        facts.wire_ignored |= {k.strip() for k in m.group(1).split(",") if k.strip()}
+
+    # module-level string constants (wire vocabularies: PING = "ping", ...)
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t, v = stmt.targets[0], _const_str(stmt.value)
+            if isinstance(t, ast.Name) and v is not None:
+                facts.consts[t.id] = v
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            v = _const_str(stmt.value)
+            if isinstance(stmt.target, ast.Name) and v is not None:
+                facts.consts[stmt.target.id] = v
+
+    nodes = ctx.walk()
+
+    # lock attribute definitions first — _lockish consults them
+    for n in nodes:
+        if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+            continue
+        callee = n.value.func
+        ctor = callee.attr if isinstance(callee, ast.Attribute) else (
+            callee.id if isinstance(callee, ast.Name) else None
+        )
+        if ctor not in _LOCK_CTORS:
+            continue
+        for t in n.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                cls = _enclosing_class(ctx, n)
+                if cls:
+                    facts.lock_defs[f"{cls}.{t.attr}"] = n.lineno
+            elif isinstance(t, ast.Name):
+                tgt_cls = _enclosing_class(ctx, n)
+                owner = tgt_cls if (
+                    tgt_cls and ctx.enclosing_function(n) is None
+                ) else module_id
+                facts.lock_defs[f"{owner}.{t.id}"] = n.lineno
+
+    daemon_assigned = any(
+        isinstance(n, ast.Assign)
+        and any(
+            isinstance(t, ast.Attribute) and t.attr == "daemon" for t in n.targets
+        )
+        and isinstance(n.value, ast.Constant)
+        and n.value.value
+        for n in nodes
+    )
+
+    for n in nodes:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _harvest_locks_in_function(ctx, n, facts)
+            _harvest_spans_in_function(ctx, n, facts)
+            if facts.is_wire:
+                _harvest_handles_in_function(ctx, n, facts)
+            continue
+        if isinstance(n, ast.Dict) and facts.is_wire:
+            for k, v in zip(n.keys, n.values):
+                if k is not None and _const_str(k) == "kind":
+                    facts.sends.append(_kind_value_site(v, facts.consts))
+            continue
+        if not isinstance(n, ast.Call):
+            continue
+        callee = n.func
+        tail = callee.attr if isinstance(callee, ast.Attribute) else (
+            callee.id if isinstance(callee, ast.Name) else None
+        )
+        if tail == "Thread":
+            rn = root_name(callee)
+            if rn in ("threading", "Thread") or tail == rn:
+                daemonic = daemon_assigned or any(
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and bool(kw.value.value)
+                    for kw in n.keywords
+                )
+                facts.threads.append(ThreadFact(line=n.lineno, daemonic=daemonic))
+        elif tail == "dict" and facts.is_wire:
+            for kw in n.keywords:
+                if kw.arg == "kind":
+                    facts.sends.append(_kind_value_site(kw.value, facts.consts))
+        elif tail == "start" and isinstance(callee, ast.Attribute):
+            facts.has_start = True
+        elif tail == "join" and isinstance(callee, ast.Attribute):
+            if not isinstance(callee.value, ast.Constant):  # skip ", ".join
+                facts.has_join = True
+        if isinstance(callee, ast.Attribute):
+            _harvest_alloc_call(ctx, n, facts)
+            _harvest_telemetry_call(n, facts)
+
+    return facts

@@ -141,7 +141,7 @@ class _JitIndex:
         self.wrapped: Dict[str, Dict] = {}
         self.impl_funcs: Set[str] = set()
         self.decorated: Dict[str, Dict] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 info = self._info_through_partial(node.value)
                 if info is None:
@@ -192,7 +192,7 @@ class _JitIndex:
 
 def _jitted_defs(ctx: ModuleContext, index: _JitIndex) -> List[ast.FunctionDef]:
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name in index.impl_funcs or node.name in index.decorated:
                 out.append(node)
@@ -265,7 +265,7 @@ def rule_jg001(ctx: ModuleContext) -> Iterator[Finding]:
         "batched device->host transfer per chunk) or hoist the read out of "
         "the loop; keep running reductions on device"
     )
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         enclosing = ctx.enclosing_function(node)
@@ -363,9 +363,9 @@ def rule_jg002(ctx: ModuleContext) -> Iterator[Finding]:
     # combination where concurrent multi-device dispatch can interleave
     if "threading" not in ctx.source or "mesh" not in ctx.source:
         return
-    index = _JitIndex(ctx)
+    index = ctx.jit_index()
     jit_names = set(index.wrapped)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         label = _dispatch_site(node, jit_names)
@@ -417,14 +417,14 @@ def _references(expr: ast.AST, names: Set[str]) -> bool:
 
 
 def rule_jg003(ctx: ModuleContext) -> Iterator[Finding]:
-    index = _JitIndex(ctx)
+    index = ctx.jit_index()
     static_callables: Dict[str, Dict] = {
         name: info
         for name, info in {**index.wrapped, **index.decorated}.items()
         if info["static"] or info["static_names"]
     }
     # (a) per-call-varying value fed to a static slot inside a loop
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         name = None
@@ -489,7 +489,7 @@ def rule_jg003(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 def rule_jg004(ctx: ModuleContext) -> Iterator[Finding]:
-    index = _JitIndex(ctx)
+    index = ctx.jit_index()
     for fn in _jitted_defs(ctx, index):
         for node in ast.walk(fn):
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
@@ -529,11 +529,11 @@ def _donating_callables(index: _JitIndex) -> Dict[str, Tuple[int, ...]]:
 
 
 def rule_jg005(ctx: ModuleContext) -> Iterator[Finding]:
-    index = _JitIndex(ctx)
+    index = ctx.jit_index()
     donating = _donating_callables(index)
     if not donating:
         return
-    for node in ast.walk(ctx.tree):
+    for node in ctx.walk():
         if not isinstance(node, ast.Call):
             continue
         name = None

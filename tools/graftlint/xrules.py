@@ -1,0 +1,427 @@
+"""Phase-2 whole-program rules (JG006-JG009): the cross-file join.
+
+Each rule is ``fn(program) -> Iterator[Finding]`` over a :class:`Program`
+holding every module's :class:`~tools.graftlint.facts.ModuleFacts`.  The
+engine runs these after the per-file rules, then applies the anchor file's
+inline/file-wide suppressions exactly as for per-file findings.
+
+Join semantics, per rule:
+
+- **JG006 lock-order-inversion** — build a directed lock-acquisition graph:
+  lexical edges (holding A, ``with B:``) union one-hop call edges (holding
+  A, call ``self.x.m()`` where ``m`` resolves to exactly one method in the
+  whole program that acquires lock set S -> edges A->s for s in S; an
+  ambiguous method name contributes nothing).  Any simple cycle is a
+  potential ABBA deadlock and is reported once, anchored at its first edge.
+- **JG007 wire-kind-exhaustiveness** — union all send sites and all handle
+  sites, resolving named constants through a program-wide table (a name
+  bound to conflicting strings resolves to nothing).  Sent-but-unhandled
+  and handled-but-never-sent kinds flag unless declared via
+  ``# graftlint: wire-ignore=...`` in any wire module.  Runs only on
+  *complete* programs (the whole ``scalerl_tpu`` tree) — linting one file
+  in isolation must not report its peers' kinds as missing.
+- **JG008 thread-resource-lifecycle** — per-module: non-daemon thread
+  created in a HOT dir whose module starts threads but never joins any;
+  a class that acquires allocator pages and never releases; an acquire
+  inside ``try`` with no release on the exception path; a ``start_span``
+  result discarded or never read (``record_span`` and spans that escape
+  into stores/returns are fine).
+- **JG009 telemetry-catalog-drift** — instruments and binds in code vs.
+  the OBSERVABILITY.md "Instrument catalog" table, both directions.
+  Wildcard rows (``chaos.<fault_kind>``) and star rows (``fleet.*``) cover
+  whole families; only exact rows are checked for staleness, and bind
+  rows are satisfied by a covering ``reg.bind`` root.  The doc->code
+  direction also needs a complete program.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import Finding
+from tools.graftlint.facts import ModuleFacts
+
+CATALOG_RELPATH = "docs/OBSERVABILITY.md"
+_CATALOG_HEADING = "instrument catalog"
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+# ---------------------------------------------------------------------------
+# the OBSERVABILITY.md instrument-catalog table
+
+
+@dataclass
+class CatalogEntry:
+    name: str  # exact name, or literal prefix for wildcard/star entries
+    line: int
+    kind_cell: str
+    style: str  # "exact" | "wildcard" | "star"
+
+    @property
+    def is_bind(self) -> bool:
+        return "bind" in self.kind_cell.lower()
+
+
+@dataclass
+class Catalog:
+    entries: List[CatalogEntry] = field(default_factory=list)
+
+    @property
+    def exacts(self) -> List[CatalogEntry]:
+        return [e for e in self.entries if e.style == "exact"]
+
+    @property
+    def family_prefixes(self) -> List[str]:
+        return [e.name for e in self.entries if e.style != "exact"]
+
+    def covers_exact(self, name: str) -> bool:
+        for e in self.exacts:
+            if name == e.name or name.startswith(e.name + "."):
+                return True
+        return any(p and name.startswith(p) for p in self.family_prefixes)
+
+    def covers_prefix(self, prefix: str) -> bool:
+        for e in self.exacts:
+            if e.name.startswith(prefix) or prefix.startswith(e.name + "."):
+                return True
+        return any(
+            p and (p.startswith(prefix) or prefix.startswith(p))
+            for p in self.family_prefixes
+        )
+
+    def covers_bind(self, name: str) -> bool:
+        if any(name == e.name for e in self.exacts):
+            return True
+        return self.covers_prefix(name + ".")
+
+
+def parse_catalog(text: str) -> Catalog:
+    """Extract instrument names from the ``### Instrument catalog`` table.
+
+    Names live backticked in the first cell; a dotless follow-on token in
+    the same cell inherits the previous token's dotted prefix (so
+    ``| `server.total_results` / `duplicate_results` |`` yields both fully
+    qualified names).  ``<placeholder>`` tokens become family prefixes, as
+    do ``foo.*`` rows.  Non-backticked (italic, report-time) rows
+    contribute nothing.
+    """
+    cat = Catalog()
+    in_section = False
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if line.startswith("#"):
+            in_section = _CATALOG_HEADING in line.lower()
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if not cells or set(cells[0]) <= {"-", ":", " "}:
+            continue  # separator row
+        first, kind_cell = cells[0], cells[1] if len(cells) > 1 else ""
+        if first.lower() in ("name", "instrument"):
+            continue  # header row
+        last_prefix = ""
+        for i, tok in enumerate(_BACKTICK_RE.findall(first)):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if i > 0 and "." not in tok and last_prefix:
+                tok = last_prefix + tok
+            if "." in tok:
+                last_prefix = tok.rsplit(".", 1)[0] + "."
+            if "<" in tok:
+                cat.entries.append(
+                    CatalogEntry(tok.split("<", 1)[0], lineno, kind_cell, "wildcard")
+                )
+            elif tok.endswith("*"):
+                cat.entries.append(
+                    CatalogEntry(tok.rstrip("*"), lineno, kind_cell, "star")
+                )
+            else:
+                cat.entries.append(CatalogEntry(tok, lineno, kind_cell, "exact"))
+    return cat
+
+
+# ---------------------------------------------------------------------------
+# program: what a phase-2 rule sees
+
+
+@dataclass
+class Program:
+    modules: List[ModuleFacts]
+    complete: bool = False
+    catalog: Optional[Catalog] = None
+    catalog_relpath: str = CATALOG_RELPATH
+    lines: Dict[str, List[str]] = field(default_factory=dict)
+
+    def finding(
+        self, relpath: str, line: int, rule: str, message: str, hint: str = ""
+    ) -> Finding:
+        text = self.lines.get(relpath, [])
+        snippet = text[line - 1].strip() if 1 <= line <= len(text) else ""
+        return Finding(
+            file=relpath, line=line, rule=rule, message=message, hint=hint,
+            snippet=snippet,
+        )
+
+
+# ---------------------------------------------------------------------------
+# JG006
+
+
+def _simple_cycles(
+    edges: Dict[str, Dict[str, Tuple[str, int]]], cap: int = 25
+) -> List[Tuple[str, ...]]:
+    nodes = sorted(set(edges) | {b for outs in edges.values() for b in outs})
+    out: List[Tuple[str, ...]] = []
+    for start in nodes:
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        while stack and len(out) < cap:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start:
+                    out.append(tuple(path))
+                elif nxt > start and nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+def xrule_jg006(prog: Program) -> Iterator[Finding]:
+    """Cycles in the cross-module lock-acquisition graph (ABBA deadlock)."""
+    edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    def add(a: str, b: str, site: Tuple[str, int]) -> None:
+        if a != b:
+            edges.setdefault(a, {}).setdefault(b, site)
+
+    for m in prog.modules:
+        for a, b, ln in m.lock_edges:
+            add(a, b, (m.relpath, ln))
+
+    method_locks: Dict[str, Set[Tuple[str, frozenset]]] = {}
+    for m in prog.modules:
+        for name, entries in m.method_locks.items():
+            method_locks.setdefault(name, set()).update(entries)
+
+    for m in prog.modules:
+        for held, meth, ln in m.held_calls:
+            candidates = method_locks.get(meth, set())
+            if len(candidates) != 1:
+                continue  # unknown or ambiguous method: no edge
+            (_cls, locks), = candidates
+            for b in locks:
+                add(held, b, (m.relpath, ln))
+
+    for cyc in _simple_cycles(edges):
+        hops = []
+        ring = list(cyc) + [cyc[0]]
+        for a, b in zip(ring, ring[1:]):
+            f, ln = edges[a][b]
+            hops.append(f"{a} -> {b} at {f}:{ln}")
+        anchor_file, anchor_line = edges[ring[0]][ring[1]]
+        yield prog.finding(
+            anchor_file,
+            anchor_line,
+            "JG006",
+            "lock-order inversion: " + " -> ".join(ring)
+            + " (" + "; ".join(hops) + ")",
+            hint="pick one global acquisition order, or move the cross-object "
+            "call outside the held section",
+        )
+
+
+# ---------------------------------------------------------------------------
+# JG007
+
+
+def xrule_jg007(prog: Program) -> Iterator[Finding]:
+    """Every sent wire kind must be dispatched somewhere (and vice versa)."""
+    if not prog.complete:
+        return
+
+    gconsts: Dict[str, str] = {}
+    conflicted: Set[str] = set()
+    for m in prog.modules:
+        for name, value in m.consts.items():
+            if name in gconsts and gconsts[name] != value:
+                conflicted.add(name)
+            else:
+                gconsts.setdefault(name, value)
+
+    def resolve(site) -> Optional[str]:
+        if site.kind is not None:
+            return site.kind
+        if site.ref is not None and site.ref not in conflicted:
+            return gconsts.get(site.ref)
+        return None
+
+    sent: Dict[str, Tuple[str, int]] = {}
+    handled: Dict[str, Tuple[str, int]] = {}
+    ignored: Set[str] = set()
+    for m in prog.modules:
+        ignored |= m.wire_ignored
+        for s in m.sends:
+            k = resolve(s)
+            if k is not None:
+                sent.setdefault(k, (m.relpath, s.line))
+        for h in m.handles:
+            k = resolve(h)
+            if k is not None:
+                handled.setdefault(k, (m.relpath, h.line))
+
+    for kind in sorted(set(sent) - set(handled) - ignored):
+        f, ln = sent[kind]
+        yield prog.finding(
+            f, ln, "JG007",
+            f"frame kind '{kind}' is sent here but no recv pump dispatches "
+            "on it anywhere in the program",
+            hint="handle the kind on the receiving pump, or declare it with "
+            f"'# graftlint: wire-ignore={kind}'",
+        )
+    for kind in sorted(set(handled) - set(sent) - ignored):
+        f, ln = handled[kind]
+        yield prog.finding(
+            f, ln, "JG007",
+            f"frame kind '{kind}' is dispatched on here but never sent "
+            "anywhere in the program (dead kind)",
+            hint="delete the dead dispatch arm, or declare it with "
+            f"'# graftlint: wire-ignore={kind}'",
+        )
+
+
+# ---------------------------------------------------------------------------
+# JG008
+
+
+def xrule_jg008(prog: Program) -> Iterator[Finding]:
+    """Thread, allocator-page, and span lifecycle hygiene."""
+    for m in prog.modules:
+        if m.is_hot and m.has_start and not m.has_join:
+            for t in m.threads:
+                if not t.daemonic:
+                    yield prog.finding(
+                        m.relpath, t.line, "JG008",
+                        "non-daemon thread created in a hot dir and started "
+                        "without any reachable join() in this module",
+                        hint="pass daemon=True, or join the thread on the "
+                        "shutdown path",
+                    )
+        for owner in sorted(m.allocs):
+            af = m.allocs[owner]
+            if af.acquire_lines and af.releases == 0:
+                yield prog.finding(
+                    m.relpath, af.acquire_lines[0], "JG008",
+                    f"{owner} acquires allocator pages (alloc/try_reserve/"
+                    "share) but never releases any (free/release)",
+                    hint="release or free the pages on every exit path",
+                )
+        for ln in m.alloc_leaks:
+            yield prog.finding(
+                m.relpath, ln, "JG008",
+                "allocator pages acquired inside try, but no handler or "
+                "finally releases them: the exception path leaks the pages",
+                hint="release in a finally (or in every except) so the "
+                "exception path returns the pages",
+            )
+        for ln, name in m.unended_spans:
+            yield prog.finding(
+                m.relpath, ln, "JG008",
+                f"span '{name}' = start_span(...) is never read again: it "
+                "is neither ended nor handed off, so the trace dangles",
+                hint="call span.end(...), use the span as a context manager, "
+                "or use tracing.record_span for retroactive spans",
+            )
+
+
+# ---------------------------------------------------------------------------
+# JG009
+
+
+def xrule_jg009(prog: Program) -> Iterator[Finding]:
+    """Instruments in code vs. the OBSERVABILITY.md catalog, both ways."""
+    cat = prog.catalog
+    if cat is None:
+        return
+
+    code_exact: Set[str] = set()
+    code_prefixes: Set[str] = set()
+    bind_names: Set[str] = set()
+    bind_prefixes: Set[str] = set()
+    any_dynamic_bind = False
+
+    for m in prog.modules:
+        any_dynamic_bind = any_dynamic_bind or m.dynamic_bind
+        for inst in m.instruments:
+            if inst.name is not None:
+                code_exact.add(inst.name)
+                if not cat.covers_exact(inst.name):
+                    yield prog.finding(
+                        m.relpath, inst.line, "JG009",
+                        f"{inst.api} '{inst.name}' is not in the "
+                        "OBSERVABILITY.md instrument catalog",
+                        hint="add a catalog row (name | kind | source) to "
+                        "docs/OBSERVABILITY.md",
+                    )
+            elif inst.prefix:
+                code_prefixes.add(inst.prefix)
+                if not cat.covers_prefix(inst.prefix):
+                    yield prog.finding(
+                        m.relpath, inst.line, "JG009",
+                        f"{inst.api} family '{inst.prefix}<...>' is not in "
+                        "the OBSERVABILITY.md instrument catalog",
+                        hint="add a wildcard catalog row like "
+                        f"`{inst.prefix}<name>` to docs/OBSERVABILITY.md",
+                    )
+        for b in m.binds:
+            if b.name is not None:
+                bind_names.add(b.name)
+                if not cat.covers_bind(b.name):
+                    yield prog.finding(
+                        m.relpath, b.line, "JG009",
+                        f"bind '{b.name}' is not in the OBSERVABILITY.md "
+                        "instrument catalog",
+                        hint="add a catalog row for the bound scalar family",
+                    )
+            elif b.prefix:
+                bind_prefixes.add(b.prefix)
+                if not cat.covers_prefix(b.prefix):
+                    yield prog.finding(
+                        m.relpath, b.line, "JG009",
+                        f"bind family '{b.prefix}<...>' is not in the "
+                        "OBSERVABILITY.md instrument catalog",
+                        hint="add a wildcard catalog row like "
+                        f"`{b.prefix}<name>`",
+                    )
+
+    if not prog.complete:
+        return
+
+    for e in cat.exacts:
+        name = e.name
+        covered = name in code_exact or any(
+            name.startswith(p) for p in code_prefixes
+        )
+        if not covered and e.is_bind:
+            covered = (
+                name in bind_names
+                or any(name == b or name.startswith(b + ".") for b in bind_names)
+                or any(name.startswith(p) for p in bind_prefixes)
+                or any_dynamic_bind
+            )
+        if not covered:
+            yield prog.finding(
+                prog.catalog_relpath, e.line, "JG009",
+                f"catalog row '{name}' has no matching instrument or bind "
+                "in code (stale row)",
+                hint="delete the stale row, or re-add the instrument",
+            )
+
+
+XRULES = [
+    ("JG006", "lock-order-inversion", xrule_jg006),
+    ("JG007", "wire-kind-exhaustiveness", xrule_jg007),
+    ("JG008", "thread-resource-lifecycle", xrule_jg008),
+    ("JG009", "telemetry-catalog-drift", xrule_jg009),
+]

@@ -35,6 +35,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBELOG = os.path.join(REPO, "TPU_PROBELOG.md")
 PAYLOG = "/tmp/tpu_autobench_r5.log"
+# machine-readable twin of the lint step's log output: the full findings
+# payload ({findings, summary, stats}) lands here on every run, pass or
+# fail, so a red lint step can be triaged without re-running the linter
+LINT_JSON = "/tmp/tpu_autobench_r5_lint.json"
 TELEM_ROOT = "/tmp/tpu_watch_telemetry"
 
 # registry counters whose nonzero final value flags a step as suspect even
@@ -444,10 +448,22 @@ def run_payload(n_devices: int = 1) -> None:
     # mid-probe instead of falling back cleanly
     fast_env = dict(os.environ, BENCH_BUDGET_S="120")
     steps = [
-        # lint first: jax-free and ~instant, so a dispatch-discipline
-        # regression (graftlint JG001-JG005, docs/LINTING.md) is recorded
-        # in the step summary even if the tunnel drops before any bench
-        ("lint", [sys.executable, "-m", "tools.graftlint", "scalerl_tpu"],
+        # rule-registry smoke before the real lint: --list-rules imports
+        # the whole rule table (JG001-JG009, per-file and whole-program),
+        # so a broken rule module fails loudly here instead of silently
+        # shrinking the set of rules the gating step below actually runs
+        ("lint-rules",
+         [sys.executable, "-m", "tools.graftlint", "--list-rules"],
+         60, env),
+        # lint second: jax-free and ~instant, so a dispatch-discipline
+        # regression (graftlint JG001-JG005) or a cross-file finding
+        # (JG006-JG009, docs/LINTING.md) is recorded in the step summary
+        # even if the tunnel drops before any bench.  Any finding fails
+        # the step (the baseline is empty by contract); the JSON artifact
+        # is written alongside the step log either way
+        ("lint",
+         [sys.executable, "-m", "tools.graftlint", "scalerl_tpu",
+          "--stats", "--json-out", LINT_JSON],
          120, env),
         # chaos soak second: seeded fault injection over the data plane
         # (frame corruption, torn shm slots, partial checkpoints, NaN
@@ -623,8 +639,8 @@ def run_payload(n_devices: int = 1) -> None:
         status.startswith("ok")
         for name, status in outcomes
         if name not in (
-            "lint", "chaos-soak", "elastic-soak", "disagg-soak",
-            "trace-soak", "genrl-soak",
+            "lint-rules", "lint", "chaos-soak", "elastic-soak",
+            "disagg-soak", "trace-soak", "genrl-soak",
         )
     ):
         # nothing TPU-witnessed succeeded (lint, the chaos soak, the
