@@ -1150,6 +1150,12 @@ def _run_disagg_measurement() -> None:
         "duplicates_absorbed": learner.duplicate_sequences
         + learner.duplicate_leases,
         "dropped_stale": learner.dropped_sequences,
+        # preemption plane (ISSUE 19): a fresh bench learner sits at
+        # epoch 1 with zero resume traffic — the fields exist so a bench
+        # run that ever rides a restored ledger is distinguishable
+        "learner_epoch": learner.learner_epoch,
+        "resumed_sequences_reissued": learner.resumed_sequences_reissued,
+        "resumed_duplicates_dropped": learner.resumed_duplicates_dropped,
         "hosts": cfg.num_hosts,
         "lanes_per_host": lanes,
         "vocab": V,

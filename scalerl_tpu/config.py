@@ -841,6 +841,12 @@ class GenRLArguments(RLArguments):
     # deliver its sequence batch before raising (a dead fleet must surface
     # as an error, not a silent hang).
     disagg_round_timeout_s: float = 120.0
+    # Durable learner ledger directory (ISSUE 19): non-empty enables the
+    # preemption-tolerant plane — SIGTERM at the between-rounds safe-point
+    # saves lease table + dedup keys + replay + snapshot generation into
+    # <dir>/learner_ledger, and the next run against the same dir resumes
+    # at the same learn step under a bumped learner epoch.
+    disagg_ledger_dir: str = ""
 
     def validate(self) -> None:
         super().validate()

@@ -133,6 +133,41 @@ def seq_update_priorities(
     )
 
 
+def seq_export(state: SequenceReplayState) -> Dict[str, Any]:
+    """The buffer's full occupancy as a host-numpy tree — storage fields,
+    recurrent core state, priorities, and both cursors — for the
+    preemption ledger (``genrl/ledger.py``).  Everything returned is
+    codec-v2 encodable (numpy arrays, tuples, dicts) and round-trips
+    bit-exact through :func:`seq_import`: a resumed learner samples the
+    SAME distribution its predecessor would have."""
+    host = jax.device_get(
+        {
+            "storage": dict(state.storage),
+            "core": state.core,
+            "priorities": state.priorities,
+        }
+    )
+    # cursors ride as plain ints: codec-v2 widens 0-d arrays to shape (1,),
+    # which would break the scalar contract on import
+    host["pos"] = int(state.pos)
+    host["size"] = int(state.size)
+    return host
+
+
+def seq_import(host: Dict[str, Any]) -> SequenceReplayState:
+    """Inverse of :func:`seq_export`: rebuild the HBM-resident pytree from
+    a restored ledger tree (one batched host->device upload per leaf)."""
+    return SequenceReplayState(
+        storage={k: jnp.asarray(v) for k, v in host["storage"].items()},
+        core=tuple(
+            (jnp.asarray(c), jnp.asarray(h)) for c, h in host["core"]
+        ),
+        priorities=jnp.asarray(host["priorities"]),
+        pos=jnp.asarray(host["pos"], jnp.int32).reshape(()),
+        size=jnp.asarray(host["size"], jnp.int32).reshape(()),
+    )
+
+
 def seq_update_priorities_keep_empty(
     state: SequenceReplayState, idx: jnp.ndarray, priorities: jnp.ndarray
 ) -> SequenceReplayState:

@@ -1591,6 +1591,29 @@ def apply_mass_kill(procs: List[mp.Process], site: str = "fleet") -> List[int]:
     return killed
 
 
+def apply_preempt(
+    procs: List[mp.Process], site: str = "fleet"
+) -> Optional[int]:
+    """One ``preempt`` chaos draw against ``procs``: when the active
+    injector fires, SIGTERM exactly ONE chosen live peer (a single spot
+    reclaim, the unit the preemption-resume machinery must absorb) and
+    return its index.  No injector or no fire → ``None``, zero cost."""
+    inj = chaos.active()
+    if inj is None:
+        return None
+    alive = [i for i, p in enumerate(procs) if p.is_alive()]
+    victim = inj.preempt_victim(len(alive), site=site)
+    if victim is None:
+        return None
+    i = alive[victim]
+    procs[i].terminate()
+    telemetry.record_event("preempt", site=site, victim=i)
+    logger.warning(
+        "chaos: preempt SIGTERMed peer slot %d (1/%d alive)", i, len(alive)
+    )
+    return i
+
+
 class ClusterExecutor:
     """The autoscaler's reference ``ScaleExecutor`` over a ``WorkerServer``
     plus a Local/RemoteCluster.

@@ -34,9 +34,16 @@ as-is):
                                            back (drain, or give-up) for
                                            reissue — no prompt is lost
                     {"kind": "drain_done", "host_id": h}
-    learner→host    {"kind": "lease", "v": [lease...], "gen": g}
+    learner→host    {"kind": "gen_welcome", "epoch": e, "gen": g}
+                                           hello reply: the LEARNER epoch
+                                           (bumped on every ledger resume)
+                                           and the current snapshot
+                                           generation a (re)joining host
+                                           must adopt before admitting work
+                    {"kind": "lease", "v": [lease...], "gen": g, "epoch": e}
                                            lease None = prompt source done
-                    {"kind": "params", "generation": g, "weights": tree}
+                    {"kind": "params", "generation": g, "weights": tree,
+                     "epoch": e}
                                            int8-quantized wire snapshot
                                            (``quantize_wire_tree``)
                     {"kind": "seq_ack", "seq": s}
@@ -59,7 +66,15 @@ Robustness is the PR 4/9 machinery applied at sequence granularity:
   scale-down;
 - ``mass_kill`` chaos waves ride :func:`fleet.cluster.apply_mass_kill`
   under the ``disagg`` site, and the autoscaler's floor rule backfills
-  through :class:`GenerationTierExecutor`.
+  through :class:`GenerationTierExecutor`;
+- the learner itself is preemptible: a SIGTERM'd learner saves its whole
+  accounting plane (lease table, dedup keys, accepted-but-unconsumed
+  sequences, snapshot generation) into a durable ledger
+  (``genrl/ledger.py``) and a restart resumes it under a bumped **learner
+  epoch** — hosts park in-flight work, redial with capped backoff, and the
+  ``gen_welcome`` handshake re-synchronizes epoch + snapshot generation so
+  pre-restart uploads dedup exactly (docs/DISTRIBUTED.md "Preemption &
+  elastic membership").
 
 jax-free by design: the shells, the learner endpoint, and the scripted
 engine run in processes that never import jax (the soak's whole point);
@@ -82,12 +97,14 @@ import numpy as np
 
 from scalerl_tpu.fleet.hub import QueueHub
 from scalerl_tpu.fleet.transport import Connection, PipeConnection
+from scalerl_tpu.genrl import ledger as ledger_store
 from scalerl_tpu.runtime import telemetry, tracing
 from scalerl_tpu.runtime.autoscaler import FleetSignals
 from scalerl_tpu.runtime.param_server import ParamSnapshotPlane
 from scalerl_tpu.runtime.supervisor import (
     DRAIN,
     DRAIN_DONE,
+    exp_backoff,
     is_heartbeat,
     make_drain,
     make_pong,
@@ -230,6 +247,11 @@ class DisaggConfig:
     # lanes before abandoning the rest back to the learner for reissue
     drain_step_budget: int = 2000
     ack_timeout_s: float = 30.0      # drain/exit wait for retained uploads
+    # learner-loss recovery: a host that loses its uplink parks in-flight
+    # work and redials with capped exponential backoff before giving up
+    reconnect_backoff_s: float = 0.05
+    reconnect_backoff_cap_s: float = 2.0
+    reconnect_max_tries: int = 40
 
     @property
     def heartbeat_timeout(self) -> float:
@@ -254,6 +276,11 @@ class DisaggConfig:
         if self.upload_batch < 1:
             raise ValueError(
                 f"upload_batch must be >= 1, got {self.upload_batch}"
+            )
+        if self.reconnect_max_tries < 1:
+            raise ValueError(
+                "reconnect_max_tries must be >= 1, got "
+                f"{self.reconnect_max_tries}"
             )
 
 
@@ -718,6 +745,10 @@ class GenerationHost:
         self.host_id = int(host_id)
         self.reconnect = reconnect
         self.host_epoch = int.from_bytes(os.urandom(4), "big")
+        # the learner's incarnation, adopted from gen_welcome (and every
+        # lease/params reply): uploads are stamped with it so a restarted
+        # learner can attribute redeliveries to its predecessor exactly
+        self.learner_epoch = 0
         self.engine: Any = None
         self._have_gen = -1
         self._latest_gen = 0
@@ -735,6 +766,7 @@ class GenerationHost:
         self._seq_counter = reg.counter("disagg_host.sequences")
         self._upload_counter = reg.counter("disagg_host.uploads")
         self._fetch_counter = reg.counter("disagg_host.param_fetches")
+        self._reconnect_counter = reg.counter("disagg_host.reconnects")
         self._send_hello()
 
     # -- link -----------------------------------------------------------
@@ -755,7 +787,30 @@ class GenerationHost:
             self.conn.close()
         except Exception:  # noqa: BLE001 — link already broken
             pass
-        self.conn = self.reconnect()
+        # learner loss: everything in flight stays PARKED by construction
+        # (queued leases, live lanes, retained un-acked uploads) while we
+        # redial with capped exponential backoff — a restarting learner
+        # takes a while to come back, and a dead one ends the host only
+        # after the full budget
+        for attempt in range(self.config.reconnect_max_tries):
+            try:
+                self.conn = self.reconnect()
+                break
+            except (ConnectionError, EOFError, OSError):
+                if attempt + 1 >= self.config.reconnect_max_tries:
+                    raise why
+                time.sleep(
+                    exp_backoff(
+                        attempt,
+                        base=self.config.reconnect_backoff_s,
+                        cap=self.config.reconnect_backoff_cap_s,
+                    )
+                )
+        self._reconnect_counter.inc()
+        telemetry.record_event(
+            "gen_host_reconnect", host=self.host_id,
+            retained_uploads=len(self._unacked),
+        )
         # membership first (the learner requeued our leases when the old
         # link dropped), then every retained upload on the fresh link
         self._send_hello()
@@ -792,10 +847,26 @@ class GenerationHost:
                     kind="disagg", host=self.host_id,
                 )
             return True
+        if isinstance(msg, dict) and msg.get("kind") == "gen_welcome":
+            self._adopt_epoch(msg)
+            # a (re)joining host adopts the learner's CURRENT snapshot
+            # generation before admitting work: lifting _latest_gen makes
+            # the run loop refetch params ahead of the next lease
+            self._latest_gen = max(self._latest_gen, int(msg.get("gen", 0)))
+            return True
         if isinstance(msg, dict) and msg.get("kind") == DRAIN:
             self._draining = True
             return True
         return False
+
+    def _adopt_epoch(self, msg: Mapping[str, Any]) -> None:
+        epoch = int(msg.get("epoch", self.learner_epoch))
+        if epoch != self.learner_epoch:
+            telemetry.record_event(
+                "learner_epoch_adopted", host=self.host_id,
+                epoch=epoch, prev=self.learner_epoch,
+            )
+            self.learner_epoch = epoch
 
     def _rpc(self, msg: Dict[str, Any]) -> Any:
         """send + recv with unsolicited-frame filtering and reconnect."""
@@ -820,7 +891,10 @@ class GenerationHost:
     def _fetch_params(self) -> None:
         t0 = time.monotonic()
         reply = self._rpc({"kind": "params", "have": self._have_gen})
-        if not isinstance(reply, dict) or "weights" not in reply:
+        if not isinstance(reply, dict):
+            return
+        self._adopt_epoch(reply)
+        if "weights" not in reply:
             return
         gen = int(reply["generation"])
         params = dequantize_wire_tree(reply["weights"])
@@ -853,6 +927,7 @@ class GenerationHost:
         reply = self._rpc(
             {"kind": "lease", "n": want, "have_gen": self._have_gen}
         )
+        self._adopt_epoch(reply)
         self._latest_gen = max(self._latest_gen, int(reply.get("gen", 0)))
         now = time.monotonic()
         for lease in reply.get("v", []):
@@ -951,6 +1026,10 @@ class GenerationHost:
         payload["host_id"] = self.host_id
         payload["host_epoch"] = self.host_epoch
         payload["seq_id"] = self._seq_id
+        # the epoch dimension of the at-least-once key: a redelivery that
+        # was generated under a previous learner incarnation is attributed
+        # to the resume, not to ordinary wire duplication
+        payload["learner_epoch"] = self.learner_epoch
         self._seq_id += 1
         return payload
 
@@ -1087,10 +1166,12 @@ class SequenceLearner(ParamSnapshotPlane):
         self,
         config: DisaggConfig,
         prompt_source: Callable[[], Optional[Dict[str, Any]]],
+        ledger_path: Optional[str] = None,
     ) -> None:
         config.validate()
         self.config = config
         self.prompt_source = prompt_source
+        self.ledger_path = ledger_path
         self._init_param_plane(None)
         self.hub = QueueHub(
             heartbeat_interval=config.heartbeat_interval_s,
@@ -1137,7 +1218,19 @@ class SequenceLearner(ParamSnapshotPlane):
         self.total_sequences = 0
         self.dropped_sequences = 0
         self.snapshot_wire_bytes = 0
+        # preemption/resume plane: the learner's incarnation counter (1 on
+        # a fresh start, predecessor+1 after a ledger restore) plus the
+        # markers that let the resumed epoch attribute drops to the resume
+        self.learner_epoch = 1
+        self.restored_extra: Optional[Dict[str, Any]] = None
+        self._restored_completed: Set[int] = set()
+        self._restored_dedup: Dict[int, Dict[int, int]] = {}
+        self.resumed_sequences_reissued = 0
+        self.resumed_duplicates_dropped = 0
         reg = telemetry.get_registry()
+        self._epoch_gauge = reg.gauge("learner.epoch")
+        self._reissued_counter = reg.counter("resume.sequences_reissued")
+        self._resume_dup_counter = reg.counter("resume.duplicates_dropped")
         self._seq_meter = reg.meter("disagg.sequences_per_s")
         self._stale_gauge = reg.gauge("disagg.staleness")
         reg.bind(
@@ -1156,10 +1249,20 @@ class SequenceLearner(ParamSnapshotPlane):
                 "hosts_joined": self.hosts_joined,
                 "hosts_drained": self.hosts_drained,
                 "snapshot_wire_bytes": self.snapshot_wire_bytes,
+                "learner_epoch": self.learner_epoch,
+                "resumed_sequences_reissued": self.resumed_sequences_reissued,
+                "resumed_duplicates_dropped": (
+                    self.resumed_duplicates_dropped
+                ),
             },
         )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        if ledger_path is not None and ledger_store.ledger_exists(
+            ledger_path
+        ):
+            self._restore_ledger(ledger_path)
+        self._epoch_gauge.set(self.learner_epoch)
 
     # -- param plane -----------------------------------------------------
     def publish(
@@ -1268,11 +1371,197 @@ class SequenceLearner(ParamSnapshotPlane):
             self._thread.join(timeout=2.0)
             self._thread = None
 
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    # -- durable ledger (preemption tolerance) ---------------------------
+    def ledger_state(
+        self, extra: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
+        """Snapshot the learner's whole accounting plane as one codec-v2
+        encodable tree: open + returned leases (reissued verbatim on
+        restart), the completed-lease / completed-sample / dedup tables
+        (so pre-restart redeliveries drop exactly), the accepted-but-
+        unconsumed sequence queue (drained here — losing it would lose
+        those sequences forever, their leases already closed), the param
+        plane (wire snapshot, generation, gen -> learner-step map), and
+        the churn counters.  ``extra`` carries trainer-owned state (replay
+        contents, learn step, lease RNG) through the same frame.
+
+        Call with the serve loop stopped (:meth:`stop`): the snapshot
+        CONSUMES the accepted queue, so it is a save-and-exit primitive,
+        not a live backup.
+        """
+        queued: List[Dict[str, Any]] = []
+        while True:
+            try:
+                queued.append(self.sequences.get_nowait())
+            except queue.Empty:
+                break
+        with self._lease_lock:
+            open_leases = [
+                lease
+                for _tid, (_conn, lease) in sorted(self._outstanding.items())
+                if isinstance(lease, dict)
+            ]
+            returned = list(self._returned)
+            state: Dict[str, Any] = {
+                "format": 1,
+                "learner_epoch": self.learner_epoch,
+                "next_task_id": self._next_task_id,
+                "open_leases": open_leases,
+                "returned_leases": returned,
+                "completed_leases": list(self._completed_leases.keys()),
+                "completed_samples": list(self._completed_samples.keys()),
+                "sample_counts": dict(self._sample_counts),
+                "dedup_seen": {
+                    hid: dict(epochs)
+                    for hid, epochs in self._dedup_seen.items()
+                },
+            }
+        with self._param_lock:
+            state.update(
+                generation=self.generation,
+                gen_steps=dict(self._gen_steps),
+                latest_learner_step=self._latest_learner_step,
+                params=self._params,
+            )
+        state["queued_sequences"] = queued
+        state["counters"] = {
+            "total_sequences": self.total_sequences,
+            "duplicate_sequences": self.duplicate_sequences,
+            "duplicate_leases": self.duplicate_leases,
+            "requeued_leases": self.requeued_leases,
+            "dropped_sequences": self.dropped_sequences,
+            "hosts_joined": self.hosts_joined,
+            "hosts_drained": self.hosts_drained,
+        }
+        state["extra"] = extra if extra is not None else {}
+        return state
+
+    def save_ledger(
+        self,
+        path: Optional[str] = None,
+        extra: Optional[Dict[str, Any]] = None,
+        keep_last: int = 2,
+    ) -> str:
+        """Persist :meth:`ledger_state` durably (write-new-then-rotate +
+        sha256 manifest + ``.prev`` fallback — ``genrl/ledger.py``).  The
+        PreemptionGuard safe-point calls this between rounds, so the saved
+        frame is always a consistent inter-step cut."""
+        p = path if path is not None else self.ledger_path
+        if p is None:
+            raise ValueError(
+                "SequenceLearner has no ledger path (pass one here or at "
+                "construction)"
+            )
+        state = self.ledger_state(extra=extra)
+        out = ledger_store.save_ledger(p, state, keep_last=keep_last)
+        logger.info(
+            "disagg ledger saved: epoch=%d open_leases=%d queued=%d gen=%d",
+            self.learner_epoch, len(state["open_leases"]),
+            len(state["queued_sequences"]), state["generation"],
+        )
+        return out
+
+    def _restore_ledger(self, path: str) -> None:
+        state = ledger_store.load_ledger(path)
+        self.learner_epoch = int(state.get("learner_epoch", 0)) + 1
+        with self._lease_lock:
+            self._next_task_id = int(state.get("next_task_id", 0))
+            for tid in state.get("completed_leases", []):
+                self._completed_leases[int(tid)] = None
+                self._restored_completed.add(int(tid))
+            for tid, k in state.get("completed_samples", []):
+                self._completed_samples[(int(tid), int(k))] = None
+            for tid, got in state.get("sample_counts", {}).items():
+                self._sample_counts[int(tid)] = int(got)
+            for hid, epochs in state.get("dedup_seen", {}).items():
+                self._dedup_seen[int(hid)] = OrderedDict(
+                    (int(e), int(s)) for e, s in epochs.items()
+                )
+                self._restored_dedup[int(hid)] = {
+                    int(e): int(s) for e, s in epochs.items()
+                }
+            # re-issue every lease that was open (on a host's lanes) or
+            # parked for reissue at save time — they keep their _task_id,
+            # so a pre-restart completion racing the reissue still counts
+            # exactly once through the restored completed-lease table
+            reissue = [
+                lease
+                for lease in (
+                    list(state.get("open_leases", []))
+                    + list(state.get("returned_leases", []))
+                )
+                if lease is not None
+            ]
+            self._returned.extend(reissue)
+            self.resumed_sequences_reissued = len(reissue)
+        with self._param_lock:
+            self.generation = int(state.get("generation", 0))
+            self._params = state.get("params")
+            self._quantized = None
+            gen_steps = {
+                int(g): int(s)
+                for g, s in state.get("gen_steps", {}).items()
+            }
+            self._gen_steps = gen_steps if gen_steps else {0: 0}
+            self._latest_learner_step = int(
+                state.get("latest_learner_step", 0)
+            )
+        requeued_seqs = 0
+        for seq in state.get("queued_sequences", []):
+            if isinstance(seq, dict) and "_t_q" in seq:
+                # the replay-wait stamp is a pre-restart monotonic reading;
+                # restart the dwell clock at restore
+                seq["_t_q"] = time.monotonic()
+            try:
+                self.sequences.put_nowait(seq)
+                requeued_seqs += 1
+            except queue.Full:
+                self.dropped_sequences += 1
+        counters = state.get("counters", {})
+        self.total_sequences = int(counters.get("total_sequences", 0))
+        self.duplicate_sequences = int(
+            counters.get("duplicate_sequences", 0)
+        )
+        self.duplicate_leases = int(counters.get("duplicate_leases", 0))
+        self.requeued_leases = int(counters.get("requeued_leases", 0))
+        self.dropped_sequences += int(counters.get("dropped_sequences", 0))
+        self.hosts_joined = int(counters.get("hosts_joined", 0))
+        self.hosts_drained = int(counters.get("hosts_drained", 0))
+        self.restored_extra = dict(state.get("extra", {}))
+        self._reissued_counter.inc(self.resumed_sequences_reissued)
+        telemetry.record_event(
+            "preemption_resume",
+            epoch=self.learner_epoch,
+            reissued=self.resumed_sequences_reissued,
+            queued=requeued_seqs,
+            generation=self.generation,
+            learner_step=self._latest_learner_step,
+        )
+        logger.info(
+            "disagg ledger restored: epoch=%d reissued=%d queued=%d gen=%d "
+            "step=%d",
+            self.learner_epoch, self.resumed_sequences_reissued,
+            requeued_seqs, self.generation, self._latest_learner_step,
+        )
+
     # -- lease accounting ------------------------------------------------
     def _next_lease(self) -> Optional[Any]:
         with self._lease_lock:
-            if self._returned:
-                return self._returned.popleft()
+            while self._returned:
+                lease = self._returned.popleft()
+                tid = (
+                    lease.get("_task_id") if isinstance(lease, dict) else None
+                )
+                if tid is not None and tid in self._completed_leases:
+                    # the original (or a retained-upload resend) closed
+                    # this lease while the reissue waited — handing it out
+                    # again would only decode a guaranteed duplicate
+                    continue
+                return lease
         return None if self._stop.is_set() else self.prompt_source()
 
     def _record_outstanding(self, conn: Connection, lease: Any) -> Any:
@@ -1341,6 +1630,13 @@ class SequenceLearner(ParamSnapshotPlane):
         epochs = self._dedup_seen.setdefault(int(hid), OrderedDict())
         last = epochs.get(epoch)
         if last is not None and sid <= last:
+            restored = self._restored_dedup.get(int(hid), {}).get(epoch)
+            if restored is not None and sid <= restored:
+                # dropped by a RESTORED key — a pre-restart upload
+                # redelivered to the resumed incarnation (the epoch
+                # dimension of the at-least-once key doing its job)
+                self.resumed_duplicates_dropped += 1
+                self._resume_dup_counter.inc()
             return True
         epochs[epoch] = sid if last is None else max(last, sid)
         epochs.move_to_end(epoch)
@@ -1377,15 +1673,35 @@ class SequenceLearner(ParamSnapshotPlane):
                     break
             with self._param_lock:
                 gen = self.generation
-            self.hub.send(conn, {"kind": "lease", "v": leases, "gen": gen})
+            self.hub.send(
+                conn,
+                {
+                    "kind": "lease",
+                    "v": leases,
+                    "gen": gen,
+                    "epoch": self.learner_epoch,
+                },
+            )
         elif kind == "params":
             with self._param_lock:
                 wire, gen = self._params, self.generation
                 snap_trace = self._snapshot_trace
             if wire is None or int(msg.get("have", -1)) == gen:
-                self.hub.send(conn, {"kind": "params", "generation": gen})
+                self.hub.send(
+                    conn,
+                    {
+                        "kind": "params",
+                        "generation": gen,
+                        "epoch": self.learner_epoch,
+                    },
+                )
             else:
-                reply = {"kind": "params", "generation": gen, "weights": wire}
+                reply = {
+                    "kind": "params",
+                    "generation": gen,
+                    "weights": wire,
+                    "epoch": self.learner_epoch,
+                }
                 tracing.inject(reply, snap_trace)
                 self.hub.send(conn, reply, compress=True)
         elif kind == "seq_batch":
@@ -1409,6 +1725,20 @@ class SequenceLearner(ParamSnapshotPlane):
                 "gen_host_join",
                 host=msg.get("host_id"),
                 lanes=msg.get("lanes"),
+            )
+            # the epoch handshake: a (re)joining host learns the learner's
+            # incarnation AND the current snapshot generation it must adopt
+            # before admitting work (a host that outlived a learner restart
+            # re-hellos here and re-synchronizes both)
+            with self._param_lock:
+                gen = self.generation
+            self.hub.send(
+                conn,
+                {
+                    "kind": "gen_welcome",
+                    "epoch": self.learner_epoch,
+                    "gen": gen,
+                },
             )
         elif kind == "lease_return":
             requeued = 0
@@ -1475,6 +1805,14 @@ class SequenceLearner(ParamSnapshotPlane):
                     ):
                         self.duplicate_leases += 1
                         dup = True
+                        # a reissue that raced past the close re-recorded
+                        # itself as outstanding — drop that zombie entry
+                        # so the lease table closes exactly (orphans == 0)
+                        entry = self._outstanding.pop(tid, None)
+                        if entry is not None:
+                            self._conn_leases.get(entry[0], set()).discard(
+                                tid
+                            )
                     else:
                         dup = False
                         self._completed_samples[(tid, k)] = None
@@ -1501,6 +1839,11 @@ class SequenceLearner(ParamSnapshotPlane):
                             self._sample_counts[tid] = got
                 if dup:
                     reg.counter("disagg.duplicate_leases").inc()
+                    if tid in self._restored_completed:
+                        # a lease closed before the restart completing
+                        # again after it (straggler host, reissue race)
+                        self.resumed_duplicates_dropped += 1
+                        self._resume_dup_counter.inc()
                     continue
                 seq["lease_id"] = tid
                 if total > 1:
@@ -1600,6 +1943,7 @@ class LocalGenerationFleet:
                     self.engine_factory,
                     host_id,
                 ),
+                kwargs={"reconnect": self._dial},
                 name=f"gen-host-{host_id}",
                 daemon=True,
             )
@@ -1629,7 +1973,10 @@ class LocalGenerationFleet:
         from scalerl_tpu.runtime import chaos
 
         inj = chaos.active()
-        armed = inj is not None and inj.plan.rates.get("mass_kill", 0.0) > 0
+        armed = inj is not None and (
+            inj.plan.rates.get("mass_kill", 0.0) > 0
+            or inj.plan.rates.get("preempt", 0.0) > 0
+        )
         if armed and self.auto_chaos and not self.use_threads:
             self._supervisor = threading.Thread(
                 target=self._supervise, name="disagg-supervisor", daemon=True
@@ -1645,13 +1992,43 @@ class LocalGenerationFleet:
             added += 1
         return added
 
+    def _dial(self) -> Connection:
+        """Thread-mode reconnect seam: a host that lost its uplink redials
+        the CURRENT learner — which a preemption harness may have swapped
+        for a restarted one via :meth:`adopt_learner`.  Raises
+        ``ConnectionError`` while no learner is accepting; the host's
+        capped backoff owns the retry cadence."""
+        import multiprocessing as mp
+
+        with self._scale_lock:
+            learner = self.learner
+        if learner is None or learner.stopped:
+            raise ConnectionError("no live learner to dial")
+        parent, child = mp.Pipe(duplex=True)
+        learner.add_host_connection(PipeConnection(parent))
+        return PipeConnection(child)
+
+    def adopt_learner(self, learner: SequenceLearner) -> None:
+        """Point the reconnect seam at a restarted learner: surviving
+        hosts redial into it, the ``gen_welcome`` handshake hands them the
+        new epoch + snapshot generation, and their retained uploads resend
+        into the restored dedup tables."""
+        with self._scale_lock:
+            self.learner = learner
+
     def chaos_poll(self) -> List[int]:
-        """One seeded preemption-wave draw against the live host procs."""
+        """One seeded preemption-wave draw against the live host procs:
+        a ``mass_kill`` wave plus (independently seeded) one ``preempt``
+        single-victim SIGTERM."""
         if self.use_threads:
             return []
-        from scalerl_tpu.fleet.cluster import apply_mass_kill
+        from scalerl_tpu.fleet.cluster import apply_mass_kill, apply_preempt
 
-        return apply_mass_kill(self.procs, site="disagg")
+        killed = apply_mass_kill(self.procs, site="disagg")
+        victim = apply_preempt(self.procs, site="disagg")
+        if victim is not None and victim not in killed:
+            killed.append(victim)
+        return killed
 
     def _supervise(self) -> None:
         while not self._stopping.wait(self.chaos_poll_interval_s):

@@ -72,6 +72,7 @@ KINDS = FRAME_KINDS + (
     "grad_nan",        # NaN planted in the training batch
     "grad_inf",        # Inf planted in the training batch
     "mass_kill",       # K fleet peers SIGTERMed in one window (spot wave)
+    "preempt",         # ONE peer SIGTERMed at a site (single spot reclaim)
 )
 
 _UNLIMITED = 1 << 62
@@ -276,6 +277,23 @@ class FaultInjector:
             g = self._gen("mass_kill", site)
             victims = sorted(int(i) for i in g.choice(n_peers, size=k, replace=False))
         return victims
+
+    def preempt_victim(self, n_peers: int, site: str = "fleet") -> Optional[int]:
+        """One seeded single-preemption draw: when the ``preempt`` stream
+        fires, return the index (into the caller's list of ``n_peers`` live
+        peers) of the ONE peer to SIGTERM; None = no preemption.
+
+        ``mass_kill`` models a spot *wave*; ``preempt`` models the scheduler
+        reclaiming a single worker — the learner, one generation host, or a
+        serving replica — mid-run.  Sites distinguish the tier
+        (``"learner"``, ``"disagg"``, ``"router"``), and the victim choice
+        draws from the same per-(kind, site) stream as the fire decision so
+        the same seed preempts the same peer.
+        """
+        if n_peers <= 0 or not self.decide("preempt", site):
+            return None
+        with self._lock:
+            return int(self._gen("preempt", site).integers(0, n_peers))
 
     # -- shm ring slots ------------------------------------------------
     def tear_slot(self, payload, site: str = "shm_ring") -> bool:

@@ -459,6 +459,30 @@ class PreemptionGuard:
         self._prev.clear()
         self._installed = False
 
+    def simulate(self, signum: int = signal.SIGTERM) -> None:
+        """Trip the guard as if ``signum`` arrived — the chaos ``preempt``
+        hook and the threads that cannot own signal handlers use this, so
+        every consumer sees one shape of preemption: the flag."""
+        self._handler(signum, None)
+
+    def poll_chaos(self, site: str) -> bool:
+        """One seeded ``preempt`` draw at a safe point.  When the stream
+        fires, the preemption is delivered as a REAL ``SIGTERM`` to this
+        process when our handler is installed (the seeded fault walks the
+        genuine signal path), or via :meth:`simulate` otherwise.  Returns
+        ``triggered`` either way, so loops can write
+        ``if guard.poll_chaos("learner"): save_and_exit()``."""
+        if not self._event.is_set():
+            from scalerl_tpu.runtime import chaos
+
+            inj = chaos.active()
+            if inj is not None and inj.preempt_victim(1, site=site) is not None:
+                if self._installed:
+                    signal.raise_signal(signal.SIGTERM)
+                else:
+                    self.simulate()
+        return self._event.is_set()
+
     def __enter__(self) -> "PreemptionGuard":
         return self.install()
 

@@ -247,6 +247,10 @@ class ReplicaHandle:
         self.server = server
         self.alive = True
         self.generation = 0
+        # the learner incarnation whose params this replica serves (set by
+        # the router's own pushes): generations only compare within the
+        # epoch-qualified order (epoch, generation)
+        self.epoch = 0
         self.p95_ms = 0.0
         self.shed_total = 0
         self.pending = 0
@@ -335,7 +339,15 @@ class ServingRouter:
         self._health: Dict[str, ReplicaHealth] = {}
         self._liveness = LivenessTracker()
         self._reader_threads: Dict[str, threading.Thread] = {}
-        self._last_push: Optional[Tuple[Any, Optional[int]]] = None
+        self._last_push: Optional[
+            Tuple[Any, Optional[int], int]
+        ] = None
+        # newest learner epoch ever rolled out through this router: a
+        # rollout from an OLDER epoch (a zombie pre-restart learner racing
+        # its restarted successor) is refused, so rolling restarts can
+        # never re-serve a stale generation
+        self.learner_epoch = 0
+        self.stale_rollouts = 0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listen_sock = None
@@ -397,6 +409,10 @@ class ServingRouter:
             replica.send({"kind": "router_hello", "req": f"hello:{replica.name}"})
         except (ConnectionError, OSError, ValueError):
             self._on_replica_down(replica, "hello failed")
+        # a late-joining replica adopts the newest rolled-out snapshot
+        # (epoch-qualified) BEFORE taking traffic — otherwise the skew /
+        # epoch guards would hold it out of rotation forever anyway
+        self._catch_up(replica)
         telemetry.record_event("router_replica_added", replica=replica.name)
 
     def remove_replica(
@@ -547,6 +563,11 @@ class ServingRouter:
                 if r.name not in exclude and r.alive
                 # mid-rollout laggards are held out until caught up
                 and fleet_max - r.generation <= self.config.max_gen_skew
+                # a pushable replica still on a pre-restart learner epoch
+                # serves stale weights by definition — held out until
+                # _catch_up rolls it forward (wire-only replicas track
+                # generations through their own reports instead)
+                and (r.server is None or r.epoch >= self.learner_epoch)
             ]
             # probe-due ejected replicas take the next request as their ONE
             # trial per window — the flag is consumed here, exactly when the
@@ -788,12 +809,42 @@ class ServingRouter:
         while replica.inflight_count() > 0 and time.monotonic() < deadline:
             time.sleep(0.002)
 
-    def rollout(self, params: Any, learner_step: Optional[int] = None) -> int:
+    def rollout(
+        self,
+        params: Any,
+        learner_step: Optional[int] = None,
+        learner_epoch: Optional[int] = None,
+    ) -> int:
         """Rolling weight rollout: one replica at a time, drain -> push ->
         re-admit — in-flight traffic keeps flowing through the others, and
         the ``max_gen_skew`` guard bounds how far the fleet can diverge
-        mid-roll.  Returns the fleet's max generation after the roll."""
-        self._last_push = (params, learner_step)
+        mid-roll.  Returns the fleet's max generation after the roll.
+
+        ``learner_epoch`` (when the caller rides the preemption-tolerant
+        plane) orders rollouts ACROSS learner restarts: a push from an
+        older epoch than the newest ever seen is a zombie pre-restart
+        learner racing its successor and is refused outright — the
+        epoch-qualified order (epoch, generation) is what "never serve a
+        stale generation through a rolling restart" means."""
+        if learner_epoch is not None:
+            epoch = int(learner_epoch)
+            if epoch < self.learner_epoch:
+                self.stale_rollouts += 1
+                telemetry.record_event(
+                    "router_stale_rollout",
+                    epoch=epoch,
+                    current=self.learner_epoch,
+                )
+                logger.warning(
+                    "router: refused rollout from stale learner epoch %d "
+                    "(current %d)", epoch, self.learner_epoch,
+                )
+                return max(
+                    (r.generation for r in self.replicas if r.alive),
+                    default=0,
+                )
+            self.learner_epoch = epoch
+        self._last_push = (params, learner_step, self.learner_epoch)
         self.rollouts += 1
         for replica in list(self.replicas):
             if not replica.alive or replica.server is None:
@@ -809,6 +860,7 @@ class ServingRouter:
                 self._redispatch_inflight(replica)
             gen = replica.server.push_params(params, learner_step=learner_step)
             replica.generation = max(replica.generation, int(gen))
+            replica.epoch = max(replica.epoch, self.learner_epoch)
             if in_rotation:
                 # an EJECTED replica gets the push (generations stay
                 # aligned) but NOT a free pass back into rotation — only
@@ -823,17 +875,20 @@ class ServingRouter:
         return fleet_max
 
     def _catch_up(self, replica: ReplicaHandle) -> None:
-        """A re-admitted laggard gets the newest rolled-out params: pushes
-        repeat until its generation counter reaches the fleet max, so the
-        skew guard releases it back into rotation."""
+        """A re-admitted (or late-joining) laggard gets the newest
+        rolled-out params: pushes repeat until its epoch-qualified
+        (epoch, generation) reaches the fleet max, so the skew guard
+        releases it back into rotation — a replica that slept through a
+        learner restart cannot re-enter serving pre-restart weights."""
         if replica.server is None or self._last_push is None:
             return
-        params, step = self._last_push
+        params, step, epoch = self._last_push
         with self._lock:
             fleet_max = max((r.generation for r in self.replicas), default=0)
-        while replica.generation < fleet_max:
+        while (replica.epoch, replica.generation) < (epoch, fleet_max):
             gen = replica.server.push_params(params, learner_step=step)
             replica.generation = max(replica.generation, int(gen))
+            replica.epoch = max(replica.epoch, epoch)
 
     # -- observability ---------------------------------------------------
     def replica_count(self) -> int:
@@ -867,6 +922,7 @@ class ServingRouter:
         with self._lock:
             inflight = len(self._pending)
             gens = [r.generation for r in self.replicas if r.alive]
+            epochs = [r.epoch for r in self.replicas if r.alive]
         return {
             "admitted": self.admitted,
             "answered": self.answered,
@@ -883,6 +939,9 @@ class ServingRouter:
             "healthy": self.healthy_count(),
             "generation_max": max(gens, default=0),
             "generation_min": min(gens, default=0),
+            "learner_epoch": self.learner_epoch,
+            "epoch_min": min(epochs, default=0),
+            "stale_rollouts": self.stale_rollouts,
         }
 
 

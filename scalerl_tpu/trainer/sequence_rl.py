@@ -23,6 +23,8 @@ other trainer families.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from contextlib import nullcontext
@@ -33,7 +35,13 @@ import numpy as np
 
 from scalerl_tpu.agents.token_ppo import TokenPPOAgent
 from scalerl_tpu.config import GenRLArguments
-from scalerl_tpu.data.sequence_replay import seq_add, seq_init, seq_sample
+from scalerl_tpu.data.sequence_replay import (
+    seq_add,
+    seq_export,
+    seq_import,
+    seq_init,
+    seq_sample,
+)
 from scalerl_tpu.genrl.continuous import ContinuousConfig, ContinuousEngine
 from scalerl_tpu.genrl.engine import GenerationConfig, GenerationEngine
 from scalerl_tpu.genrl.rollout import (
@@ -470,6 +478,15 @@ class DisaggSequenceRLTrainer:
     the wire, lease/ack/dedup, and snapshot protocol all still flow, with
     no per-host jax process spin-up; ``False`` spawns real host processes
     (the chaos/soak shape).
+
+    Preemption tolerance (docs/DISTRIBUTED.md "Preemption & elastic
+    membership"): with ``ledger_dir`` set, the trainer rides the durable
+    learner ledger — a :class:`~scalerl_tpu.runtime.supervisor.
+    PreemptionGuard` safe-point between rounds turns SIGTERM into
+    :meth:`save_resume` (full learner accounting plane + replay contents +
+    agent weights + lease cursor/RNG in ONE crash-safe frame), and the
+    next construction against the same ``ledger_dir`` resumes at the same
+    learn step under a bumped learner epoch.
     """
 
     def __init__(
@@ -479,6 +496,8 @@ class DisaggSequenceRLTrainer:
         agent: Optional[TokenPPOAgent] = None,
         engine_factory: Optional[Any] = None,
         use_threads: bool = True,
+        ledger_dir: Optional[str] = None,
+        guard: Optional[Any] = None,
     ) -> None:
         from scalerl_tpu.genrl.disagg import (
             DisaggConfig,
@@ -542,11 +561,25 @@ class DisaggSequenceRLTrainer:
         self._lease_rng = np.random.default_rng(args.seed + 2)
         self._lease_lock = threading.Lock()
         self._lease_seq = 0
-        self.learner = SequenceLearner(self.config, self._next_lease)
-        self.learner.start()
-        self.learner.publish(
-            self._to_host(self.agent.get_weights()), learner_step=0
+        self.guard = guard
+        ledger_dir = ledger_dir or getattr(args, "disagg_ledger_dir", "")
+        self.ledger_path = (
+            os.path.join(ledger_dir, "learner_ledger") if ledger_dir else None
         )
+        self.learner = SequenceLearner(
+            self.config, self._next_lease, ledger_path=self.ledger_path
+        )
+        self.learn_steps = 0
+        self.reward_history: List[float] = []
+        if self.learner.restored_extra is not None:
+            self._adopt_restored(self.learner.restored_extra)
+        self.learner.start()
+        if self.learner.generation == 0:
+            # fresh start only: a restored learner already holds the wire
+            # snapshot (and generation counter) its hosts must adopt
+            self.learner.publish(
+                self._to_host(self.agent.get_weights()), learner_step=0
+            )
         self.fleet = LocalGenerationFleet(
             self.learner,
             self.config,
@@ -554,12 +587,54 @@ class DisaggSequenceRLTrainer:
             use_threads=use_threads,
         )
         self.fleet.start()
-        self.learn_steps = 0
         reg = telemetry.get_registry()
         self._learn_meter = reg.meter("genrl.learn_steps_per_s")
         self._reward_gauge = reg.gauge("genrl.mean_reward")
         self._pad_gauge = reg.gauge("genrl.pad_ratio")
-        self.reward_history: List[float] = []
+
+    def _adopt_restored(self, extra: Dict[str, Any]) -> None:
+        """Rebuild the trainer half of a preempted run from the ledger's
+        ``extra`` tree: learn step, replay contents, agent weights, the
+        lease cursor + RNG (so resumed prompt leases continue the exact
+        pre-restart sequence), and the reward history."""
+        self.learn_steps = int(extra.get("learn_steps", 0))
+        self._lease_seq = int(extra.get("lease_seq", 0))
+        rng_state = extra.get("lease_rng")
+        if rng_state:
+            # PCG64 state words are 128-bit — they ride the ledger as a
+            # JSON string, not codec ints
+            self._lease_rng.bit_generator.state = json.loads(rng_state)
+        if "replay" in extra:
+            self.replay = seq_import(extra["replay"])
+        if "agent" in extra:
+            self.agent.set_weights(jax.device_put(extra["agent"]))
+        self.reward_history = [
+            float(r) for r in extra.get("reward_history", [])
+        ]
+        logger.info(
+            "disagg trainer resumed at learn step %d (epoch %d, "
+            "%d leases reissued)",
+            self.learn_steps, self.learner.learner_epoch,
+            self.learner.resumed_sequences_reissued,
+        )
+
+    def save_resume(self) -> Optional[str]:
+        """The PreemptionGuard safe-point action: stop the plane and
+        persist learner ledger + trainer state as one crash-safe frame
+        (write-new-then-rotate + sha256 manifest).  Returns the ledger
+        path, or None when no ``ledger_dir`` is configured."""
+        self.learner.stop()
+        if self.ledger_path is None:
+            return None
+        extra = {
+            "learn_steps": self.learn_steps,
+            "lease_seq": self._lease_seq,
+            "lease_rng": json.dumps(self._lease_rng.bit_generator.state),
+            "reward_history": [float(r) for r in self.reward_history],
+            "replay": seq_export(self.replay),
+            "agent": self._to_host(self.agent.get_weights()),
+        }
+        return self.learner.save_ledger(self.ledger_path, extra=extra)
 
     def _dispatch_guard(self):
         """Serialize multi-device dispatch when the agent is meshed (the
@@ -681,6 +756,20 @@ class DisaggSequenceRLTrainer:
         metrics: Dict[str, float] = {}
         try:
             for _ in range(rounds):
+                if self.guard is not None and self.guard.poll_chaos(
+                    "learner"
+                ):
+                    # the safe-point: SIGTERM (real, or the chaos plan's
+                    # seeded preempt draw) landed — save the full plane
+                    # between rounds and exit; the next construction
+                    # against the same ledger_dir resumes this step
+                    telemetry.record_event(
+                        "preemption_exit",
+                        plane="disagg",
+                        step=self.learn_steps,
+                    )
+                    self.save_resume()
+                    break
                 metrics = self.train_round()
         finally:
             self.close()
@@ -689,6 +778,7 @@ class DisaggSequenceRLTrainer:
         summary["final_reward_mean"] = float(np.mean(tail)) if tail else 0.0
         summary["rounds"] = float(len(self.reward_history))
         summary["wire_sequences"] = float(self.learner.total_sequences)
+        summary["learn_steps"] = float(self.learn_steps)
         return summary
 
     def close(self) -> None:

@@ -136,6 +136,8 @@ def test_per_add_with_priorities_enters_distribution():
     assert np.all(np.isfinite(np.asarray(batch["weights"])))
 
 
+@pytest.mark.slow  # ~9 s learning curve — same convention as the other cartpole solves;
+# apex mechanics stay in the fold/priority/PER units + resume round-trip
 def test_apex_trainer_e2e_learns_cartpole(tmp_path):
     args = _args(
         max_timesteps=6000,
@@ -168,6 +170,8 @@ def test_apex_trainer_e2e_learns_cartpole(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.slow  # ~8 s mesh e2e; sharded PER mechanics stay tier-1-covered by
+# tests/test_sharded_replay.py parity units (ISSUE 19 buy-back)
 def test_apex_sharded_replay_mesh_e2e(tmp_path):
     """Pod-shape Ape-X: dp/fsdp-meshed learner + lane-sharded PER (the
     BASELINE "replay sharded across TPU HBM" row) trains end to end, with

@@ -173,6 +173,66 @@ def test_mass_kill_spec_roundtrip():
     assert ChaosPlan.parse(plan.spec()) == plan
 
 
+def test_preempt_spec_roundtrip_and_victim_determinism():
+    """ISSUE 19: the ``preempt`` kind (single spot reclaim) parses through
+    the grammar, draws deterministically (same seed -> same victim), and
+    honors the @max occurrence cap and per-site stream isolation."""
+    plan = ChaosPlan.parse("9:preempt=0.5@2")
+    assert plan.rates["preempt"] == 0.5
+    assert plan.limits["preempt"] == 2
+    assert ChaosPlan.parse(plan.spec()) == plan
+    hot = ChaosPlan(seed=21, rates={"preempt": 1.0}, limits={"preempt": 1})
+    a, b = FaultInjector(hot), FaultInjector(hot)
+    va, vb = a.preempt_victim(4), b.preempt_victim(4)
+    assert va == vb and va is not None and 0 <= va < 4
+    # the @1 limit: a second draw never fires
+    assert a.preempt_victim(4) is None
+    # sites draw from independent streams but stay deterministic per seed
+    c, d = FaultInjector(hot), FaultInjector(hot)
+    assert c.preempt_victim(4, site="learner") == d.preempt_victim(
+        4, site="learner"
+    )
+    # rate 0 never fires
+    assert FaultInjector(ChaosPlan(seed=1)).preempt_victim(4) is None
+
+
+def test_apply_preempt_terminates_exactly_one_live_proc(monkeypatch):
+    """``apply_preempt``: one seeded draw SIGTERMs exactly ONE alive proc
+    (dead slots are never re-killed), records the ``preempt`` event, and is
+    a zero-cost no-op with no injector."""
+    from scalerl_tpu.fleet.cluster import apply_preempt
+    from scalerl_tpu.runtime import telemetry
+
+    class _Proc:
+        def __init__(self, alive=True):
+            self.alive = alive
+            self.terminated = 0
+
+        def is_alive(self):
+            return self.alive
+
+        def terminate(self):
+            self.terminated += 1
+            self.alive = False
+
+    monkeypatch.setenv(chaos.ENV_VAR, "17:preempt=1.0@1")
+    chaos.clear()
+    try:
+        procs = [_Proc(), _Proc(alive=False), _Proc()]
+        victim = apply_preempt(procs, site="test")
+        assert victim in (0, 2)  # never the dead slot
+        assert sum(p.terminated for p in procs) == 1
+        assert procs[victim].terminated == 1
+        events = telemetry.get_recorder().events("preempt")
+        assert events and events[-1]["victim"] == victim
+        # the @1 cap is spent: the next draw is a no-op
+        assert apply_preempt(procs, site="test") is None
+    finally:
+        monkeypatch.delenv(chaos.ENV_VAR)
+        chaos.clear()
+    assert apply_preempt([_Proc()]) is None  # no injector -> no-op
+
+
 def test_env_var_activation_and_clear(monkeypatch):
     monkeypatch.setenv(chaos.ENV_VAR, "9:frame_dup=1.0")
     chaos.clear()

@@ -625,6 +625,8 @@ def test_push_params_flushes_prefix_cache(setup):
     )
 
 
+@pytest.mark.slow  # ~12 s churn soak; aliasing/identity mechanics stay tier-1-covered by
+# the paging churn invariant + group-submit identity tests (ISSUE 19 buy-back)
 def test_churn_grouped_admits_evictions_no_aliasing_token_identity():
     """Satellite: 300 churn steps mixing grouped admits, prefix hits,
     mid-group EOS, param-push flushes, and LRU evictions over a tight

@@ -346,6 +346,8 @@ def _make_loop_mode(iter_mode, iters_per_call=2, T=4, B=4):
     return loop, agent
 
 
+@pytest.mark.slow  # ~8 s; iter-mode parity stays tier-1-covered by test_run_parity_k1_vs_k3
+# + the engine-level scan/unroll parity in test_genrl (ISSUE 19 buy-back)
 def test_iter_mode_scan_unroll_parity():
     """The unrolled chunk body is the same math as the scanned one: same
     final params and same per-chunk metric stream."""
@@ -410,6 +412,9 @@ def test_anakin_parity_with_chunked_driver():
     assert m_ana["chunks_done"] == float(num_calls)
 
 
+@pytest.mark.slow  # ~16 s; transfer discipline stays tier-1-covered by
+# test_run_steady_state_is_transfer_guarded_with_one_transfer_per_chunk
+# + test_anakin_parity_with_chunked_driver (ISSUE 19 buy-back)
 def test_anakin_one_dispatch_one_transfer_under_guard(monkeypatch):
     """The Anakin invariant, all three halves: N chunks cost ONE batched
     device->host transfer, the warm path runs under the armed

@@ -99,6 +99,8 @@ def test_c51_dqn_smoke(tmp_path):
     train_envs.close()
 
 
+@pytest.mark.slow  # ~9 s composition e2e; each component keeps its own fast smoke
+# (dqn/per_nstep/c51) in tier-1 (ISSUE 19 buy-back)
 def test_rainbow_all_components_compose(tmp_path):
     """The full Rainbow assembly — double + dueling + noisy + C51 + PER +
     3-step — trains end to end through one config; the components the

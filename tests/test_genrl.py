@@ -429,6 +429,8 @@ def test_genrl_e2e_token_ppo_improves_reward():
     assert summary["staleness"] <= 2.0  # push-per-step keeps lag bounded
 
 
+@pytest.mark.slow  # ~10 s; mp-sharding parity stays tier-1-covered by
+# test_transformer_sharded_matches_unsharded + the fast genrl rounds
 def test_genrl_trainer_sharded_mp2_round():
     """The learn step rides the dp×mp sharded plane off the args alone:
     mp=2 lays the transformer's mlp/heads over the mp axis and a round

@@ -1423,6 +1423,35 @@ GOOD_JG008_SPAN_ESCAPES = """
         self._open[key] = span          # handed off; ended elsewhere
 """
 
+BAD_JG008_POOL = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Pump:
+        def start(self):
+            self._pool = ThreadPoolExecutor(max_workers=4)
+            self._pool.submit(self._run)
+"""
+
+GOOD_JG008_POOL_SHUTDOWN = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Pump:
+        def start(self):
+            self._pool = ThreadPoolExecutor(max_workers=4)
+            self._pool.submit(self._run)
+
+        def stop(self):
+            self._pool.shutdown(wait=True)
+"""
+
+GOOD_JG008_POOL_MANAGED = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fan_out(tasks):
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            return [f.result() for f in [pool.submit(t) for t in tasks]]
+"""
+
 
 def test_jg008_non_daemon_thread_without_join_flags():
     findings = lint_many([("scalerl_tpu/runtime/fixture.py", BAD_JG008_THREAD)])
@@ -1474,6 +1503,21 @@ def test_jg008_dropped_span_flags():
 def test_jg008_ended_or_escaping_span_is_clean():
     for src in (GOOD_JG008_SPAN_ENDED, GOOD_JG008_SPAN_ESCAPES):
         assert lint_many([("scalerl_tpu/genrl/fixture.py", src)]) == []
+
+
+def test_jg008_unmanaged_pool_without_shutdown_flags():
+    findings = lint_many([("scalerl_tpu/trainer/fixture.py", BAD_JG008_POOL)])
+    assert rules_of(findings) == ["JG008"]
+    assert "shutdown" in findings[0].message
+
+
+def test_jg008_pool_with_shutdown_or_with_managed_is_clean():
+    for src in (GOOD_JG008_POOL_SHUTDOWN, GOOD_JG008_POOL_MANAGED):
+        assert lint_many([("scalerl_tpu/trainer/fixture.py", src)]) == []
+
+
+def test_jg008_pool_rule_is_hot_dir_scoped():
+    assert lint_many([("scalerl_tpu/models/fixture.py", BAD_JG008_POOL)]) == []
 
 
 # -- JG009 — telemetry-catalog drift ----------------------------------------

@@ -159,6 +159,8 @@ def test_impact_first_update_ratio_is_one():
 # composition with the sharded learner plane
 
 
+@pytest.mark.slow  # ~8 s; impact mechanics stay in its fast units, mp-sharding parity in
+# test_transformer_sharded_matches_unsharded (ISSUE 19 buy-back)
 def test_impact_transformer_sharded_learner():
     """IMPACT + transformer + dp×mp: the heavier sharded learn step with
     the replay counterweight, end to end on the virtual mesh."""

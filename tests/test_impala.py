@@ -345,6 +345,8 @@ def test_impala_bfloat16_compute_dtype():
     assert all(m == m for m in metrics.values())  # finite
 
 
+@pytest.mark.slow  # ~11 s; dtype plumbing tier-1-covered by test_bf16_params_with_fp32_opt_state
+# + the fp32 fused loop in test_parallel (ISSUE 19 buy-back)
 def test_impala_bfloat16_fused_device_loop():
     """The bench's accelerator config — bf16 torso inside the fused
     env+inference+V-trace loop (bench.py sets compute_dtype='bfloat16'

@@ -405,6 +405,8 @@ def test_agent_learn_dispatches_on_layout_one_batched_transfer(monkeypatch):
     assert np.isfinite(m_pad["total_loss"])
 
 
+@pytest.mark.slow  # ~15 s learning curve; packed mechanics stay tier-1-covered by the
+# packed-vs-padded parity + test_disagg_trainer_packed_round (ISSUE 19 buy-back)
 def test_trainer_packed_e2e_improves_reward_and_pad_gauge():
     """SequenceRLTrainer with learner_packing LEARNS: recall reward
     climbs well off random over a short run (the padded e2e's packed
@@ -430,6 +432,10 @@ def test_trainer_packed_e2e_improves_reward_and_pad_gauge():
     assert last > first + 0.2, (first, last)
 
 
+@pytest.mark.slow  # ~21 s; packed-layout dispatch stays tier-1-covered by
+# test_agent_learn_dispatches_on_layout_one_batched_transfer + the
+# packed-vs-padded parity units; disagg rounds by test_disagg
+# (ISSUE 19 tier-1 budget buy-back)
 def test_disagg_trainer_packed_round():
     """DisaggSequenceRLTrainer rides learner_packing identically: wire
     layouts unchanged, learner consumes packed rows."""

@@ -76,6 +76,8 @@ def test_flash_bfloat16_inputs():
     )
 
 
+@pytest.mark.slow  # ~14 s; kernel correctness stays tier-1-covered by the
+# flash-vs-full fwd/grad oracles above (ISSUE 19 buy-back)
 def test_flash_in_transformer_policy():
     """The kernel drops into TransformerPolicy's attn_fn seam and trains."""
     from scalerl_tpu.models.transformer import TransformerPolicy

@@ -15,6 +15,8 @@ jax-free: these run in tier-1 for pennies.
 
 import json
 import sys
+
+import pytest
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
@@ -161,6 +163,8 @@ def test_bench_history_values_group_shape(tmp_path, monkeypatch):
     ) == [55000.0]
 
 
+@pytest.mark.slow  # ~22 s in-process bench; test_genrl_bench_artifact_schema keeps the
+# schema/gate machinery tier-1-covered (ISSUE 19 tier-1 budget buy-back)
 def test_sharded_bench_artifact_schema():
     """bench --mode sharded artifacts carry the like-for-like comparison
     keys the gate needs: mode, mesh, params_total, params_per_chip."""
@@ -392,6 +396,8 @@ def test_perf_gate_gated_fields_like_for_like(tmp_path, monkeypatch):
     assert m == ""
 
 
+@pytest.mark.slow  # ~28 s in-process bench; schema machinery tier-1-covered by
+# test_genrl_bench_artifact_schema (ISSUE 19 tier-1 budget buy-back)
 def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     """bench --mode genrl --continuous artifacts carry the like-for-like
     acceptance comparison (cohort rate + speedup in the SAME artifact) and
@@ -447,6 +453,7 @@ def test_genrl_continuous_bench_artifact_schema(capsys, monkeypatch):
     assert 0.0 < result["learn_pad_ratio"] < 1.0
 
 
+@pytest.mark.slow  # ~14 s in-process bench; same buy-back as the continuous schema test
 def test_genrl_continuous_group_bench_artifact_schema(capsys, monkeypatch):
     """The BENCH_GENRL_GROUP shape (ISSUE 14): every arrival fans into
     n=4 lanes via submit_group, the artifact carries group=n for the
@@ -481,6 +488,8 @@ def test_genrl_continuous_group_bench_artifact_schema(capsys, monkeypatch):
     assert result["prefix_hit_rate"] >= 0.0
 
 
+@pytest.mark.slow  # ~17 s in-process bench; schema/gate machinery tier-1-covered by
+# test_genrl_bench_artifact_schema (ISSUE 19 tier-1 budget buy-back)
 def test_disagg_bench_artifact_schema(capsys, monkeypatch):
     """bench --mode disagg artifacts carry the disaggregated-dataflow
     headline (end-to-end sequences/s through the wire) plus the

@@ -132,6 +132,8 @@ def test_hetero_pipeline_transformer_pp4_matches_single_device():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~9 s; pipeline correctness stays tier-1-covered by
+# test_hetero_pipeline_transformer_pp4_matches_single_device
 def test_hetero_pipeline_bubble_schedule_is_tight():
     """Bubble accounting: the GPipe schedule runs exactly M + S - 1 steps —
     with one step fewer the last microbatch never reaches the head, so the

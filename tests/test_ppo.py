@@ -206,11 +206,11 @@ def test_ppo_recurrent_lane_minibatching():
     """LSTM policy: minibatches slice env lanes (full sequences) including
     the entering core state, so the recurrent carry stays lane-aligned."""
     args = _args(use_lstm=True, hidden_size=32, num_minibatches=2, ppo_epochs=2)
-    agent = PPOAgent(args, obs_shape=(16, 16, 4), num_actions=3, obs_dtype=jnp.uint8)
+    agent = PPOAgent(args, obs_shape=(8, 8, 4), num_actions=3, obs_dtype=jnp.uint8)
     T, B = 4, 4
     core = agent.initial_state(B)
     traj = Trajectory(
-        obs=jnp.zeros((T + 1, B, 16, 16, 4), jnp.uint8),
+        obs=jnp.zeros((T + 1, B, 8, 8, 4), jnp.uint8),
         action=jnp.zeros((T + 1, B), jnp.int32),
         reward=jnp.ones((T + 1, B), jnp.float32),
         done=jnp.zeros((T + 1, B), jnp.bool_),

@@ -1,0 +1,526 @@
+"""Preemption-tolerant sequence-RL plane (ISSUE 19).
+
+Covers the durable learner ledger (bit-exact codec-v2 round-trip under the
+sha256 manifest, tamper detection, ``.prev`` fallback), the learner-epoch
+handshake (``gen_welcome``, epoch-stamped replies, resume-dup accounting),
+the :class:`PreemptionGuard` chaos hook, the full learner-kill/restart e2e
+with EXACT ledger accounting (accepted == uploaded − duplicates, zero
+orphaned leases), a host killed during the learner restart, and the
+trainer-level ``save_resume`` / ``_adopt_restored`` round-trip (replay
+contents, agent weights, lease RNG, monotonic learn step).
+"""
+
+import json
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalerl_tpu.fleet.transport import PipeConnection
+from scalerl_tpu.genrl import ledger as ledger_store
+from scalerl_tpu.genrl.disagg import (
+    DisaggConfig,
+    LocalGenerationFleet,
+    ScriptedEngineFactory,
+    SequenceLearner,
+    scripted_sequence_payload,
+)
+from scalerl_tpu.runtime import chaos, telemetry
+from scalerl_tpu.runtime.supervisor import PreemptionGuard
+
+
+def _lease_source(n_leases, start=1):
+    counter = {"i": start - 1}
+    lock = threading.Lock()
+
+    def source():
+        with lock:
+            if counter["i"] >= start - 1 + n_leases:
+                return None
+            counter["i"] += 1
+            return {"seed": counter["i"], "length": 4}
+
+    return source
+
+
+def _collect(learner_ref, n, deadline_s=60.0):
+    """Drain ``n`` sequences; ``learner_ref`` is a zero-arg callable so the
+    consumer can follow a learner swap mid-drain (the restart shape)."""
+    seqs = []
+    deadline = time.monotonic() + deadline_s
+    while len(seqs) < n and time.monotonic() < deadline:
+        s = learner_ref().get_sequence(timeout=0.2)
+        if s is not None:
+            seqs.append(s)
+    return seqs
+
+
+def _weights():
+    rng = np.random.default_rng(0)
+    return {"w": rng.standard_normal((16, 8)).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# the durable ledger (jax-free, rides the sha256-manifest idiom)
+
+
+def test_ledger_roundtrip_bit_exact(tmp_path):
+    """save -> (simulated SIGTERM: nothing but the files survive) ->
+    restore is bit-exact for every codec-v2 shape the learner stores:
+    numpy arrays (dtype-preserving), int-keyed dicts, nested containers."""
+    path = str(tmp_path / "ledger")
+    rng = np.random.default_rng(7)
+    state = {
+        "format": 1,
+        "learner_epoch": 3,
+        "arr_f32": rng.standard_normal((5, 3)).astype(np.float32),
+        "arr_i64": rng.integers(0, 2**40, size=7),
+        "int_keyed": {0: 17, 42: {11: np.arange(4, dtype=np.int32)}},
+        "leases": [
+            {"seed": 1, "_task_id": 9, "prompt": np.arange(6, dtype=np.int32)}
+        ],
+        "scalars": {"pi": 3.140625, "n": -12, "flag": True, "none": None},
+    }
+    out = ledger_store.save_ledger(path, state)
+    assert out == os.path.abspath(path)
+    assert os.path.exists(os.path.join(path, ledger_store.LEDGER_FILE))
+    assert os.path.exists(os.path.join(path, ledger_store.MANIFEST_NAME))
+    back = ledger_store.load_ledger(path)
+    assert back["learner_epoch"] == 3
+    np.testing.assert_array_equal(back["arr_f32"], state["arr_f32"])
+    assert back["arr_f32"].dtype == np.float32
+    np.testing.assert_array_equal(back["arr_i64"], state["arr_i64"])
+    assert back["int_keyed"][0] == 17
+    np.testing.assert_array_equal(
+        back["int_keyed"][42][11], state["int_keyed"][42][11]
+    )
+    lease = back["leases"][0]
+    assert lease["_task_id"] == 9
+    np.testing.assert_array_equal(lease["prompt"], state["leases"][0]["prompt"])
+    assert back["scalars"] == state["scalars"]
+
+
+def test_ledger_tamper_and_missing_manifest_detected(tmp_path):
+    path = str(tmp_path / "ledger")
+    ledger_store.save_ledger(path, {"x": 1})
+    fpath = os.path.join(os.path.abspath(path), ledger_store.LEDGER_FILE)
+    blob = bytearray(open(fpath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(fpath, "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(ledger_store.LedgerIntegrityError):
+        ledger_store.load_ledger(path, fallback=False)
+    # a ledger visible without its manifest is a torn save, never unpacked
+    ledger_store.save_ledger(path, {"x": 2})
+    os.unlink(os.path.join(os.path.abspath(path), ledger_store.MANIFEST_NAME))
+    with pytest.raises(ledger_store.LedgerIntegrityError):
+        ledger_store.load_ledger(path, fallback=False)
+
+
+def test_ledger_truncated_falls_back_through_prev_chain(tmp_path):
+    """Three generations of saves retain a 2-deep ``.prev`` chain; a
+    truncated primary AND a corrupted ``.prev`` still restore from
+    ``.prev2``, counting a fallback per skipped candidate."""
+    path = str(tmp_path / "ledger")
+    for v in (1, 2, 3):
+        ledger_store.save_ledger(path, {"v": v}, keep_last=2)
+    apath = os.path.abspath(path)
+    assert ledger_store.ledger_fallbacks(apath) == [
+        apath + ".prev", apath + ".prev2"
+    ]
+    fallbacks_before = (
+        telemetry.get_registry().counter("ledger.fallbacks").value
+    )
+    # truncate the primary (preemption mid-flush)
+    fpath = os.path.join(apath, ledger_store.LEDGER_FILE)
+    blob = open(fpath, "rb").read()
+    with open(fpath, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert ledger_store.load_ledger(path)["v"] == 2
+    # corrupt .prev too: the chain walks to .prev2
+    p1 = os.path.join(apath + ".prev", ledger_store.LEDGER_FILE)
+    with open(p1, "ab") as f:
+        f.write(b"\x00garbage")
+    assert ledger_store.load_ledger(path)["v"] == 1
+    assert (
+        telemetry.get_registry().counter("ledger.fallbacks").value
+        >= fallbacks_before + 2
+    )
+    # every candidate dead -> the ORIGINAL error surfaces
+    import shutil
+
+    for p in (apath + ".prev", apath + ".prev2"):
+        shutil.rmtree(p)
+    with pytest.raises(ledger_store.LedgerIntegrityError):
+        ledger_store.load_ledger(path)
+
+
+def test_truncated_ledger_learner_still_reissues_consistent_leases(tmp_path):
+    """Satellite (d): the learner's restore rides the same fallback chain —
+    with the newest ledger truncated, the restart restores the PREVIOUS
+    consistent cut and re-issues exactly that cut's open lease set."""
+    path = str(tmp_path / "ledger")
+    cfg = DisaggConfig(num_hosts=1, heartbeat_interval_s=0.0)
+    learner = SequenceLearner(cfg, _lease_source(4), ledger_path=path)
+    a, _b = mp.Pipe(duplex=True)
+    conn = PipeConnection(a)
+    learner.hub.add_connection(conn)
+    learner._handle(conn, {"kind": "lease", "n": 2, "have_gen": -1})
+    assert len(learner._outstanding) == 2
+    first_cut = sorted(learner._outstanding.keys())
+    learner.save_ledger()  # cut A: 2 open leases
+    # one lease completes, a third opens -> cut B
+    done = dict(scripted_sequence_payload(1, 4, 16, 0))
+    done.update(host_id=1, host_epoch=1, seq_id=0, _task_id=first_cut[0])
+    learner._ingest([done])
+    learner._handle(conn, {"kind": "lease", "n": 1, "have_gen": -1})
+    learner.save_ledger()
+    learner.stop()
+    # truncate cut B: restore must fall back to cut A and reissue ITS set
+    fpath = os.path.join(os.path.abspath(path), ledger_store.LEDGER_FILE)
+    blob = open(fpath, "rb").read()
+    with open(fpath, "wb") as f:
+        f.write(blob[: len(blob) // 3])
+    resumed = SequenceLearner(cfg, _lease_source(0), ledger_path=path)
+    assert resumed.learner_epoch == 2
+    assert resumed.resumed_sequences_reissued == 2
+    reissued_tids = sorted(
+        lease["_task_id"] for lease in resumed._returned
+    )
+    assert reissued_tids == first_cut
+    # the reissued set is servable immediately, ahead of the (empty) source
+    lease = resumed._next_lease()
+    assert lease is not None and lease["_task_id"] == first_cut[0]
+    resumed.stop()
+
+
+# ---------------------------------------------------------------------------
+# epoch handshake + resume-duplicate accounting (unit level)
+
+
+def test_gen_welcome_carries_epoch_and_generation(tmp_path):
+    """A (re)joining host's ``gen_hello`` is answered with ``gen_welcome``
+    carrying the learner's epoch and current snapshot generation; lease
+    and params replies are epoch-stamped too."""
+    cfg = DisaggConfig(num_hosts=1, heartbeat_interval_s=0.0)
+    learner = SequenceLearner(cfg, _lease_source(2))
+    learner.start()
+    learner.publish(_weights(), learner_step=0)
+    parent, child = mp.Pipe(duplex=True)
+    learner.add_host_connection(PipeConnection(parent))
+    host = PipeConnection(child)
+    host.send({"kind": "gen_hello", "host_id": 0, "host_epoch": 1, "lanes": 2})
+    welcome = host.recv(timeout=10.0)
+    assert welcome["kind"] == "gen_welcome"
+    assert welcome["epoch"] == 1
+    assert welcome["gen"] == 1
+    host.send({"kind": "lease", "n": 1, "have_gen": 1})
+    reply = host.recv(timeout=10.0)
+    assert reply["kind"] == "lease" and reply["epoch"] == 1
+    host.send({"kind": "params", "have": -1})
+    reply = host.recv(timeout=10.0)
+    assert reply["kind"] == "params" and reply["epoch"] == 1
+    assert "weights" in reply
+    learner.stop()
+
+
+def test_restored_dedup_attributes_drops_to_the_resume(tmp_path):
+    """Pre-restart uploads redelivered to the resumed incarnation drop via
+    the RESTORED watermarks/completed table, and are attributed to
+    ``resume.duplicates_dropped`` — the 'duplicates' leg of the ledger
+    accounting identity."""
+    path = str(tmp_path / "ledger")
+    cfg = DisaggConfig(num_hosts=1, heartbeat_interval_s=0.0)
+    learner = SequenceLearner(cfg, _lease_source(2), ledger_path=path)
+    p1 = dict(scripted_sequence_payload(1, 4, 16, 0))
+    p1.update(host_id=7, host_epoch=11, seq_id=0, _task_id=100)
+    learner._ingest([p1])
+    learner.stop()
+    learner.save_ledger()
+    resumed = SequenceLearner(cfg, _lease_source(0), ledger_path=path)
+    assert resumed.learner_epoch == 2
+    # retained-upload redelivery: same (host, epoch, seq) key as before
+    # the restart -> dropped AND attributed to the resume
+    r1 = dict(scripted_sequence_payload(1, 4, 16, 0))
+    r1.update(host_id=7, host_epoch=11, seq_id=0, _task_id=100)
+    resumed._ingest([r1])
+    assert resumed.duplicate_sequences == 1
+    assert resumed.resumed_duplicates_dropped == 1
+    # a reissue race completing a lease the PREDECESSOR closed: fresh
+    # upload key, restored completed-lease table drops it, same attribution
+    race = dict(scripted_sequence_payload(1, 4, 16, 0))
+    race.update(host_id=8, host_epoch=1, seq_id=0, _task_id=100)
+    resumed._ingest([race])
+    assert resumed.duplicate_leases == 1
+    assert resumed.resumed_duplicates_dropped == 2
+    assert resumed.total_sequences == 1  # restored count, nothing new
+    resumed.stop()
+
+
+def test_preemption_guard_chaos_preempt_draw(monkeypatch):
+    """The guard's seeded ``preempt`` draw trips it exactly like a real
+    SIGTERM (simulate path off the main-thread/handler requirement), and
+    an unarmed plan never trips it."""
+    monkeypatch.setenv(chaos.ENV_VAR, "77:preempt=1.0@1")
+    chaos.clear()
+    try:
+        guard = PreemptionGuard()
+        assert not guard.triggered
+        assert guard.poll_chaos("learner") is True
+        assert guard.triggered and guard.received is not None
+        events = telemetry.get_recorder().events("preemption_signal")
+        assert events
+        # once tripped it LATCHES (the loop exits at the next safe point)
+        assert guard.poll_chaos("learner") is True
+    finally:
+        monkeypatch.delenv(chaos.ENV_VAR)
+        chaos.clear()
+    guard2 = PreemptionGuard()
+    assert guard2.poll_chaos("learner") is False
+
+
+# ---------------------------------------------------------------------------
+# the e2e: kill the learner mid-decode, restart, close the ledger exactly
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_learner_restart_e2e_exact_accounting(tmp_path):
+    """SIGTERM the learner mid-decode with LIVE hosts: save-and-exit,
+    restart from the ledger (epoch + 1), surviving hosts reconnect through
+    the backoff seam and re-handshake — and the ledger closes exactly:
+    every lease's sequence reaches the consumer once, zero consumer-visible
+    duplicates, zero orphaned leases, bit-exact payloads."""
+    path = str(tmp_path / "ledger")
+    n = 36
+    cfg = DisaggConfig(
+        num_hosts=2, lanes_per_host=2, upload_batch=1,
+        heartbeat_interval_s=0.5,
+    )
+    # ONE source across both incarnations: un-issued prompts belong to the
+    # prompt source (the trainer's restored lease cursor), not the ledger
+    source = _lease_source(n)
+    learner = SequenceLearner(cfg, source, ledger_path=path)
+    learner.start()
+    learner.publish(_weights(), learner_step=0)
+    state = {"learner": learner}
+    fleet = LocalGenerationFleet(
+        state["learner"], cfg,
+        ScriptedEngineFactory(
+            lanes=2, response_len=6, tokens_per_step=1, step_sleep_s=0.02
+        ),
+        use_threads=True, auto_chaos=False,
+    )
+    fleet.start()
+    restarted = None
+    try:
+        # warmup: the kill must land mid-decode, not mid-boot
+        seqs = _collect(lambda: state["learner"], 8)
+        assert len(seqs) == 8
+        guard = PreemptionGuard()
+        guard.simulate()  # the SIGTERM shape without owning a handler
+        assert guard.triggered
+        learner.stop()
+        learner.save_ledger()
+        open_at_kill = learner.resumed_sequences_reissued  # 0 on the first
+        restarted = SequenceLearner(cfg, source, ledger_path=path)
+        assert restarted.learner_epoch == 2
+        assert restarted.resumed_sequences_reissued > 0
+        restarted.start()
+        state["learner"] = restarted
+        fleet.adopt_learner(restarted)
+        seqs += _collect(lambda: state["learner"], n - len(seqs))
+    finally:
+        learner.stop()
+        if restarted is not None:
+            restarted.stop()
+        fleet.join()
+    assert restarted is not None and open_at_kill == 0
+    assert len(seqs) == n
+    # EXACT accounting across the restart: accepted == issued, unique
+    assert len({s["lease_id"] for s in seqs}) == n
+    # zero orphaned leases after the drain (the lease table closed)
+    assert len(restarted._outstanding) == 0
+    # bit-exact payloads on both sides of the restart
+    for s in seqs:
+        expect = scripted_sequence_payload(s["seed"], 6, 32, s["generation"])
+        for key in ("prompt", "response_tokens", "behavior_logp", "values"):
+            np.testing.assert_array_equal(s[key], expect[key])
+    # the resume is observable: event + reconnects + epoch gauge
+    assert telemetry.get_recorder().events("preemption_resume")
+    assert telemetry.get_registry().gauge("learner.epoch").value == 2
+    assert (
+        telemetry.get_registry().counter("disagg_host.reconnects").value > 0
+    )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_host_killed_during_learner_restart(tmp_path):
+    """A generation host dies IN the restart window (its in-flight leases
+    ride the ledger as open leases); the restarted learner re-issues them
+    to a respawned fleet, which adopts the restored snapshot generation
+    before admitting work — accounting still closes exactly."""
+    path = str(tmp_path / "ledger")
+    n = 24
+    cfg = DisaggConfig(
+        num_hosts=2, lanes_per_host=2, upload_batch=1,
+        heartbeat_interval_s=0.5,
+    )
+    source = _lease_source(n)
+    learner = SequenceLearner(cfg, source, ledger_path=path)
+    learner.start()
+    learner.publish(_weights(), learner_step=0)
+    fleet = LocalGenerationFleet(
+        learner, cfg,
+        ScriptedEngineFactory(
+            lanes=2, response_len=6, tokens_per_step=1, step_sleep_s=0.02
+        ),
+        mp_context="spawn", auto_chaos=False,
+    )
+    fleet.start()
+    restarted = None
+    fleet2 = None
+    try:
+        seqs = _collect(lambda: learner, 6)
+        assert len(seqs) == 6
+        # the preemption: learner exits; one host is killed in the window
+        learner.stop()
+        learner.save_ledger()
+        fleet.procs[0].terminate()
+        fleet.join(timeout=10.0)
+        restarted = SequenceLearner(cfg, source, ledger_path=path)
+        assert restarted.learner_epoch == 2
+        restarted.start()
+        # respawned hosts: fresh shells against the restored learner —
+        # they must adopt the restored snapshot generation via gen_welcome
+        fleet2 = LocalGenerationFleet(
+            restarted, cfg,
+            ScriptedEngineFactory(
+                lanes=2, response_len=6, tokens_per_step=1,
+                step_sleep_s=0.02,
+            ),
+            use_threads=True, auto_chaos=False,
+        )
+        fleet2.start()
+        seqs += _collect(lambda: restarted, n - len(seqs))
+    finally:
+        learner.stop()
+        if restarted is not None:
+            restarted.stop()
+        fleet.join(timeout=5.0)
+        if fleet2 is not None:
+            fleet2.join()
+    assert len(seqs) == n
+    assert len({s["lease_id"] for s in seqs}) == n
+    assert len(restarted._outstanding) == 0
+    for s in seqs:
+        expect = scripted_sequence_payload(s["seed"], 6, 32, s["generation"])
+        for key in ("prompt", "response_tokens", "behavior_logp", "values"):
+            np.testing.assert_array_equal(s[key], expect[key])
+    # the restored generation (not 0) is what the respawned fleet decoded
+    # under — late joiners adopted the snapshot before admitting work
+    assert all(s["generation"] >= 1 for s in seqs)
+
+
+# ---------------------------------------------------------------------------
+# trainer-level full-plane resume (replay + agent + lease RNG + learn step)
+
+
+@pytest.fixture
+def _trainer_args(tmp_path):
+    from scalerl_tpu.config import GenRLArguments
+
+    return GenRLArguments(
+        vocab_size=12, prompt_len=4, max_new_tokens=4, d_model=32,
+        n_layers=1, n_heads=2, genrl_batch=4, genrl_sample_batch=4,
+        genrl_buffer_sequences=8, disagg_hosts=2,
+        telemetry_interval_s=0.0, logger_backend="none",
+        disagg_round_timeout_s=120.0,
+        disagg_ledger_dir=str(tmp_path / "plane"),
+    )
+
+
+@pytest.mark.slow
+def test_trainer_save_resume_roundtrip(_trainer_args, tmp_path):
+    """save_resume -> fresh construction against the same ledger_dir:
+    learn step continues monotonically, replay contents and agent weights
+    round-trip bit-exact, and the lease RNG resumes its exact stream."""
+    import jax
+
+    from scalerl_tpu.trainer.sequence_rl import DisaggSequenceRLTrainer
+
+    os.makedirs(_trainer_args.disagg_ledger_dir, exist_ok=True)
+    t1 = DisaggSequenceRLTrainer(_trainer_args)
+    assert t1.learner.learner_epoch == 1
+    t1.train(2)
+    # train() closed the plane; reopen enough state to snapshot it
+    assert t1.learn_steps == 2
+    rng_cut = json.dumps(t1._lease_rng.bit_generator.state)
+    w_cut = jax.device_get(t1.agent.get_weights())
+    replay_size = int(t1.replay.size)
+    out = t1.save_resume()
+    assert out == t1.ledger_path
+
+    t2 = DisaggSequenceRLTrainer(_trainer_args)
+    try:
+        assert t2.learner.learner_epoch == 2
+        assert t2.learn_steps == 2
+        assert int(t2.replay.size) == replay_size
+        assert json.dumps(t2._lease_rng.bit_generator.state) == rng_cut
+        jax.tree_util.tree_map(
+            np.testing.assert_array_equal,
+            jax.device_get(t2.agent.get_weights()),
+            w_cut,
+        )
+        # the restored param plane keeps its generation: no re-publish of
+        # a fresh gen 0 snapshot (stale-generation protection end to end)
+        assert t2.learner.generation >= 1
+        # and training continues: the step counter is monotonic across
+        # the restart (the train curve continues, never rewinds)
+        summary = t2.train(1)
+        assert summary["learn_steps"] == 3.0
+    finally:
+        t2.close()
+
+
+@pytest.mark.slow
+def test_trainer_guard_preempt_exit_resumes_same_step(
+    _trainer_args, monkeypatch
+):
+    """The learn loop's safe point: the chaos ``preempt`` draw lands
+    between rounds -> ``preemption_exit`` + save_resume + clean exit; the
+    successor resumes at the SAME learn step under epoch + 1."""
+    from scalerl_tpu.trainer.sequence_rl import DisaggSequenceRLTrainer
+
+    os.makedirs(_trainer_args.disagg_ledger_dir, exist_ok=True)
+    t1 = DisaggSequenceRLTrainer(_trainer_args)
+    t1.train(2)
+    assert t1.learn_steps == 2
+    # rebuild the plane mid-run shape: a fresh trainer resumed from a
+    # manual save, now running WITH an armed guard
+    t1.save_resume()
+    monkeypatch.setenv(chaos.ENV_VAR, "5:preempt=1.0@1")
+    chaos.clear()
+    try:
+        guard = PreemptionGuard()
+        t2 = DisaggSequenceRLTrainer(_trainer_args, guard=guard)
+        assert t2.learn_steps == 2
+        summary = t2.train(3)
+        # the draw fires at the FIRST safe point: zero new rounds ran,
+        # the plane saved, and the loop exited cleanly
+        assert guard.triggered
+        assert summary["learn_steps"] == 2.0
+        assert telemetry.get_recorder().events("preemption_exit")
+    finally:
+        monkeypatch.delenv(chaos.ENV_VAR)
+        chaos.clear()
+    t3 = DisaggSequenceRLTrainer(_trainer_args)
+    try:
+        assert t3.learn_steps == 2
+        assert t3.learner.learner_epoch == 3  # two restarts deep
+    finally:
+        t3.close()

@@ -250,6 +250,9 @@ def test_r2d2_enable_mesh_matches_unsharded():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow  # ~20 s; checkpoint round-trip mechanics stay tier-1-covered by
+# test_sharded_checkpoint_save_restore_resume + the supervisor
+# round-trip units (ISSUE 19 tier-1 budget buy-back)
 def test_r2d2_trainer_resume_roundtrip(tmp_path):
     """Kill-and-resume through the shared HostPlaneMixin: learner state and
     the frame counter survive; the resumed run continues, not restarts."""
@@ -448,6 +451,8 @@ def test_r2d2_memory_proof_delayed_recall():
     assert ff <= 0.3, ff
 
 
+@pytest.mark.slow  # ~18 s; sharded sequence-replay mechanics stay tier-1-covered by
+# tests/test_sharded_replay.py seq parity units (ISSUE 19 buy-back)
 def test_r2d2_trainer_sharded_replay(tmp_path):
     """Host R2D2 with a DDP agent: the sequence ring shards over the
     agent's mesh (capacity axis), per-shard sampling feeds the sharded
@@ -474,6 +479,8 @@ def test_r2d2_trainer_sharded_replay(tmp_path):
     trainer.close()
 
 
+@pytest.mark.slow  # ~10 s learning curve — same convention as the other cartpole
+# solves; r2d2 mechanics stay in test_r2d2_agent_learn_step_and_target_sync
 def test_r2d2_trainer_cartpole_smoke(tmp_path):
     args = _args(work_dir=str(tmp_path), rollout_length=8, burn_in=2,
                  n_steps=1, warmup_sequences=4, batch_size=4)

@@ -522,6 +522,79 @@ def test_catch_up_push_realigns_a_laggard():
         _teardown(router, reps)
 
 
+def test_stale_epoch_rollout_refused():
+    """ISSUE 19: a rollout stamped with an OLDER learner epoch (a zombie
+    pre-restart learner racing its restarted successor) is refused
+    outright — generations never move, the refusal is counted, and the
+    next epoch's rollout proceeds normally."""
+    reps = [StubReplica(f"r{i}", gen=1) for i in range(2)]
+    router = _router(reps)
+    try:
+        fleet_gen = router.rollout({"w": 1}, learner_step=5, learner_epoch=2)
+        assert fleet_gen == 2
+        assert router.learner_epoch == 2
+        assert all(s.handle.epoch == 2 for s in reps)
+        # the zombie: pre-restart epoch 1 pushing newer-looking weights
+        got = router.rollout({"w": 99}, learner_step=6, learner_epoch=1)
+        assert got == 2  # current fleet max, not a new generation
+        assert router.stale_rollouts == 1
+        assert all(s.handle.generation == 2 for s in reps)
+        assert router.stats()["stale_rollouts"] == 1
+        assert router.stats()["learner_epoch"] == 2
+        # the restarted learner's next epoch rolls normally
+        assert router.rollout({"w": 2}, learner_epoch=3) == 3
+        assert router.learner_epoch == 3
+        assert router.stats()["epoch_min"] == 3
+    finally:
+        _teardown(router, reps)
+
+
+def test_pre_restart_epoch_replica_held_out_until_caught_up():
+    """A pushable replica still on a pre-restart learner epoch serves
+    stale weights by definition: it is held out of rotation until
+    ``_catch_up`` rolls it onto the current (epoch, generation)."""
+    reps = [StubReplica(f"r{i}", gen=1) for i in range(2)]
+    router = _router(reps)
+    client = RawClient(router)
+    rng = np.random.default_rng(3)
+    try:
+        router.rollout({"w": 1}, learner_epoch=2)
+        # r1 missed the epoch roll (dead during the learner restart)
+        reps[1].handle.epoch = 1
+        for i in range(12):
+            client.send(_act_msg(f"e{i}",
+                                 rng.normal(size=(2, 8)).astype(np.float32)))
+        client.wait(12)
+        assert reps[0].served == 12 and reps[1].served == 0
+        router._catch_up(reps[1].handle)
+        assert reps[1].handle.epoch == 2
+        assert reps[1].handle.generation >= reps[0].handle.generation
+        for i in range(12):
+            client.send(_act_msg(f"f{i}",
+                                 rng.normal(size=(2, 8)).astype(np.float32)))
+        client.wait(24)
+        assert reps[1].served > 0  # back in rotation
+    finally:
+        _teardown(router, reps, [client])
+
+
+def test_late_joining_replica_adopts_current_epoch_and_generation():
+    """``add_replica`` after an epoch-stamped rollout catches the newcomer
+    up BEFORE it takes traffic — a respawned replica never serves the
+    pre-restart generation."""
+    reps = [StubReplica("r0", gen=1)]
+    router = _router(reps)
+    late = None
+    try:
+        router.rollout({"w": 1}, learner_step=7, learner_epoch=2)
+        late = StubReplica("late", gen=0)
+        router.add_replica(late.handle)
+        assert late.handle.epoch == 2
+        assert late.handle.generation >= reps[0].handle.generation
+    finally:
+        _teardown(router, reps + ([late] if late else []))
+
+
 def test_client_observed_generation_is_monotonic_across_rollout():
     reps = [StubReplica(f"r{i}", gen=3) for i in range(3)]
     router = _router(reps)

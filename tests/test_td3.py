@@ -50,6 +50,8 @@ def _batch(B=32):
     }
 
 
+@pytest.mark.slow  # ~8 s; generic enable-mesh parity stays tier-1-covered by
+# test_agent_enable_mesh_matches_unsharded; td3 math by its fast units
 def test_td3_enable_mesh_matches_unsharded():
     """DDP TD3: dp×fsdp-sharded learn == single-device learn at the same
     global batch, including the masked delayed-actor update."""
@@ -118,6 +120,8 @@ def test_td3_actions_respect_bounds():
     np.testing.assert_array_equal(g, agent.predict(obs))
 
 
+@pytest.mark.slow  # ~10 s pipeline e2e; td3 mechanics stay in the delayed-update/bounds/
+# enable-mesh units; pendulum solve already slow by the same convention
 def test_td3_offpolicy_trainer_pipeline(tmp_path):
     pytest.importorskip("gymnasium")
     args = _args(work_dir=str(tmp_path))

@@ -291,6 +291,8 @@ VERDICT_SCHEMA = {
 }
 
 
+@pytest.mark.slow  # ~10 s traced-path e2e; the untraced wire-clean guard + tracer units
+# stay tier-1 (ISSUE 19 tier-1 budget buy-back)
 def test_disagg_lifecycle_yields_complete_traces(monkeypatch, tmp_path):
     from scalerl_tpu.genrl.disagg import (
         DisaggConfig,

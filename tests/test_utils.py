@@ -84,6 +84,8 @@ def test_target_updates():
     np.testing.assert_allclose(np.asarray(hard["w"]), np.ones(3))
 
 
+@pytest.mark.slow  # ~15 s profiler e2e; annotation plumbing has no tier-1-critical
+# correctness surface (ISSUE 19 tier-1 budget buy-back)
 def test_profiling_trace_and_annotate(tmp_path):
     import jax.numpy as jnp
 

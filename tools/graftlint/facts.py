@@ -21,7 +21,10 @@ Fact families (one per v2 rule):
   linter's own rule tables, say) are not frames.
 - **lifecycle** (JG008): ``threading.Thread(...)`` creations with daemon
   status, whether the module calls ``.start()`` / ``.join()`` at all,
-  per-class ``PageAllocator`` acquire/release tallies plus acquire-inside-
+  ``ThreadPoolExecutor``/``ProcessPoolExecutor`` constructions (with
+  ``with``-managed ones marked — the context manager is their shutdown)
+  and whether the module calls ``.shutdown()`` at all, per-class
+  ``PageAllocator`` acquire/release tallies plus acquire-inside-
   ``try``-without-exception-path-release sites, and ``start_span`` results
   that are discarded or never read again.
 - **telemetry** (JG009): ``MetricsRegistry`` instrument creations
@@ -50,6 +53,9 @@ WIRE_DIRS = {"fleet", "serving", "genrl", "runtime", "trainer"}
 HOT_DIRS = {"runtime", "trainer", "agents", "serving", "genrl"}
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+#: executor constructors the JG008 pool sub-rule tracks (shutdown() is the
+#: executor's join()).
+_POOL_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
 _LOCK_SUFFIXES = ("_lock", "_guard", "_mutex")
 _LOCK_NAMES = {"lock", "mutex", "guard"}
 
@@ -78,6 +84,17 @@ class KindSite:
 class ThreadFact:
     line: int
     daemonic: bool
+
+
+@dataclass
+class PoolFact:
+    """One ThreadPoolExecutor/ProcessPoolExecutor construction.  ``managed``
+    means it was built as a ``with`` context expression — the context
+    manager IS the shutdown, so only unmanaged pools need a reachable
+    ``shutdown()`` (the executor twin of the Thread ``join`` rule)."""
+
+    line: int
+    managed: bool
 
 
 @dataclass
@@ -117,6 +134,8 @@ class ModuleFacts:
     threads: List[ThreadFact] = field(default_factory=list)
     has_start: bool = False
     has_join: bool = False
+    pools: List[PoolFact] = field(default_factory=list)
+    has_pool_shutdown: bool = False
     allocs: Dict[str, AllocFact] = field(default_factory=dict)
     alloc_leaks: List[int] = field(default_factory=list)
     unended_spans: List[Tuple[int, str]] = field(default_factory=list)
@@ -524,6 +543,16 @@ def harvest(
                 ) else module_id
                 facts.lock_defs[f"{owner}.{t.id}"] = n.lineno
 
+    # with-managed executor constructions: the With node's context_expr is
+    # the pool Call itself, and the context manager shuts it down
+    managed_ctx_calls = {
+        id(item.context_expr)
+        for n in nodes
+        if isinstance(n, (ast.With, ast.AsyncWith))
+        for item in n.items
+        if isinstance(item.context_expr, ast.Call)
+    }
+
     daemon_assigned = any(
         isinstance(n, ast.Assign)
         and any(
@@ -562,6 +591,14 @@ def harvest(
                     for kw in n.keywords
                 )
                 facts.threads.append(ThreadFact(line=n.lineno, daemonic=daemonic))
+        elif tail in _POOL_CTORS:
+            rn = root_name(callee)
+            if rn in ("concurrent", "futures") or tail == rn:
+                facts.pools.append(
+                    PoolFact(
+                        line=n.lineno, managed=id(n) in managed_ctx_calls
+                    )
+                )
         elif tail == "dict" and facts.is_wire:
             for kw in n.keywords:
                 if kw.arg == "kind":
@@ -571,6 +608,8 @@ def harvest(
         elif tail == "join" and isinstance(callee, ast.Attribute):
             if not isinstance(callee.value, ast.Constant):  # skip ", ".join
                 facts.has_join = True
+        elif tail == "shutdown" and isinstance(callee, ast.Attribute):
+            facts.has_pool_shutdown = True
         if isinstance(callee, ast.Attribute):
             _harvest_alloc_call(ctx, n, facts)
             _harvest_telemetry_call(n, facts)

@@ -296,7 +296,7 @@ def xrule_jg007(prog: Program) -> Iterator[Finding]:
 
 
 def xrule_jg008(prog: Program) -> Iterator[Finding]:
-    """Thread, allocator-page, and span lifecycle hygiene."""
+    """Thread, executor-pool, allocator-page, and span lifecycle hygiene."""
     for m in prog.modules:
         if m.is_hot and m.has_start and not m.has_join:
             for t in m.threads:
@@ -307,6 +307,19 @@ def xrule_jg008(prog: Program) -> Iterator[Finding]:
                         "without any reachable join() in this module",
                         hint="pass daemon=True, or join the thread on the "
                         "shutdown path",
+                    )
+        if m.is_hot and not m.has_pool_shutdown:
+            # the executor twin of the thread rule: shutdown() is the
+            # pool's join(); a with-managed pool shuts down at scope exit
+            for p in m.pools:
+                if not p.managed:
+                    yield prog.finding(
+                        m.relpath, p.line, "JG008",
+                        "executor pool created in a hot dir without any "
+                        "reachable shutdown() in this module (and not "
+                        "with-managed)",
+                        hint="use the pool as a context manager, or call "
+                        "shutdown(wait=...) on the teardown path",
                     )
         for owner in sorted(m.allocs):
             af = m.allocs[owner]

@@ -241,6 +241,56 @@ def _disagg_marker(bl, start_offset: int) -> str:
         return ""
 
 
+def _preempt_marker(bl, start_offset: int) -> str:
+    """Gate the preempt-soak step on its JSON verdict line.
+
+    ``tools/preempt_soak.py`` prints one ``{"metric": "preempt_soak", ...}``
+    line after SIGTERMing the learner mid-decode and restarting it from the
+    durable ledger.  The gate is EXACT accounting across the restart: any
+    lost sequence, consumer-visible duplicate, corrupt payload, orphaned
+    lease, or a learner that came back without bumping its epoch marks the
+    outcome ``!ledger(...)``; a cleanly-closed ledger marks ``+preempt``.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        verdict = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "preempt_soak":
+                verdict = obj
+        if not verdict:
+            return ""
+        bad = []
+        if int(verdict.get("lost", 0)) > 0:
+            bad.append(f"lost={verdict['lost']}")
+        if int(verdict.get("duplicates", 0)) > 0:
+            bad.append(f"dup={verdict['duplicates']}")
+        if int(verdict.get("payload_mismatches", 0)) > 0:
+            bad.append(f"corrupt={verdict['payload_mismatches']}")
+        if int(verdict.get("orphaned_leases", 0)) > 0:
+            bad.append(f"orphans={verdict['orphaned_leases']}")
+        if not verdict.get("epoch_bumped", False):
+            bad.append("no-epoch-bump")
+        if int(verdict.get("resume_events", 0)) < 1:
+            bad.append("no-resume")
+        if bad:
+            bl.write(f"[watcher] PREEMPT GATE: {','.join(bad)} — flagging\n")
+            return "!ledger(" + ",".join(bad) + ")"
+        return "+preempt"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] preempt gate failed: {e}\n")
+        return ""
+
+
 def _trace_marker(bl, start_offset: int) -> str:
     """Gate the trace-soak step on the trace_report verdict line.
 
@@ -492,6 +542,14 @@ def run_payload(n_devices: int = 1) -> None:
         # corrupt sequences or a missing backfill mark !disagg(...)
         ("disagg-soak", [sys.executable, "tools/disagg_soak.py"],
          600, dict(env, JAX_PLATFORMS="cpu")),
+        # preempt soak: SIGTERM the LEARNER mid-decode (the guard's seeded
+        # preempt draw), restart it from the durable ledger, and close the
+        # accounting exactly (tools/preempt_soak.py).  jax-free thread
+        # fleet, bounded, runs tunnel-down, non-quorum like the other
+        # soaks; _preempt_marker gates on the ledger identity — lost/
+        # duplicate/orphaned work or a missing epoch bump marks !ledger(...)
+        ("preempt-soak", [sys.executable, "tools/preempt_soak.py"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
         # trace soak: the disagg soak with SCALERL_TRACE_SAMPLE=1.0 and
         # per-host span export — tools/trace_report.py merges the files
         # into Chrome trace_event JSON + a critical-path breakdown, and
@@ -623,6 +681,8 @@ def run_payload(n_devices: int = 1) -> None:
                     status += _elastic_marker(bl, step_start)
                 if name == "disagg-soak":
                     status += _disagg_marker(bl, step_start)
+                if name == "preempt-soak":
+                    status += _preempt_marker(bl, step_start)
                 if name == "trace-soak":
                     status += _disagg_marker(bl, step_start)
                     status += _trace_marker(bl, step_start)
@@ -640,7 +700,7 @@ def run_payload(n_devices: int = 1) -> None:
         for name, status in outcomes
         if name not in (
             "lint-rules", "lint", "chaos-soak", "elastic-soak",
-            "disagg-soak", "trace-soak", "genrl-soak",
+            "disagg-soak", "preempt-soak", "trace-soak", "genrl-soak",
         )
     ):
         # nothing TPU-witnessed succeeded (lint, the chaos soak, the
