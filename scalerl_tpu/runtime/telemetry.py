@@ -152,20 +152,35 @@ class Gauge:
 
 
 class Histogram:
-    """Count/sum/min/max plus a bounded reservoir for quantile estimates.
+    """Count/sum/min/max plus a bounded quantile estimator — one of two
+    backends, chosen at construction:
 
-    The reservoir is deterministic systematic sampling (every k-th
-    observation once full — no RNG so snapshots are reproducible in tests),
-    which is adequate for the step-latency / batch-staleness distributions
-    it tracks; exact digests are not the point of a runtime sketch.
+    - ``backend="reservoir"`` (default): deterministic systematic sampling
+      (every k-th observation once full — no RNG so snapshots are
+      reproducible in tests).  Adequate for SMALL-count distributions
+      (step latencies, batch staleness); structurally biased at the tail
+      once the count dwarfs the 256-slot reservoir.
+    - ``backend="digest"``: a mergeable log-bucket sketch
+      (``runtime/attribution.LatencyDigest`` — fixed γ-spaced buckets,
+      DDSketch-style) whose quantiles stay within ``relative_error`` of
+      the true value at ANY count, and whose merge across hosts/threads
+      is exact integer addition.  The traffic-plane SLO instruments
+      (``serving.latency_s``, ``router.latency_s`` — the autoscaler's p95
+      signal) live here; a million-request p99 from a 256-sample
+      reservoir is not a number worth gating on.
     """
 
     kind = _KIND_HISTOGRAM
     __slots__ = ("name", "_lock", "count", "sum", "min", "max", "_reservoir",
-                 "_cap", "_stride")
+                 "_cap", "_stride", "backend", "_digest")
 
-    def __init__(self, name: str, reservoir_size: int = 256) -> None:
+    def __init__(self, name: str, reservoir_size: int = 256,
+                 backend: str = "reservoir",
+                 relative_error: float = 0.01) -> None:
+        if backend not in ("reservoir", "digest"):
+            raise ValueError(f"unknown histogram backend {backend!r}")
         self.name = name
+        self.backend = backend
         self._lock = threading.Lock()
         self.count = 0
         self.sum = 0.0
@@ -174,9 +189,24 @@ class Histogram:
         self._reservoir: List[float] = []
         self._cap = int(reservoir_size)
         self._stride = 1
+        self._digest = None
+        if backend == "digest":
+            # deferred import: attribution imports telemetry for the
+            # registry, so the reverse edge must not run at module load
+            from scalerl_tpu.runtime.attribution import LatencyDigest
+
+            self._digest = LatencyDigest(relative_error=relative_error)
 
     def observe(self, v: float) -> None:
         v = float(v)
+        if self._digest is not None:
+            with self._lock:
+                self.count += 1
+                self.sum += v
+                self.min = min(self.min, v)
+                self.max = max(self.max, v)
+            self._digest.observe(v)
+            return
         with self._lock:
             self.count += 1
             self.sum += v
@@ -191,12 +221,19 @@ class Histogram:
                     self._reservoir[self.count % self._cap] = v
 
     def quantile(self, q: float) -> float:
+        if self._digest is not None:
+            return self._digest.quantile(q)
         with self._lock:
             if not self._reservoir:
                 return 0.0
             data = sorted(self._reservoir)
         idx = min(len(data) - 1, max(0, int(q * (len(data) - 1))))
         return data[idx]
+
+    def digest_wire(self) -> Optional[Dict[str, Any]]:
+        """The mergeable digest snapshot (JSON-safe), or None on the
+        reservoir backend — the fleet piggyback / artifact hook."""
+        return self._digest.to_wire() if self._digest is not None else None
 
     def read(self) -> Dict[str, float]:
         with self._lock:
@@ -214,6 +251,10 @@ class Histogram:
         out["p50"] = self.quantile(0.50)
         out["p95"] = self.quantile(0.95)  # the serving SLO quantile
         out["p99"] = self.quantile(0.99)
+        if self._digest is not None:
+            # the digest's tail stays trustworthy at any count — expose the
+            # p999 the reservoir could never honestly report
+            out["p999"] = self.quantile(0.999)
         return out
 
 
@@ -313,8 +354,14 @@ class MetricsRegistry:
             raise TypeError(f"instrument {name!r} is a {inst.kind}, not a gauge")
         return inst
 
-    def histogram(self, name: str, reservoir_size: int = 256) -> Histogram:
-        inst = self._get(name, lambda n: Histogram(n, reservoir_size))
+    def histogram(self, name: str, reservoir_size: int = 256,
+                  backend: str = "reservoir",
+                  relative_error: float = 0.01) -> Histogram:
+        inst = self._get(
+            name,
+            lambda n: Histogram(n, reservoir_size, backend=backend,
+                                relative_error=relative_error),
+        )
         if not isinstance(inst, Histogram):
             raise TypeError(f"instrument {name!r} is a {inst.kind}, not a histogram")
         return inst
@@ -411,7 +458,9 @@ class MetricsRegistry:
         out: Dict[str, float] = {}
         for name, value in self.scalars(prefix).items():
             # drop the per-quantile histogram fields from the wire payload
-            if name.endswith((".p50", ".p95", ".p99", ".min", ".max", ".sum")):
+            # (.p999 is the digest backend's extra tail field)
+            if name.endswith((".p50", ".p95", ".p99", ".p999", ".min",
+                              ".max", ".sum")):
                 continue
             out[name] = value
         return out

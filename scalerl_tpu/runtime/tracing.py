@@ -246,6 +246,7 @@ class Tracer:
         self._sink_path: Optional[str] = None
         self._tls = threading.local()
         self._rng = random.Random(os.urandom(8))
+        self._listeners: List[Callable[[Dict[str, Any]], None]] = []
 
     # -- sampling + span creation ---------------------------------------
     def _sample(self) -> bool:
@@ -301,6 +302,32 @@ class Tracer:
                 reg.counter("trace.spans_dropped").inc()
             self._ring.append(rec)
             self._sink_write(rec)
+            listeners = list(self._listeners)
+        # outside the ring lock: a listener (the TierLedger's online feed)
+        # may take its own locks and must never be able to deadlock a span
+        # end against finished()/clear()
+        for fn in listeners:
+            try:
+                fn(rec)
+            except Exception as e:  # noqa: BLE001 — a listener must never kill a span site
+                logger.warning("trace listener failed: %r", e)
+
+    def add_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """Subscribe ``fn`` to every finished-span record (called after the
+        ring append, outside the ring lock).  This is how the streaming
+        tier attribution (``runtime/attribution.py``) consumes spans ONLINE
+        without polling the bounded ring — same records the JSONL sink
+        writes, zero extra stamps."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     def finished(self) -> List[Dict[str, Any]]:
         """The retained span records, oldest first (bounded ring)."""

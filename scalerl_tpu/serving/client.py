@@ -69,6 +69,12 @@ class PendingReply:
         self._reply = reply
         self._event.set()
 
+    def done(self) -> bool:
+        """Non-blocking: has a reply (or a link-loss verdict) landed?
+        Poll-harvest callers (the traffic replay) sweep thousands of these
+        without parking a thread per request."""
+        return self._event.is_set()
+
     def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         if not self._event.wait(timeout):
             raise TimeoutError(f"no reply for request {self.req_id}")

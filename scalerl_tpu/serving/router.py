@@ -91,6 +91,13 @@ HEALTHY = "healthy"
 DRAINING = "draining"
 EJECTED = "ejected"
 
+# breaker state -> gauge code (``router.breaker.<replica>``): a replay
+# verdict correlates a p99 spike against this timeline numerically.
+# 0 = closed (healthy, in rotation), 1 = open (ejected), 2 = probing
+# (one trial in flight), 3 = draining (rollout/scale-down)
+BREAKER_CODES = {HEALTHY: 0.0, EJECTED: 1.0, DRAINING: 3.0}
+BREAKER_PROBING = 2.0
+
 
 @dataclass
 class RouterConfig:
@@ -364,7 +371,10 @@ class ServingRouter:
         self.readmissions = 0
         self.rollouts = 0
         reg = telemetry.get_registry()
-        self._lat_hist = reg.histogram("router.latency_s")
+        # digest backend: aggregate_p95_ms() is the autoscaler's capacity
+        # signal — it must hold its relative-error bound at front-door
+        # request counts, which the reservoir backend cannot (ISSUE 20)
+        self._lat_hist = reg.histogram("router.latency_s", backend="digest")
         self._req_meter = reg.meter("router.requests_per_s")
         self._req_counter = reg.counter("router.requests")
         self._retry_counter = reg.counter("router.retries")
@@ -398,6 +408,7 @@ class ServingRouter:
                 jitter=self.config.probe_jitter,
                 rng=self._rng,
             )
+        self._export_breaker(replica.name)
         self._liveness.beat(replica.name)
         t = threading.Thread(
             target=self._replica_loop, args=(replica,),
@@ -426,6 +437,7 @@ class ServingRouter:
             return None
         health = self._health[name]
         health.mark_draining()
+        self._export_breaker(name)
         if drain:
             self._await_drain(replica)
         with self._lock:
@@ -576,6 +588,10 @@ class ServingRouter:
                 h = self._health[r.name]
                 if h.state == EJECTED and not h.probing and now >= h.probe_at:
                     h.probing = True
+                    # the open->probing edge of the breaker timeline: a
+                    # gauge write + flight event, both host-side and cheap
+                    self._export_breaker(r.name)
+                    telemetry.record_event("router_probe", replica=r.name)
                     return r
             candidates = [
                 r for r in eligible
@@ -736,15 +752,42 @@ class ServingRouter:
     def _note_ejection(self, replica: ReplicaHandle, why: str) -> None:
         self.ejections += 1
         self._eject_counter.inc()
+        self._export_breaker(replica.name)
         telemetry.record_event("router_eject", replica=replica.name, why=why)
         logger.warning("router: ejected replica %s (%s)", replica.name, why)
 
     def _note_readmission(self, replica: ReplicaHandle) -> None:
         self.readmissions += 1
         self._readmit_counter.inc()
+        self._export_breaker(replica.name)
         telemetry.record_event("router_readmit", replica=replica.name)
         logger.info("router: re-admitted replica %s", replica.name)
         self._catch_up(replica)
+
+    def _export_breaker(self, name: str) -> None:
+        """Export one replica's breaker state as a gauge
+        (``router.breaker.<replica>``; see :data:`BREAKER_CODES`).  Called
+        on every transition — a replay verdict lines p99 spikes up against
+        this timeline plus the eject/readmit/probe/rollout flight events."""
+        h = self._health.get(name)
+        if h is None:
+            return
+        code = (
+            BREAKER_PROBING if (h.state == EJECTED and h.probing)
+            else BREAKER_CODES.get(h.state, 0.0)
+        )
+        telemetry.get_registry().gauge(f"router.breaker.{name}").set(code)
+
+    def breaker_states(self) -> Dict[str, str]:
+        """The per-replica breaker state, human vocabulary (``probing``
+        refines ``ejected`` while the trial request is in flight)."""
+        with self._lock:
+            return {
+                name: ("probing" if (h.state == EJECTED and h.probing)
+                       else h.state)
+                for name, h in self._health.items()
+                if any(r.name == name for r in self.replicas)
+            }
 
     def _on_replica_down(self, replica: ReplicaHandle, why: str) -> None:
         """Death verdict: eject, close, and re-dispatch every in-flight
@@ -853,11 +896,23 @@ class ServingRouter:
             in_rotation = health.state == HEALTHY
             if in_rotation:
                 health.mark_draining()
+                self._export_breaker(replica.name)
+                # the rollout phase timeline: drain -> push -> readmit per
+                # replica, so a replay verdict can correlate a latency
+                # spike with exactly which phase the fleet was in
+                telemetry.record_event(
+                    "router_rollout_phase", replica=replica.name,
+                    phase="drain", rollout=self.rollouts,
+                )
                 self._await_drain(replica)
                 # stragglers past the drain bound re-dispatch (the replica
                 # may be wedged; at-least-once covers the race where it
                 # still answers)
                 self._redispatch_inflight(replica)
+            telemetry.record_event(
+                "router_rollout_phase", replica=replica.name, phase="push",
+                rollout=self.rollouts,
+            )
             gen = replica.server.push_params(params, learner_step=learner_step)
             replica.generation = max(replica.generation, int(gen))
             replica.epoch = max(replica.epoch, self.learner_epoch)
@@ -866,6 +921,11 @@ class ServingRouter:
                 # aligned) but NOT a free pass back into rotation — only
                 # its probe can re-admit it
                 health.readmit()
+                self._export_breaker(replica.name)
+                telemetry.record_event(
+                    "router_rollout_phase", replica=replica.name,
+                    phase="readmit", rollout=self.rollouts,
+                )
             telemetry.record_event(
                 "router_rollout", replica=replica.name, gen=replica.generation
             )
@@ -942,6 +1002,7 @@ class ServingRouter:
             "learner_epoch": self.learner_epoch,
             "epoch_min": min(epochs, default=0),
             "stale_rollouts": self.stale_rollouts,
+            "breaker": self.breaker_states(),
         }
 
 

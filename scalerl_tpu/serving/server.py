@@ -186,7 +186,10 @@ class InferenceServer(ParamSnapshotPlane):
         # under the transfer guard — the JG001 runtime enforcement
         self._warm_buckets: set = set()
         reg = telemetry.get_registry()
-        self._lat_hist = reg.histogram("serving.latency_s")
+        # digest backend: the SLO quantiles must stay honest at unbounded
+        # request counts — a 256-sample reservoir's p99 is reservoir bias,
+        # not a tail (runtime/attribution.LatencyDigest, ISSUE 20)
+        self._lat_hist = reg.histogram("serving.latency_s", backend="digest")
         self._occ_hist = reg.histogram("serving.batch_occupancy")
         self._req_meter = reg.meter("serving.requests_per_s")
         self._req_counter = reg.counter("serving.requests")

@@ -1,0 +1,434 @@
+"""Streaming tier attribution (ISSUE 20): the log-bucket digest's error
+bound and exact merge, the exact-sum tier walk over synthetic span-tree
+shapes (incl. requeue/re-dispatch and duplicate-reply), the online
+TierLedger fed by the tracer listener, and the traffic_replay verdict
+schema.
+
+jax-free on purpose — the digest, the walk, and the ledger are host-side
+dict work; these tests run in milliseconds (the 1M-sample digest check
+goes through the vectorized ``observe_array`` path).
+"""
+
+import json
+import math
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from scalerl_tpu.runtime import telemetry, tracing
+from scalerl_tpu.runtime.attribution import (
+    TIER_HEAD_GAP,
+    TIER_INTERIOR_GAP,
+    TIER_TAIL_GAP,
+    LatencyDigest,
+    TierLedger,
+    attribute_edges,
+    attribute_tiers,
+    build_traces,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_planes():
+    telemetry.reset()
+    tracing.reset()
+    yield
+    telemetry.reset()
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# LatencyDigest
+
+
+def test_digest_quantile_within_relative_error_on_1m_samples():
+    rng = np.random.default_rng(0)
+    # a realistic latency shape: lognormal body + a heavy mixture tail
+    vals = np.concatenate([
+        rng.lognormal(mean=-4.0, sigma=0.8, size=900_000),
+        rng.lognormal(mean=-1.5, sigma=0.5, size=100_000),
+    ])
+    d = LatencyDigest(relative_error=0.01)
+    d.observe_array(vals)
+    assert d.count == vals.size
+    srt = np.sort(vals)
+    for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+        # the sketch targets the lower-rank order statistic
+        exact = float(srt[int(q * (srt.size - 1))])
+        est = d.quantile(q)
+        assert abs(est - exact) <= 0.01 * exact + 1e-12, (q, est, exact)
+
+
+def test_digest_merge_is_associative_and_commutative():
+    rng = np.random.default_rng(1)
+    parts = [rng.lognormal(size=2000) * s for s in (1.0, 3.0, 0.2)]
+
+    def digest_of(arrays):
+        d = LatencyDigest(relative_error=0.02)
+        for a in arrays:
+            d.observe_array(a)
+        return d
+
+    def merged(order):
+        ds = [digest_of([parts[i]]) for i in order]
+        out = ds[0]
+        for d in ds[1:]:
+            out.merge(d)
+        return out
+
+    a = merged([0, 1, 2])
+    b = merged([2, 0, 1])
+    # (d0 + d1) + d2 vs d0 + (d1 + d2)
+    left = digest_of([parts[0]]).merge(digest_of([parts[1]]))
+    left.merge(digest_of([parts[2]]))
+    right23 = digest_of([parts[1]]).merge(digest_of([parts[2]]))
+    right = digest_of([parts[0]]).merge(right23)
+    one_pass = digest_of(parts)
+    for other in (b, left, right, one_pass):
+        assert a._buckets == other._buckets
+        assert a.count == other.count
+        assert a.zero_count == other.zero_count
+        assert math.isclose(a.sum, other.sum, rel_tol=1e-9)
+        assert a.quantile(0.99) == other.quantile(0.99)
+
+
+def test_digest_merge_rejects_gamma_mismatch():
+    with pytest.raises(ValueError):
+        LatencyDigest(relative_error=0.01).merge(
+            LatencyDigest(relative_error=0.02)
+        )
+
+
+def test_digest_wire_roundtrip_and_zero_bucket():
+    d = LatencyDigest(relative_error=0.01)
+    d.observe(0.0)          # zero bucket
+    d.observe(1e-12)        # clock-noise floor -> zero bucket
+    d.observe(0.5)
+    d.observe(2.0)
+    back = LatencyDigest.from_wire(d.to_wire())
+    assert back.count == 4 and back.zero_count == 2
+    assert back.read() == d.read()
+    assert json.loads(json.dumps(d.to_wire())) == d.to_wire()
+    assert d.quantile(0.0) == 0.0  # the zero bucket reports exactly 0
+
+
+def test_digest_collapse_preserves_tail():
+    # 98% of mass smeared over ~350 low buckets, 2% in a tight high group:
+    # the bound forces a collapse of the LOW buckets, and the p99 (which
+    # lives in the high group) must keep its error bound
+    d = LatencyDigest(relative_error=0.01, max_buckets=32)
+    rng = np.random.default_rng(2)
+    low = rng.uniform(1e-6, 1e-3, size=49_000)
+    high = rng.uniform(90.0, 110.0, size=1_000)
+    vals = np.concatenate([low, high])
+    d.observe_array(vals)
+    assert d._collapsed_at is not None  # the collapse actually happened
+    assert len(d._buckets) <= 32
+    exact = float(np.sort(vals)[int(0.99 * (vals.size - 1))])
+    assert abs(d.quantile(0.99) - exact) <= 0.01 * exact
+
+
+# ---------------------------------------------------------------------------
+# the exact-sum tier walk (synthetic span-tree shapes)
+
+
+def _span(trace, span, parent, name, t0, dur, **attrs):
+    return {"trace": trace, "span": span, "parent": parent, "name": name,
+            "kind": "serving", "host": "h", "t0": t0, "dur": dur,
+            "attrs": attrs}
+
+
+def _tiers_of(spans):
+    traces = build_traces(spans)
+    (tid,) = traces
+    t = traces[tid]
+    return attribute_tiers(t), t
+
+
+def test_tiers_nested_shape_sums_exactly_and_splits_router():
+    # the replay shape: root encloses router.route encloses serve.*
+    spans = [
+        _span("t1", "r", None, "traffic.request", 0.0, 1.0),
+        _span("t1", "a", "r", "router.route", 0.1, 0.8),
+        _span("t1", "b", "r", "serve.queue_wait", 0.2, 0.3),
+        _span("t1", "c", "r", "serve.flush", 0.5, 0.3),
+    ]
+    tiers, t = _tiers_of(spans)
+    assert abs(sum(tiers.values()) - t["e2e"]) < 1e-9
+    # innermost wins: router.dispatch gets [0.1,0.2) + [0.8,0.9) — the
+    # dispatch head AND the reply hop back through the router
+    assert tiers[TIER_HEAD_GAP] == pytest.approx(0.1)
+    assert tiers["router.dispatch"] == pytest.approx(0.2)
+    assert tiers["replica.queue"] == pytest.approx(0.3)
+    assert tiers["replica.flush"] == pytest.approx(0.3)
+    assert tiers[TIER_TAIL_GAP] == pytest.approx(0.1)
+
+
+def test_tiers_requeue_redispatch_shape_sums_exactly():
+    # a replica died mid-service: TWO router.route attempts and two
+    # partial serve records overlap; every interval still lands exactly
+    # once
+    spans = [
+        _span("t1", "r", None, "traffic.request", 0.0, 2.0),
+        _span("t1", "a1", "r", "router.route", 0.1, 1.7),
+        _span("t1", "q1", "r", "serve.queue_wait", 0.2, 0.2),
+        _span("t1", "f1", "r", "serve.flush", 0.4, 0.3),   # died mid-flush
+        _span("t1", "q2", "r", "serve.queue_wait", 0.9, 0.4),
+        _span("t1", "f2", "r", "serve.flush", 1.3, 0.4),
+    ]
+    tiers, t = _tiers_of(spans)
+    assert abs(sum(tiers.values()) - t["e2e"]) < 1e-9
+    assert tiers["replica.queue"] == pytest.approx(0.6)
+    assert tiers["replica.flush"] == pytest.approx(0.7)
+    # router.dispatch: [0.1,0.2) + [0.7,0.9) + [1.7,1.8)
+    assert tiers["router.dispatch"] == pytest.approx(0.4)
+    assert tiers[TIER_TAIL_GAP] == pytest.approx(0.2)
+
+
+def test_tiers_interior_gap_and_no_children():
+    spans = [
+        _span("t1", "r", None, "traffic.request", 0.0, 1.0),
+        _span("t1", "b", "r", "serve.queue_wait", 0.2, 0.2),
+        _span("t1", "c", "r", "serve.flush", 0.6, 0.2),
+    ]
+    tiers, t = _tiers_of(spans)
+    assert abs(sum(tiers.values()) - t["e2e"]) < 1e-9
+    assert tiers[TIER_INTERIOR_GAP] == pytest.approx(0.2)  # [0.4, 0.6)
+    # a shed trace: root only — everything is the client dispatch leg
+    tiers2, t2 = _tiers_of(
+        [_span("t2", "r", None, "traffic.request", 0.0, 0.5)]
+    )
+    assert tiers2 == {TIER_HEAD_GAP: pytest.approx(0.5)}
+
+
+def test_attribute_edges_cursor_semantics_unchanged():
+    # the legacy sequential walk trace_report re-exports: earlier-starting
+    # span keeps the overlap, holes are "untracked"
+    spans = [
+        _span("t1", "r", None, "sequence", 0.0, 1.0),
+        _span("t1", "a", "r", "seq.decode", 0.1, 0.4),
+        _span("t1", "b", "r", "seq.upload", 0.4, 0.3),
+    ]
+    traces = build_traces(spans)
+    edges = attribute_edges(traces["t1"])
+    assert edges["seq.decode"] == pytest.approx(0.4)
+    assert edges["seq.upload"] == pytest.approx(0.2)  # clipped overlap
+    assert edges["untracked"] == pytest.approx(0.4)
+    assert abs(sum(edges.values()) - traces["t1"]["e2e"]) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# the online ledger through the tracer listener
+
+
+def _emit_trace(ok=True):
+    root = tracing.start_span("traffic.request", kind="serving")
+    assert root.sampled
+    t0 = root.t_start
+    tracing.record_span("router.route", parent=root, t_start=t0 + 0.001,
+                        t_end=t0 + 0.009, kind="serving")
+    tracing.record_span("serve.queue_wait", parent=root, t_start=t0 + 0.002,
+                        t_end=t0 + 0.004, kind="serving")
+    tracing.record_span("serve.flush", parent=root, t_start=t0 + 0.004,
+                        t_end=t0 + 0.008, kind="serving")
+    root.end(t_end=t0 + 0.010)
+    return root
+
+
+def test_tier_ledger_online_decomposition(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_SAMPLE, "1.0")
+    tracing.reset()
+    tracer = tracing.get_tracer()
+    reg = telemetry.get_registry()
+    ledger = TierLedger(registry=reg).attach(tracer)
+    for _ in range(5):
+        _emit_trace()
+    assert ledger.decomposed == 5
+    assert ledger.orphans == 0
+    assert ledger.max_sum_err < 1e-9
+    assert set(ledger.digests) >= {"router.dispatch", "replica.queue",
+                                   "replica.flush"}
+    assert ledger.digests["replica.flush"].count == 5
+    bn = ledger.bottleneck()
+    assert bn["bottleneck_tier"] in bn["tiers"]
+    assert bn["e2e_p50_ms"] > 0
+    # shares sum to 1 over the attributed time
+    assert sum(r["share"] for r in bn["tiers"].values()) == pytest.approx(
+        1.0, abs=1e-3
+    )
+    # registry binding: the snapshot carries the attr tree
+    snap = reg.snapshot()
+    assert snap["attr"]["decomposed"] == 5
+    ledger.detach(tracer)
+    _emit_trace()
+    assert ledger.decomposed == 5  # detached: no longer fed
+
+
+def test_tier_ledger_late_spans_and_orphans(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_SAMPLE, "1.0")
+    tracing.reset()
+    tracer = tracing.get_tracer()
+    ledger = TierLedger().attach(tracer)
+    root = _emit_trace()
+    assert ledger.decomposed == 1
+    # a duplicate reply lands AFTER decomposition: counted late, never
+    # re-opened, never an orphan
+    tracing.record_span("serve.flush", parent=root,
+                        t_start=root.t_start + 0.02,
+                        t_end=root.t_start + 0.03, kind="serving")
+    assert ledger.late_spans == 1
+    assert ledger.decomposed == 1
+    # a rootless trace (its root never ends) drains as an orphan
+    dangling = tracing.start_span("traffic.request", kind="serving")
+    tracing.record_span("serve.flush", parent=dangling,
+                        t_start=0.0, t_end=0.1, kind="serving")
+    assert ledger.drain() == 1
+    assert ledger.orphans == 1
+    # spans from families the ledger does not track are never buffered
+    seq = tracing.start_span("sequence", kind="seq")
+    tracing.record_span("seq.decode", parent=seq, t_start=0.0, t_end=0.1)
+    seq.end()
+    assert ledger.drain() == 0
+    ledger.detach(tracer)
+
+
+def test_tier_ledger_bounded_pending_evicts_stalest(monkeypatch):
+    monkeypatch.setenv(tracing.ENV_SAMPLE, "1.0")
+    tracing.reset()
+    tracer = tracing.get_tracer()
+    ledger = TierLedger(max_pending=4).attach(tracer)
+    for _ in range(8):
+        dangling = tracing.start_span("traffic.request", kind="serving")
+        tracing.record_span("serve.flush", parent=dangling,
+                            t_start=0.0, t_end=0.1, kind="serving")
+    assert ledger.orphans == 4  # evicted beyond the cap
+    assert ledger.drain() == 4
+    ledger.detach(tracer)
+
+
+# ---------------------------------------------------------------------------
+# telemetry Histogram digest backend
+
+
+def test_histogram_digest_backend_quantiles_and_wire():
+    reg = telemetry.get_registry()
+    h = reg.histogram("front.latency_s", backend="digest",
+                      relative_error=0.01)
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(mean=-3.0, sigma=1.0, size=20_000)
+    for v in vals[:64]:
+        h.observe(float(v))
+    h._digest.observe_array(vals[64:])
+    h.count = float(vals.size)
+    srt = np.sort(vals)
+    exact_p99 = float(srt[int(0.99 * (srt.size - 1))])
+    assert abs(h.quantile(0.99) - exact_p99) <= 0.01 * exact_p99 + 1e-12
+    assert h.read()["p999"] > 0
+    wire = h.digest_wire()
+    assert wire is not None
+    assert LatencyDigest.from_wire(wire).quantile(0.99) == h.quantile(0.99)
+    # reservoir instruments have no digest to export
+    r = reg.histogram("small.latency_s")
+    assert r.digest_wire() is None
+    with pytest.raises(ValueError):
+        reg.histogram("bad.backend", backend="tdigest")
+
+
+def test_histogram_digest_in_compact_and_prometheus(tmp_path):
+    reg = telemetry.get_registry()
+    h = reg.histogram("front.latency_s", backend="digest")
+    for v in (0.01, 0.02, 0.4):
+        h.observe(v)
+    scalars = reg.scalars()
+    assert "front.latency_s.p999" in scalars
+    # the compact (piggyback) view ships count/mean, never the quantiles
+    compact = reg.compact()
+    assert "front.latency_s.mean" in compact
+    assert not any(k.endswith((".p99", ".p999")) for k in compact)
+    prom = telemetry.PrometheusExporter(str(tmp_path / "metrics.prom"))
+    prom.write(scalars)
+    text = (tmp_path / "metrics.prom").read_text()
+    assert "scalerl_front_latency_s_p99 " in text
+
+
+# ---------------------------------------------------------------------------
+# the traffic_replay verdict (fast in-process twin of the soak)
+
+REPLAY_SCHEMA = {
+    "metric": str, "clients": int, "replicas": int, "duration_s": float,
+    "fired": int, "answered": int, "good": int, "shed": int, "lost": int,
+    "goodput_rps": float, "offered_rps": float, "slo_ms": float,
+    "p50_ms": float, "p95_ms": float, "p99_ms": float,
+    "router": dict, "accounting_balanced": bool, "bottleneck_tier": str,
+    "tiers": dict, "attribution": dict, "digest_check": dict,
+    "phases": dict,
+}
+
+
+def test_traffic_replay_verdict_schema_and_gates():
+    from tools.traffic_replay import build_parser, run_replay
+
+    args = build_parser().parse_args([
+        "--clients", "8", "--shards", "2", "--replicas", "2",
+        "--duration-s", "1.5", "--base-rps", "40", "--burst-every-s", "0.7",
+        "--burst-n", "4", "--kill-replica-at", "0.8", "--service-ms", "1.0",
+    ])
+    v = run_replay(args)
+    for key, typ in REPLAY_SCHEMA.items():
+        assert key in v, key
+        assert isinstance(v[key], typ), (key, type(v[key]))
+    assert v["accounting_balanced"]
+    assert v["attribution"]["complete"]
+    assert v["attribution"]["orphans"] == 0
+    assert v["digest_check"]["ok"]
+    assert v["bottleneck_tier"] in v["tiers"]
+    assert v["router"]["ejections"] >= 1  # the seeded kill landed
+    assert json.loads(json.dumps(v)) == v  # one-line JSON artifact
+
+
+def test_traffic_replay_schedule_is_seeded_and_diurnal():
+    from tools.traffic_replay import diurnal_rate, make_schedule
+
+    a = make_schedule(10.0, 100.0, 0.6, 8.0, 0.0, 0, seed=7)
+    b = make_schedule(10.0, 100.0, 0.6, 8.0, 0.0, 0, seed=7)
+    assert np.array_equal(a, b)
+    c = make_schedule(10.0, 100.0, 0.6, 8.0, 0.0, 0, seed=8)
+    assert not np.array_equal(a, c)
+    # the sinusoid shapes density: the peak quadrant outdraws the trough
+    peak = np.sum((a % 8.0 >= 2.0) & (a % 8.0 < 4.0))
+    trough = np.sum((a % 8.0 >= 6.0) & (a % 8.0 < 8.0))
+    assert peak > trough * 1.5
+    assert diurnal_rate(2.0, 100.0, 0.6, 8.0) == pytest.approx(160.0)
+    # burst overlays land exactly on their marks
+    d = make_schedule(3.0, 10.0, 0.0, 8.0, 1.0, 5, seed=0)
+    assert np.sum(d == 1.0) == 5 and np.sum(d == 2.0) == 5
+
+
+def test_trace_report_traffic_mode(tmp_path, monkeypatch, capsys):
+    # offline twin: span files -> --traffic tier table + verdict line
+    monkeypatch.setenv(tracing.ENV_SAMPLE, "1.0")
+    monkeypatch.setenv(tracing.ENV_DIR, str(tmp_path))
+    tracing.reset()
+    for _ in range(3):
+        _emit_trace()
+    tracing.get_tracer().close()
+
+    from tools.trace_report import main as report_main
+
+    rc = report_main([str(tmp_path), "--traffic"])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    traffic = [json.loads(ln) for ln in lines
+               if json.loads(ln).get("metric") == "traffic_report"]
+    assert len(traffic) == 1
+    v = traffic[0]
+    assert v["traffic_traces"] == 3
+    assert v["bottleneck_tier"] in v["tiers"]
+    assert v["max_sum_err_s"] < 1e-9

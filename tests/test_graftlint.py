@@ -1635,3 +1635,63 @@ def test_cli_list_rules_includes_v2(capsys):
     listed = capsys.readouterr().out
     for rule_id in ("JG001", "JG006", "JG007", "JG008", "JG009"):
         assert rule_id in listed
+
+
+# ---------------------------------------------------------------------------
+# tier-attribution fixtures (ISSUE 20): scalerl_tpu/runtime is a HOT
+# package — the streaming attribution path (span stamps -> TierLedger ->
+# per-tier digests) must never buy a timestamp with a device sync
+
+ATTR = "scalerl_tpu/runtime/attribution_fixture.py"
+
+GOOD_ATTR_HOST_STAMPS = """
+    import time
+
+    from scalerl_tpu.runtime import telemetry, tracing
+
+    def route_loop(requests, route_one, ledger):
+        reg = telemetry.get_registry()
+        lat = reg.histogram("router.latency_s", backend="digest")
+        for msg in requests:
+            t0 = time.monotonic()          # host stamp, free
+            reply = route_one(msg)
+            t1 = time.monotonic()
+            # retroactive span from stamps already taken: the sanctioned
+            # hot-path idiom — no extra syscalls, no device value
+            tracing.record_span(
+                "router.route", parent=tracing.extract(msg),
+                t_start=t0, t_end=t1, kind="serving",
+            )
+            lat.observe(t1 - t0)           # host float into the digest
+"""
+
+BAD_ATTR_DEVICE_STAMP_PER_REQUEST = """
+    import jax
+
+    from scalerl_tpu.runtime import telemetry, tracing
+
+    def route_loop(requests, route_one):
+        reg = telemetry.get_registry()
+        lat = reg.histogram("router.latency_s", backend="digest")
+        for msg in requests:
+            reply = route_one(msg)
+            # "timing" the route by materializing the reply blocks the
+            # dispatch queue once per request — the transfer storm the
+            # tier ledger exists to make visible, not cause
+            logits = jax.device_get(reply["logits"])
+            lat.observe(float(logits.sum()))
+"""
+
+
+def test_jg001_attribution_host_stamp_path_is_clean():
+    """The streaming-attribution idiom — two host monotonic stamps, one
+    retroactive record_span, one digest observe — lints clean in the HOT
+    runtime package."""
+    assert lint(GOOD_ATTR_HOST_STAMPS, relpath=ATTR) == []
+
+
+def test_jg001_attribution_device_stamp_per_request_flags():
+    """Buying a per-request latency sample with jax.device_get in the
+    route loop is exactly what JG001 exists to flag in runtime/."""
+    findings = lint(BAD_ATTR_DEVICE_STAMP_PER_REQUEST, relpath=ATTR)
+    assert "JG001" in rules_of(findings)

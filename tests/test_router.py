@@ -623,6 +623,120 @@ def test_client_observed_generation_is_monotonic_across_rollout():
 
 
 # ---------------------------------------------------------------------------
+# breaker observability (ISSUE 20): per-replica gauge codes + flight events
+
+
+def test_breaker_gauge_tracks_eject_and_readmit():
+    from scalerl_tpu.runtime import telemetry
+    from scalerl_tpu.serving.router import BREAKER_CODES
+
+    telemetry.reset()
+    flappy = StubReplica("flappy", mode="shed")
+    steady = StubReplica("steady")
+    router = _router([flappy, steady], eject_after=1,
+                     probe_backoff_s=0.02, probe_backoff_cap_s=0.05)
+    client = RawClient(router)
+    rng = np.random.default_rng(6)
+    gauge = telemetry.get_registry().gauge("router.breaker.flappy")
+    try:
+        # add_replica exported the initial state for every replica
+        assert gauge.read() == BREAKER_CODES[HEALTHY]
+        assert telemetry.get_registry().gauge(
+            "router.breaker.steady").read() == BREAKER_CODES[HEALTHY]
+        sent = 0
+        deadline = time.monotonic() + 3.0
+        while (router._health["flappy"].state != EJECTED
+               and time.monotonic() < deadline):
+            client.send(_act_msg(
+                f"b{sent}", rng.normal(size=(2, 8)).astype(np.float32)))
+            sent += 1
+            time.sleep(0.002)
+        assert gauge.read() == BREAKER_CODES[EJECTED]
+        assert router.breaker_states()["flappy"] == "ejected"
+        assert router.stats()["breaker"]["flappy"] == "ejected"
+        flappy.mode = "ok"
+        deadline = time.monotonic() + 3.0
+        while router.readmissions == 0 and time.monotonic() < deadline:
+            client.send(_act_msg(
+                f"b{sent}", rng.normal(size=(2, 8)).astype(np.float32)))
+            sent += 1
+            time.sleep(0.01)
+        assert router.readmissions >= 1
+        assert gauge.read() == BREAKER_CODES[HEALTHY]
+        assert router.stats()["breaker"] == {"flappy": "healthy",
+                                             "steady": "healthy"}
+        # the flight recorder holds the transition timeline the gauges
+        # summarize: eject -> (probe) -> readmit, by replica name
+        kinds = {e["kind"] for e in telemetry.get_recorder().events()
+                 if e.get("replica") == "flappy"}
+        assert {"router_eject", "router_readmit"} <= kinds
+    finally:
+        _teardown(router, [flappy, steady], [client])
+        telemetry.reset()
+
+
+def test_rollout_emits_phase_events_and_drain_gauge():
+    from scalerl_tpu.runtime import telemetry
+    from scalerl_tpu.serving.router import BREAKER_CODES
+
+    telemetry.reset()
+    reps = [StubReplica(f"r{i}", gen=1) for i in range(2)]
+    router = _router(reps)
+    try:
+        router.rollout({"w": 1}, learner_step=3)
+        phases = [
+            (e.get("replica"), e.get("phase"))
+            for e in telemetry.get_recorder().events("router_rollout_phase")
+        ]
+        # every replica walked drain -> push -> readmit, in order
+        for s in reps:
+            mine = [p for r, p in phases if r == s.name]
+            assert mine == ["drain", "push", "readmit"], phases
+        # and the breaker gauges ended back at healthy after the roll
+        for s in reps:
+            assert telemetry.get_registry().gauge(
+                f"router.breaker.{s.name}").read() == BREAKER_CODES[HEALTHY]
+        assert all(v == "healthy"
+                   for v in router.stats()["breaker"].values())
+    finally:
+        _teardown(router, reps)
+        telemetry.reset()
+
+
+def test_router_latency_instrument_uses_digest_backend():
+    from scalerl_tpu.runtime import telemetry
+
+    telemetry.reset()
+    reps = [StubReplica("r0")]
+    router = _router(reps)
+    try:
+        # the SLO quantile instrument rides the mergeable digest, not the
+        # 256-slot reservoir: its p99 stays honest at traffic counts
+        h = telemetry.get_registry().histogram("router.latency_s")
+        assert h.backend == "digest"
+        assert h.digest_wire() is not None
+    finally:
+        _teardown(router, reps)
+        telemetry.reset()
+
+
+def test_removed_replica_leaves_breaker_states():
+    from scalerl_tpu.runtime import telemetry
+
+    telemetry.reset()
+    reps = [StubReplica(f"r{i}") for i in range(2)]
+    router = _router(reps)
+    try:
+        assert set(router.breaker_states()) == {"r0", "r1"}
+        router.remove_replica("r1")
+        # the states map tracks the live replica set only
+        assert set(router.breaker_states()) == {"r0"}
+    finally:
+        _teardown(router, reps)
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
 # the serving-tier autoscaler loop
 
 

@@ -336,6 +336,63 @@ def _trace_marker(bl, start_offset: int) -> str:
         return ""
 
 
+def _traffic_marker(bl, start_offset: int) -> str:
+    """Gate the traffic-replay step on the traffic_replay verdict line.
+
+    ``tools/traffic_replay.py`` drives the router with diurnal open-loop
+    socket traffic while the streaming tier attribution decomposes every
+    sampled request; the verdict carries four acceptance facts and this
+    marker gates on all of them: exact router accounting
+    (admitted == answered + shed + orphaned), attribution completeness
+    (every sampled root decomposed, zero orphaned traces), the digest's
+    p99 within its relative-error bound of the exact percentile, and a
+    non-empty ``bottleneck_tier``.  Failures mark
+    ``!traffic(orphans=N,unattributed=X,...)``; a clean soak marks
+    ``+traffic(<bottleneck_tier>)``.
+    """
+    try:
+        bl.flush()
+        with open(bl.name, "r", errors="replace") as f:
+            f.seek(start_offset)
+            segment = f.read()
+        verdict = None
+        for line in segment.splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if obj.get("metric") == "traffic_replay":
+                verdict = obj
+        if not verdict:
+            return ""
+        attr = verdict.get("attribution") or {}
+        digest = verdict.get("digest_check") or {}
+        bad = []
+        if not verdict.get("accounting_balanced"):
+            bad.append("unbalanced")
+        if int(attr.get("orphans", 0)) > 0:
+            bad.append(f"orphans={attr['orphans']}")
+        unattributed = int(attr.get("sampled", 0)) - int(
+            attr.get("decomposed", 0)
+        )
+        if unattributed != 0:
+            bad.append(f"unattributed={unattributed}")
+        if not digest.get("ok", False):
+            bad.append(f"digest_err={digest.get('rel_err')}")
+        if not verdict.get("bottleneck_tier"):
+            bad.append("no-bottleneck")
+        if bad:
+            bl.write(f"[watcher] TRAFFIC GATE: {','.join(bad)} — flagging\n")
+            return "!traffic(" + ",".join(bad) + ")"
+        return f"+traffic({verdict['bottleneck_tier']})"
+    except Exception as e:  # noqa: BLE001 - diagnosis must not fail the watcher
+        bl.write(f"[watcher] traffic gate failed: {e}\n")
+        return ""
+
+
 def perf_gate_verdict(
     new_value: float, prior_values, threshold: float = 0.2
 ):
@@ -561,6 +618,19 @@ def run_payload(n_devices: int = 1) -> None:
          [sys.executable, "tools/disagg_soak.py", "--trace-dir",
           "/tmp/tpu_watch_trace", "--leases", "48"],
          600, dict(env, JAX_PLATFORMS="cpu")),
+        # traffic replay soak: diurnal x Poisson open-loop arrivals (plus
+        # burst overlays and one seeded replica kill) through the router's
+        # REAL listening socket from 1k RemotePolicyClients, with the
+        # streaming tier attribution decomposing every request online.
+        # _traffic_marker gates on exact accounting, attribution
+        # completeness (zero orphans, every sampled root decomposed), the
+        # digest error bound, and a named bottleneck tier.  jax-free
+        # scripted replicas, bounded, runs tunnel-down, non-quorum
+        ("traffic-replay",
+         [sys.executable, "tools/traffic_replay.py", "--clients", "1000",
+          "--duration-s", "20", "--base-rps", "300",
+          "--kill-replica-at", "8", "--rollout-at", "14"],
+         600, dict(env, JAX_PLATFORMS="cpu")),
         # genrl soak: the hermetic token-PPO e2e (generate -> score
         # -> learn on the synthetic recall task, scan/unroll decode parity,
         # reward-improvement threshold).  CPU-pinned and ~1 min (measured
@@ -604,8 +674,11 @@ def run_payload(n_devices: int = 1) -> None:
         # (traffic_goodput_rps), perf-gated like-for-like against
         # traffic-mode history; the artifact also carries the exact-
         # accounting verdict (accounting_balanced) from the router ledger
+        # plus the streaming tier attribution's bottleneck_tier — sampling
+        # must be armed here or every traffic.request is head-sampled out
+        # and the tier verdict rides empty
         ("bench-traffic", [sys.executable, "bench.py", "--mode", "traffic"],
-         1500, dict(env, BENCH_SKIP_MICRO="1")),
+         1500, dict(env, BENCH_SKIP_MICRO="1", SCALERL_TRACE_SAMPLE="1.0")),
         # token-level sequence-RL plane: prefill/decode tokens/s/chip
         # through the KV-cached generation engine + token-PPO learn
         # steps/s; perf-gated like-for-like against genrl-mode history and
@@ -686,6 +759,8 @@ def run_payload(n_devices: int = 1) -> None:
                 if name == "trace-soak":
                     status += _disagg_marker(bl, step_start)
                     status += _trace_marker(bl, step_start)
+                if name == "traffic-replay":
+                    status += _traffic_marker(bl, step_start)
                 outcomes.append((name, status + _telemetry_marker(telem_dir, bl)))
             except Exception as e:  # noqa: BLE001 - watcher must survive anything
                 bl.write(f"[watcher] {name} failed: {e}\n")
@@ -700,7 +775,8 @@ def run_payload(n_devices: int = 1) -> None:
         for name, status in outcomes
         if name not in (
             "lint-rules", "lint", "chaos-soak", "elastic-soak",
-            "disagg-soak", "preempt-soak", "trace-soak", "genrl-soak",
+            "disagg-soak", "preempt-soak", "trace-soak", "traffic-replay",
+            "genrl-soak",
         )
     ):
         # nothing TPU-witnessed succeeded (lint, the chaos soak, the

@@ -27,7 +27,17 @@ The last stdout line is a one-line JSON verdict
 its trace-soak step on: ``sequence_traces`` vs ``complete_sequences``
 (root -> learn_step present) and ``orphan_spans``.
 
-jax-free, stdlib-only: runs anywhere the soak ran.
+``--traffic`` additionally runs the tier-attribution walk
+(``scalerl_tpu.runtime.attribution``) over every traffic trace
+(``traffic.request`` / ``serve.request`` roots), prints the per-tier
+latency table, and emits a second verdict line
+(``{"metric": "traffic_report", "bottleneck_tier": ...}``) — the offline
+twin of the streaming ``TierLedger`` that multi-host runs use, since the
+ledger can only see spans recorded through the local tracer.
+
+jax-free: the trace-tree grouping and the exact-sum attribution walk
+live in ``scalerl_tpu.runtime.attribution`` (shared with the online
+ledger) and are re-exported here for compatibility.
 """
 
 from __future__ import annotations
@@ -38,6 +48,16 @@ import json
 import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scalerl_tpu.runtime.attribution import (  # noqa: F401  (re-exports)
+    TRAFFIC_ROOTS,
+    LatencyDigest,
+    attribute_edges,
+    attribute_tiers,
+    build_traces,
+)
 
 # edge-name -> cost class for the queue/compute/wire rollup
 EDGE_CLASSES = {
@@ -110,57 +130,75 @@ def load_dir(trace_dir: str) -> Tuple[List[Dict], Dict[str, float]]:
     return spans, offsets
 
 
-def build_traces(spans: List[Dict]) -> Dict[str, Dict[str, Any]]:
-    """Group spans by trace id; identify each trace's root and orphans."""
-    traces: Dict[str, Dict[str, Any]] = {}
-    for s in spans:
-        traces.setdefault(s["trace"], {"spans": []})["spans"].append(s)
+def build_traffic_report(
+    traces: Dict[str, Dict[str, Any]], relative_error: float = 0.01
+) -> Dict[str, Any]:
+    """Per-tier latency table + bottleneck verdict over the traffic
+    traces (``TRAFFIC_ROOTS``-rooted) in an already-built trace set."""
+    tier_digests: Dict[str, LatencyDigest] = {}
+    tier_totals: Dict[str, float] = {}
+    e2e_digest = LatencyDigest(relative_error=relative_error)
+    n = 0
+    max_sum_err = 0.0
     for t in traces.values():
-        ids = {s["span"] for s in t["spans"]}
-        t["root"] = next(
-            (s for s in t["spans"] if not s.get("parent")), None
+        root = t["root"]
+        if root is None or root["name"] not in TRAFFIC_ROOTS:
+            continue
+        n += 1
+        tiers = attribute_tiers(t)
+        max_sum_err = max(
+            max_sum_err, abs(sum(tiers.values()) - t["e2e"])
         )
-        t["orphans"] = [
-            s for s in t["spans"]
-            if s.get("parent") and s["parent"] not in ids
-        ]
-        t0 = min(float(s["t0"]) for s in t["spans"])
-        t1 = max(float(s["t0"]) + float(s["dur"]) for s in t["spans"])
-        if t["root"] is not None:
-            t0 = min(t0, float(t["root"]["t0"]))
-        t["t0"], t["t1"] = t0, t1
-        t["e2e"] = max(t1 - t0, 0.0)
-    return traces
-
-
-def attribute_edges(trace: Dict[str, Any]) -> Dict[str, float]:
-    """Charge every interval of [trace start, trace end] to exactly one
-    edge (or ``untracked``): walk the child spans in start order, clip to
-    the un-attributed suffix, fill holes with ``untracked``.  The values
-    sum to ``e2e`` by construction."""
-    edges: Dict[str, float] = {}
-    start, end = trace["t0"], trace["t1"]
-    root = trace["root"]
-    children = sorted(
-        (
-            s for s in trace["spans"]
-            if root is None or s["span"] != root["span"]
-        ),
-        key=lambda s: float(s["t0"]),
+        e2e_digest.observe(t["e2e"])
+        for tier, dur in tiers.items():
+            tier_totals[tier] = tier_totals.get(tier, 0.0) + dur
+            tier_digests.setdefault(
+                tier, LatencyDigest(relative_error=relative_error)
+            ).observe(dur)
+    total = sum(tier_totals.values()) or 1.0
+    table = {
+        tier: {
+            "share": round(tier_totals[tier] / total, 4),
+            "total_s": round(tier_totals[tier], 6),
+            "p50_ms": round(d.quantile(0.50) * 1e3, 3),
+            "p95_ms": round(d.quantile(0.95) * 1e3, 3),
+            "p99_ms": round(d.quantile(0.99) * 1e3, 3),
+            "count": d.count,
+        }
+        for tier, d in tier_digests.items()
+    }
+    bottleneck = (
+        max(table, key=lambda k: table[k]["p95_ms"]) if table else None
     )
-    cursor = start
-    for s in children:
-        s0 = max(float(s["t0"]), cursor)
-        s1 = min(float(s["t0"]) + float(s["dur"]), end)
-        if s0 > cursor:
-            edges["untracked"] = edges.get("untracked", 0.0) + (s0 - cursor)
-            cursor = s0
-        if s1 > cursor:
-            edges[s["name"]] = edges.get(s["name"], 0.0) + (s1 - cursor)
-            cursor = s1
-    if end > cursor:
-        edges["untracked"] = edges.get("untracked", 0.0) + (end - cursor)
-    return edges
+    return {
+        "metric": "traffic_report",
+        "traffic_traces": n,
+        "bottleneck_tier": bottleneck,
+        "tiers": table,
+        "max_sum_err_s": max_sum_err,
+        "e2e_p50_ms": round(e2e_digest.quantile(0.50) * 1e3, 3),
+        "e2e_p95_ms": round(e2e_digest.quantile(0.95) * 1e3, 3),
+        "e2e_p99_ms": round(e2e_digest.quantile(0.99) * 1e3, 3),
+        "relative_error": relative_error,
+    }
+
+
+def print_traffic_report(tr: Dict[str, Any], out=sys.stdout) -> None:
+    print(
+        f"traffic tiers ({tr['traffic_traces']} traces, max attribution "
+        f"error {tr['max_sum_err_s'] * 1e6:.3f}us):",
+        file=out,
+    )
+    for tier, row in sorted(
+        tr["tiers"].items(), key=lambda kv: -kv[1]["share"]
+    ):
+        print(
+            f"  {tier:<16} {100 * row['share']:5.1f}%  "
+            f"p50={row['p50_ms']:.2f}ms p95={row['p95_ms']:.2f}ms "
+            f"p99={row['p99_ms']:.2f}ms  (n={row['count']})",
+            file=out,
+        )
+    print(f"bottleneck tier: {tr['bottleneck_tier']}", file=out)
 
 
 def build_report(trace_dir: str, top: int = 5) -> Dict[str, Any]:
@@ -307,12 +345,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="trace_event JSON output path (default <dir>/trace_events.json)",
     )
     parser.add_argument("--top", type=int, default=5)
+    parser.add_argument(
+        "--traffic",
+        action="store_true",
+        help="also run the tier-attribution walk over traffic traces and "
+        "emit a traffic_report verdict line",
+    )
+    parser.add_argument(
+        "--relative-error",
+        type=float,
+        default=0.01,
+        help="digest quantile relative-error bound for --traffic",
+    )
     args = parser.parse_args(argv)
 
     report = build_report(args.trace_dir, top=args.top)
     chrome = args.chrome or os.path.join(args.trace_dir, "trace_events.json")
     report["verdict"]["chrome"] = write_chrome(report, chrome)
     print_report(report)
+    if args.traffic:
+        traffic = build_traffic_report(
+            report["traces"], relative_error=args.relative_error
+        )
+        print_traffic_report(traffic)
+        print(json.dumps(traffic), flush=True)
     # the gate line LAST: tpu_watch scans for the newest matching object
     print(json.dumps(report["verdict"]), flush=True)
     ok = (
